@@ -1,0 +1,46 @@
+"""easydist_tpu: a TPU-native automatic-parallelization framework.
+
+One-decorator parallelization of unmodified JAX train/inference step functions:
+trace to jaxpr, discover per-op SPMD sharding rules by executing each op sharded
+and checking recombination (ShardCombine), solve a global ILP for the
+minimum-communication strategy over an ICI/DCN device mesh, and emit the original
+function with `jax.lax.with_sharding_constraint` so XLA's GSPMD partitioner
+inserts the collectives.  No CUDA/NCCL anywhere.
+
+Capability parity target: alibaba/easydist (see /root/reference) — user API
+`easydist_setup` + `easydist_compile` (reference easydist/__init__.py:21,
+easydist/jax/api.py:307), rebuilt TPU-first.
+"""
+
+__version__ = "0.1.0"
+
+from . import config  # noqa: F401
+
+
+def easydist_setup(backend: str = "jax", device: str = "tpu", allow_tf32: bool = True):
+    """Initialize the framework (reference: easydist/__init__.py:21-36).
+
+    On TPU there is no NCCL/process-group bring-up: multi-host initialization is
+    `jax.distributed.initialize()` over DCN, and single-host needs nothing.
+    """
+    import logging
+
+    logging.basicConfig(level=config.log_level)
+    from .platform import init_backend
+
+    init_backend(backend)
+    if backend == "jax" and config.multihost:
+        import jax
+
+        jax.distributed.initialize()
+
+
+def easydist_compile(func=None, **kwargs):
+    """Decorator entrypoint; dispatches to the JAX frontend.
+
+    Mirrors reference easydist/jax/api.py:307-323 (and torch/api.py:227 for the
+    torch frontend, which lowers to the same IR).
+    """
+    from .jaxfront.api import easydist_compile as _jax_compile
+
+    return _jax_compile(func, **kwargs)

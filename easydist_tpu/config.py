@@ -1,0 +1,90 @@
+"""Flat env-var-driven configuration (reference: easydist/config.py:28-126).
+
+Every knob is a module global, overridable by environment variable at import
+time and mutated by API kwargs at runtime.  Imported everywhere as `edconfig`.
+"""
+
+import logging
+import os
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    return val.lower() in ("1", "true", "yes", "on")
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, default))
+
+
+def _env_float(name: str, default: float) -> float:
+    return float(os.environ.get(name, default))
+
+
+# ---------------- logging / dumps ----------------
+log_level = getattr(logging, os.environ.get("EASYDIST_LOGLEVEL", "INFO").upper())
+dump_dir = os.environ.get("EASYDIST_DUMP_DIR", None)
+dump_strategy = _env_bool("EASYDIST_DUMP_STRATEGY", False)
+dump_cluster = _env_bool("EASYDIST_DUMP_CLUSTER", False)
+
+# ---------------- compile cache ----------------
+enable_compile_cache = _env_bool("EASYDIST_COMPILE_CACHE", False)
+compile_cache_dir = os.environ.get("EASYDIST_COMPILE_CACHE_DIR", "./.easydist_cache")
+
+# ---------------- ShardCombine discovery ----------------
+# number of shards used when executing candidate shardings (reference
+# metashard/metaop.py:62 uses 2)
+discovery_nshards = _env_int("EASYDIST_DISCOVERY_NSHARDS", 2)
+# run discovery ops on CPU even when a TPU is present (device dispatch for
+# thousands of tiny eager ops is wasteful; discovery is compile-time analysis)
+discovery_on_cpu = _env_bool("EASYDIST_DISCOVERY_ON_CPU", True)
+# allclose tolerance for recombination checks (reference platform/jax.py:24
+# uses rtol 5e-3 because of tf32; we default tighter on CPU float32)
+allclose_rtol = _env_float("EASYDIST_ALLCLOSE_RTOL", 1e-3)
+allclose_atol = _env_float("EASYDIST_ALLCLOSE_ATOL", 1e-5)
+# explore halo/block-cyclic extensions of the gather space (reference
+# config.py `extend_space`)
+extend_space = _env_bool("EASYDIST_EXTEND_SPACE", True)
+# cap tensor elements during discovery: ops larger than this get hint-shrunk
+# (reference torch/sharding_interpreter.py:256-313)
+discovery_hint_numel = _env_int("EASYDIST_DISCOVERY_HINT_NUMEL", 2**24)
+# hard cap on candidate shardings executed per shard group (the DFS is
+# exponential in the number of tensor args; jax primitives rarely exceed 3)
+discovery_max_candidates = _env_int("EASYDIST_DISCOVERY_MAX_CANDIDATES", 4096)
+
+# ---------------- solver ----------------
+enable_graph_coarsen = _env_bool("EASYDIST_ENABLE_GRAPH_COARSEN", True)
+coarsen_level = _env_int("EASYDIST_COARSEN_LEVEL", 1)
+solver_time_limit = _env_float("EASYDIST_SOLVER_TIME_LIMIT", 60.0)
+all_to_all_punish_factor = _env_float("EASYDIST_ALL_TO_ALL_PUNISH", 3.0)
+# allow re-picking a strategy already chosen on a previous mesh axis
+allow_repeated_axis_strategy = _env_bool("EASYDIST_ALLOW_REPEATED_AXIS_STRATEGY", False)
+# memory-aware solving: weight on per-device memory in the objective
+mem_cost_weight = _env_float("EASYDIST_MEM_COST_WEIGHT", 1e-8)
+# hard per-device memory cap in bytes (0 = unconstrained); v5e has 16 GiB HBM
+per_device_memory_cap = _env_int("EASYDIST_MEMORY_CAP", 0)
+memory_ratio = _env_float("EASYDIST_MEMORY_RATIO", 0.9)
+liveness_only_input = _env_bool("EASYDIST_LIVENESS_ONLY_INPUT", False)
+solver_backend = os.environ.get("EASYDIST_SOLVER", "milp")  # milp | beam
+beam_width = _env_int("EASYDIST_BEAM_WIDTH", 100)
+
+# ---------------- mesh / comm cost model ----------------
+# per-axis link bandwidth in bytes/s used to weight collective cost between
+# mesh axes; ICI (intra-slice) vs DCN (cross-slice).  v5e: 4x 400Gbps ICI
+# links/chip ≈ 200 GB/s; DCN ≈ 25 GB/s per host.
+ici_bandwidth = _env_float("EASYDIST_ICI_BANDWIDTH", 2.0e11)
+dcn_bandwidth = _env_float("EASYDIST_DCN_BANDWIDTH", 2.5e10)
+multihost = _env_bool("EASYDIST_MULTIHOST", False)
+
+# ---------------- runtime ----------------
+# donate params/opt-state buffers in the emitted jit (XLA buffer aliasing: the
+# TPU analog of the reference's in-place CUDA memory reuse)
+enable_donation = _env_bool("EASYDIST_ENABLE_DONATION", True)
+# jax.remat policy applied to the emitted function: "none" | "dots" | "all"
+remat_policy = os.environ.get("EASYDIST_REMAT_POLICY", "none")
+
+# ---------------- profiling / perf db ----------------
+prof_db_path = os.environ.get("EASYDIST_PERF_DB", os.path.expanduser("~/.easydist_tpu/perf.db"))
+enable_runtime_prof = _env_bool("EASYDIST_RUNTIME_PROF", False)

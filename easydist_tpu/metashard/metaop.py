@@ -1,0 +1,216 @@
+"""MetaOp: execution-based SPMD sharding-rule discovery ("ShardCombine").
+
+Wraps a single operator (`fn`, concrete `args`).  `discover()` searches the
+space of input shardings: it assigns a shard *group* to at most one dimension
+of each tensor argument, executes the op once per shard with those dimensions
+split `nshards` ways, and accepts the assignment iff the per-shard outputs can
+be recombined into the unsharded output (see combination.match_recombine).
+Each accepted group becomes one SPMD strategy of the op: inputs SHARD on their
+group dims, output placement given by the recombination kind.
+
+Reference semantics: easydist/metashard/metaop.py:60-277 (search order,
+halo-retry loop, prompt fast-path).  Implementation is fresh; discovery runs
+eagerly on the host CPU (see platform.jax_backend).
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+from typing import Callable, Dict, List, Optional, Tuple
+
+from easydist_tpu import config as edconfig
+from easydist_tpu import platform
+from .annotation import DimSharding, HaloSpec, ShardSpace, halo_pad
+from .combination import HaloHint, match_recombine
+
+logger = logging.getLogger(__name__)
+
+
+class MetaOp:
+
+    def __init__(self, fn: Callable, args, nshards: Optional[int] = None,
+                 name: Optional[str] = None):
+        self.fn = fn
+        self.name = name or getattr(fn, "__name__", repr(fn))
+        self.nshards = nshards or edconfig.discovery_nshards
+        self.flat_args, self.args_spec = platform.tree_flatten(args)
+        self.tensor_indices = [i for i, a in enumerate(self.flat_args)
+                               if isinstance(a, platform.Tensor)]
+
+    # ------------------------------------------------------------- execution
+
+    def _call(self, flat_args):
+        args = platform.tree_unflatten(flat_args, self.args_spec)
+        if isinstance(args, tuple) and len(args) == 2 and isinstance(args[1], dict):
+            a, kw = args
+            return self.fn(*a, **kw)
+        return self.fn(*args)
+
+    def run_global(self):
+        return self._call(list(self.flat_args))
+
+    def _shard_tensor(self, tensor, dim: int, block: int, halo: Optional[HaloSpec]):
+        """Split `tensor` into nshards along `dim`; block-cyclic if block > 1;
+        halo-pad the shards afterwards."""
+        if tensor.shape[dim] % (self.nshards * block) != 0:
+            raise RuntimeError(
+                f"dim {dim} of size {tensor.shape[dim]} not divisible into "
+                f"{self.nshards} shards x {block} blocks")
+        if block == 1:
+            shards = platform.chunk(tensor, self.nshards, dim)
+        else:
+            blocks = platform.chunk(tensor, block, dim)
+            per_block = [platform.chunk(b, self.nshards, dim) for b in blocks]
+            shards = [platform.concatenate([pb[s] for pb in per_block], dim=dim)
+                      for s in range(self.nshards)]
+        return halo_pad(shards, halo)
+
+    def run_sharded(self, space: ShardSpace, group: int,
+                    halo: Optional[HaloSpec] = None) -> List:
+        """Execute once per shard with the group's dims split; returns the list
+        of per-shard outputs.  Raises RuntimeError when shapes don't divide."""
+        shard_plans: Dict[int, List] = {}  # flat-arg index -> per-shard tensors
+        for t_idx, flat_idx in enumerate(self.tensor_indices):
+            row = space[t_idx]
+            for dim_idx, d in enumerate(row):
+                if d.group == group:
+                    eff_halo = halo if halo is not None else d.halo
+                    if eff_halo is not None:
+                        # halo is always exchanged along the dim being split —
+                        # a HaloHint's dim refers to the *output* concat dim
+                        # and must not leak here
+                        eff_halo = HaloSpec(eff_halo.width, dim_idx)
+                    shard_plans[flat_idx] = self._shard_tensor(
+                        self.flat_args[flat_idx], dim_idx, d.block, eff_halo)
+                    break
+        if not shard_plans:
+            raise RuntimeError(f"group {group} not present in shard space")
+
+        outs = []
+        for s in range(self.nshards):
+            shard_args = list(self.flat_args)
+            for flat_idx, shards in shard_plans.items():
+                shard_args[flat_idx] = shards[s]
+            outs.append(self._call(shard_args))
+        return outs
+
+    # -------------------------------------------------------------- discovery
+
+    def _check_candidate(self, space: ShardSpace, group: int, global_out):
+        """Execute a candidate sharding and match recombination; drives the
+        halo-retry loop (reference metaop.py:147-166).  Returns
+        (recombine_fn_or_list, halo_used) or None."""
+        try:
+            sharded = self.run_sharded(space, group)
+        except Exception as e:  # shape indivisible, op rejects sharded input, ...
+            logger.debug("candidate %r failed to execute: %s", space, e)
+            return None
+
+        fn = match_recombine(sharded, global_out)
+        if isinstance(fn, HaloHint):
+            hint = fn
+            width0 = max(hint.width, 1)
+            sample = sharded[0][hint.out_idx] if hint.out_idx is not None else sharded[0]
+            width_cap = max(sample.shape[hint.dim] // 2, width0)
+            for width in range(width0, width_cap + 1):
+                halo = HaloSpec(width, hint.dim)
+                try:
+                    sharded = self.run_sharded(space, group, halo=halo)
+                except Exception:
+                    return None
+                fn = match_recombine(sharded, global_out)
+                if fn is not None and not isinstance(fn, HaloHint):
+                    return fn, halo
+            return None
+        if fn is None:
+            return None
+        return fn, None
+
+    def _search_group(self, space: ShardSpace, group: int,
+                      anchor: Tuple[int, int], global_out):
+        """Find an assignment of `group` to >=1 currently-unsharded dims (at
+        most one per tensor), whose first assigned dim is at/after `anchor`.
+        Candidates are enumerated depth-first in (tensor, dim) order; the first
+        that executes and recombines wins (reference metaop.py:130-188).
+
+        Returns (new_space, recombine, halo) or None."""
+        ntensors = len(space)
+
+        def assignments(t_idx: int, chosen: List[Tuple[int, int]]):
+            if t_idx == ntensors:
+                if chosen:
+                    yield list(chosen)
+                return
+            start = anchor[1] if t_idx == anchor[0] and not chosen else 0
+            if not chosen and t_idx < anchor[0]:
+                # first assigned dim must not precede the anchor tensor
+                yield from assignments(t_idx + 1, chosen)
+                return
+            for dim_idx in range(start, len(space[t_idx])):
+                if space[t_idx][dim_idx].group == 0:
+                    chosen.append((t_idx, dim_idx))
+                    yield from assignments(t_idx + 1, chosen)
+                    chosen.pop()
+            yield from assignments(t_idx + 1, chosen)
+
+        budget = edconfig.discovery_max_candidates
+        for chosen in assignments(0, []):
+            budget -= 1
+            if budget < 0:
+                logger.debug("%s: candidate budget exhausted for group %d",
+                             self.name, group)
+                return None
+            cand = copy.deepcopy(space)
+            for t_idx, dim_idx in chosen:
+                cand.table[t_idx][dim_idx] = DimSharding(group=group)
+            res = self._check_candidate(cand, group, global_out)
+            if res is not None:
+                fn, halo = res
+                cand.attach_halo(halo, group)
+                return cand, fn, halo
+        return None
+
+    def discover(self, prompt: Optional[ShardSpace] = None):
+        """Full sharding discovery.  Returns (ShardSpace, {group: recombine}).
+
+        `prompt` is a space discovered for the same op at other shapes; its
+        groups are re-validated cheaply before falling back to search
+        (reference metaop.py:190-260, 262-277).
+        """
+        recombines: Dict[int, object] = {}
+        space = ShardSpace.for_args(self.flat_args)
+        global_out = self.run_global()
+
+        if prompt is not None and prompt.compatible_with_args(self.flat_args):
+            prompt_halos = {}
+            for group in range(1, prompt.max_group() + 1):
+                res = self._check_candidate(prompt, group, global_out)
+                if res is None:
+                    break
+                recombines[group] = res[0]
+                prompt_halos[group] = res[1]
+            if recombines:
+                space = prompt.truncate(len(recombines))
+                for group, halo in prompt_halos.items():
+                    if halo is not None:  # re-validation needed a new width
+                        space.attach_halo(halo, group)
+
+        group = len(recombines) + 1
+        anchor = (0, 0)
+        while anchor[0] < len(space):
+            found = self._search_group(space, group, anchor, global_out)
+            if found is None:
+                break
+            space, fn, _halo = found
+            recombines[group] = fn
+            # next group's first dim must come after this group's first dim
+            pos = next(((t, d) for t in range(len(space))
+                        for d in range(len(space[t]))
+                        if space[t][d].group == group))
+            t, d = pos
+            anchor = (t, d + 1) if d + 1 < len(space[t]) else (t + 1, 0)
+            group += 1
+
+        logger.debug("discovered space of %s: %r", self.name, space)
+        return space, recombines

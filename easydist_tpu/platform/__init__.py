@@ -1,0 +1,52 @@
+"""Tensor micro-API that the ShardCombine engine runs on.
+
+The discovery engine (metashard/) only needs ~15 tensor operations, so it is
+kept framework-neutral behind this registry (reference:
+easydist/platform/__init__.py:23-49).  Backends: "jax" (default; discovery runs
+eagerly on the host CPU device) and "numpy" (hardware-free unit tests).  A torch
+frontend reuses the same engine by converting through numpy.
+"""
+
+import importlib
+import sys
+
+_BACKEND_NAME = None
+_BACKEND_MOD = None
+
+# the operations every backend must provide
+_API = [
+    "Tensor", "add", "equal", "allclose", "zeros_like", "minimum", "maximum",
+    "concatenate", "chunk", "narrow", "clone", "from_numpy", "to_numpy",
+    "tree_flatten", "tree_unflatten",
+]
+
+
+def init_backend(name: str = "jax"):
+    """Load a backend module and re-export its micro-API here."""
+    global _BACKEND_NAME, _BACKEND_MOD
+    mod = importlib.import_module(f"easydist_tpu.platform.{name}_backend")
+    for fn in _API:
+        if not hasattr(mod, fn):
+            raise RuntimeError(f"backend {name!r} is missing platform op {fn!r}")
+        setattr(sys.modules[__name__], fn, getattr(mod, fn))
+    _BACKEND_NAME = name
+    _BACKEND_MOD = mod
+    return mod
+
+
+def get_backend() -> str:
+    return _BACKEND_NAME
+
+
+def backend_initialized() -> bool:
+    return _BACKEND_NAME is not None
+
+
+def __getattr__(name):
+    """Lazily initialize the default (jax) backend on first API access, so
+    importing the package stays cheap and the numpy backend can be selected
+    in jax-free environments."""
+    if name in _API and _BACKEND_NAME is None:
+        init_backend("jax")
+        return getattr(sys.modules[__name__], name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

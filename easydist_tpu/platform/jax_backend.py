@@ -1,0 +1,105 @@
+"""JAX implementation of the platform micro-API (reference: easydist/platform/jax.py).
+
+All ops here run eagerly.  Discovery executes thousands of tiny ops, so we pin
+them to the host CPU device when `config.discovery_on_cpu` is set — compile-time
+analysis should not occupy the TPU or pay device-transfer latency.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from easydist_tpu import config as edconfig
+
+Tensor = jax.Array
+
+
+@functools.lru_cache(maxsize=1)
+def _cpu_device():
+    return jax.local_devices(backend="cpu")[0]
+
+
+def _maybe_cpu(fn):
+    """Run `fn` with default device = host CPU (and jit disabled)."""
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        if edconfig.discovery_on_cpu:
+            with jax.default_device(_cpu_device()):
+                return fn(*args, **kwargs)
+        return fn(*args, **kwargs)
+
+    return wrapped
+
+
+@_maybe_cpu
+def add(x, y):
+    return jnp.add(x, y)
+
+
+@_maybe_cpu
+def equal(x, y):
+    if x.shape != y.shape:
+        return False
+    return bool(jnp.array_equal(x, y))
+
+
+@_maybe_cpu
+def allclose(x, y):
+    if getattr(x, "shape", None) != getattr(y, "shape", None):
+        return False
+    return bool(jnp.allclose(x, y, rtol=edconfig.allclose_rtol, atol=edconfig.allclose_atol))
+
+
+@_maybe_cpu
+def zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@_maybe_cpu
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+@_maybe_cpu
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+@_maybe_cpu
+def concatenate(tensors, dim=0):
+    return jnp.concatenate(tensors, axis=dim)
+
+
+@_maybe_cpu
+def chunk(tensor, chunks, dim=0):
+    """Split into `chunks` equal parts along `dim` (must divide evenly)."""
+    return jnp.split(tensor, chunks, axis=dim)
+
+
+@_maybe_cpu
+def narrow(tensor, dim, start, length):
+    return jax.lax.slice_in_dim(tensor, start, start + length, axis=dim)
+
+
+def clone(x):
+    return x  # jax arrays are immutable; aliasing is safe
+
+
+@_maybe_cpu
+def from_numpy(x):
+    return jnp.asarray(x)
+
+
+def to_numpy(x):
+    return np.asarray(x)
+
+
+def tree_flatten(tree):
+    return jax.tree_util.tree_flatten(tree)
+
+
+def tree_unflatten(leaves, spec):
+    return jax.tree_util.tree_unflatten(spec, leaves)

@@ -1,0 +1,25 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+This is the TPU analog of the reference's mock-device-mesh trick
+(easydist/utils/testing/mock.py:16-50): one process, N-device semantics, no
+hardware.  Must configure jax BEFORE any backend initialization — the axon TPU
+plugin registers itself in sitecustomize and would otherwise claim the backend.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devices = jax.devices()
+    assert len(devices) == 8, f"expected 8 virtual CPU devices, got {len(devices)}"
+    return devices

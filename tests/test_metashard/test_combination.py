@@ -1,0 +1,126 @@
+"""Unit tests for the recombination library (hardware-free, numpy backend).
+
+Mirrors the reference test strategy (tests/test_combination/*): exercise the
+Recombine functions and match_* checkers directly on small arrays.
+"""
+
+import numpy as np
+import pytest
+
+from easydist_tpu import platform
+from easydist_tpu.metashard.combination import (
+    HaloHint, Recombine, Reduction, match_concat, match_identity, match_recombine,
+    match_reduce)
+
+
+@pytest.fixture(autouse=True)
+def numpy_backend():
+    platform.init_backend("numpy")
+    yield
+    platform.init_backend("jax")
+
+
+def test_identity_roundtrip():
+    x = np.arange(12.0).reshape(3, 4)
+    assert match_identity([x, x.copy()], x) is not None
+    y = x + 1
+    assert match_identity([x, y], x) is None
+
+
+def test_reduce_sum():
+    rng = np.random.default_rng(0)
+    a, b = rng.normal(size=(4, 4)), rng.normal(size=(4, 4))
+    fn = match_reduce([a, b], a + b)
+    assert fn is not None
+    np.testing.assert_allclose(fn([a, b]), a + b)
+
+
+def test_reduce_max_min():
+    a = np.array([[1.0, 5.0], [3.0, 2.0]])
+    b = np.array([[4.0, 0.0], [1.0, 9.0]])
+    assert match_reduce([a, b], np.maximum(a, b)) is not None
+    assert match_reduce([a, b], np.minimum(a, b)) is not None
+    assert match_reduce([a, b], a * b) is None
+
+
+def test_concat_plain():
+    x = np.arange(24.0).reshape(4, 6)
+    parts = np.split(x, 2, axis=0)
+    fn = match_concat(parts, x)
+    assert fn is not None and fn.keywords["dim"] == 0
+    parts = np.split(x, 3, axis=1)
+    fn = match_concat(parts, x)
+    assert fn is not None and fn.keywords["dim"] == 1
+
+
+def test_concat_block_cyclic():
+    # block-cyclic sharded: shard0 = blocks [0,2], shard1 = blocks [1,3]
+    x = np.arange(16.0)
+    blocks = np.split(x, 4)
+    parts = [np.concatenate([blocks[0], blocks[2]]),
+             np.concatenate([blocks[1], blocks[3]])]
+    fn = match_concat(parts, x)
+    assert fn is not None
+    assert fn.keywords.get("block", 1) == 2
+    np.testing.assert_allclose(fn(parts), x)
+
+
+def test_concat_overlap_halo_positive():
+    # conv-style: adjacent shards share a 2-wide overlap that sums to target
+    full = np.arange(10.0)
+    left, right = full[:6].copy(), full[4:].copy()
+    left[4:] *= 0.25
+    right[:2] = full[4:6] * 0.75
+    fn = match_concat([left, right], full)
+    assert fn is not None and fn.keywords.get("halo") == 2
+
+
+def test_halo_hint_for_undersized_parts():
+    # valid-conv style: two parts 2 elements short in total -> HaloHint
+    full = np.arange(10.0).reshape(10, 1)
+    parts = [full[:4], full[4:8]]
+    got = match_concat(parts, full)
+    assert isinstance(got, HaloHint)
+
+
+def test_multi_output_match():
+    x = np.arange(8.0).reshape(4, 2)
+    halves = np.split(x, 2, axis=0)
+    sharded = [(h, 7) for h in halves]
+    fns = match_recombine(sharded, (x, 7))
+    assert isinstance(fns, list) and len(fns) == 1
+    sharded_bad = [(halves[0], 7), (halves[1], 8)]
+    assert match_recombine(sharded_bad, (x, 7)) is None
+
+
+def test_recombine_concat_negative_halo():
+    # each part overhangs by 1 at the seam; halo=-1 drops the overlap
+    full = np.arange(8.0)
+    parts = [full[:5], full[3:]]
+    got = Recombine.concat(parts, dim=0, halo=-1)
+    np.testing.assert_allclose(got, full)
+
+
+def test_reduce_avg():
+    a, b = np.ones((2, 2)), 3 * np.ones((2, 2))
+    np.testing.assert_allclose(Recombine.reduce([a, b], Reduction.AVG), 2 * np.ones((2, 2)))
+
+
+def test_concat_overhang_many_parts():
+    # 4 parts, each seam overhangs 1 element on both sides: gap = 2*1*(4-1) = 6
+    full = np.arange(12.0)
+    bounds = [0, 3, 6, 9, 12]
+    parts = []
+    for i in range(4):
+        lo = max(bounds[i] - 1, 0)
+        hi = min(bounds[i + 1] + 1, 12)
+        parts.append(full[lo:hi])
+    fn = match_concat(parts, full)
+    assert fn is not None and fn.keywords.get("halo") == -1
+    np.testing.assert_allclose(fn(parts), full)
+
+
+def test_reduce_avg_matched():
+    a, b = np.ones((2, 2)), 3 * np.ones((2, 2))
+    fn = match_reduce([a, b], 2 * np.ones((2, 2)))
+    assert fn is not None and fn.keywords["op"] is Reduction.AVG

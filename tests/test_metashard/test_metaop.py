@@ -1,0 +1,146 @@
+"""ShardCombine discovery tests on canonical ops (numpy backend, no hardware).
+
+The expected spaces mirror the reference docstring examples
+(easydist/metashard/annotation.py:76-80): matmul gets three groups (row,
+contraction, column), elementwise ops get one group per dim, reductions mark
+the reduced dim PARTIAL.
+"""
+
+import numpy as np
+import pytest
+
+from easydist_tpu import platform
+from easydist_tpu.metashard import MetaOp
+from easydist_tpu.metashard.combination import Recombine, Reduction
+
+
+@pytest.fixture(autouse=True)
+def numpy_backend():
+    platform.init_backend("numpy")
+    yield
+    platform.init_backend("jax")
+
+
+def groups_of(space):
+    return [[d.group for d in row] for row in space.table]
+
+
+def test_matmul_discovery():
+    rng = np.random.default_rng(0)
+    a, b = rng.normal(size=(4, 6)), rng.normal(size=(6, 8))
+    op = MetaOp(np.matmul, (a, b), name="matmul")
+    space, recombines = op.discover()
+    # groups: 1 = row shard (concat dim 0), 2 = contraction (reduce SUM),
+    # 3 = col shard (concat dim 1)
+    assert groups_of(space) == [[1, 2], [2, 3]]
+    assert recombines[1].func is Recombine.concat and recombines[1].keywords["dim"] == 0
+    assert recombines[2].func is Recombine.reduce
+    assert recombines[2].keywords["op"] is Reduction.SUM
+    assert recombines[3].func is Recombine.concat and recombines[3].keywords["dim"] == 1
+
+
+def test_elementwise_discovery():
+    x = np.random.default_rng(1).normal(size=(4, 6))
+    op = MetaOp(np.tanh, (x,), name="tanh")
+    space, recombines = op.discover()
+    assert groups_of(space) == [[1, 2]]
+    assert recombines[1].keywords["dim"] == 0
+    assert recombines[2].keywords["dim"] == 1
+
+
+def test_binary_elementwise_discovery():
+    rng = np.random.default_rng(2)
+    x, y = rng.normal(size=(4, 6)), rng.normal(size=(4, 6))
+    op = MetaOp(np.add, (x, y), name="add")
+    space, _ = op.discover()
+    # both args must shard together on each dim
+    assert groups_of(space) == [[1, 2], [1, 2]]
+
+
+def test_reduction_discovery():
+    x = np.random.default_rng(3).normal(size=(4, 6))
+
+    def sum0(t):
+        return t.sum(axis=0)
+
+    op = MetaOp(sum0, (x,), name="sum0")
+    space, recombines = op.discover()
+    # dim0 shard -> PARTIAL(SUM); dim1 shard -> concat dim0 of the output
+    assert groups_of(space) == [[1, 2]]
+    assert recombines[1].func is Recombine.reduce
+    assert recombines[2].func is Recombine.concat and recombines[2].keywords["dim"] == 0
+
+
+def test_mean_norm_style_op():
+    # layernorm-like: normalize over the last dim; last dim must be unshardable
+    x = np.random.default_rng(4).normal(size=(4, 6, 8))
+
+    def norm(t):
+        mu = t.mean(axis=-1, keepdims=True)
+        var = t.var(axis=-1, keepdims=True)
+        return (t - mu) / np.sqrt(var + 1e-5)
+
+    op = MetaOp(norm, (x,), name="norm")
+    space, _ = op.discover()
+    assert groups_of(space) == [[1, 2, 0]]
+
+
+def test_conv1d_halo_discovery():
+    # same-padded conv: sharding the spatial dim needs halo exchange
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(16,))
+    k = rng.normal(size=(3,))
+
+    def conv_same(t, w):
+        return np.convolve(t, w, mode="same")
+
+    op = MetaOp(conv_same, (x, k), name="conv_same")
+    space, recombines = op.discover()
+    row = space.table[0]
+    shard_dims = [d for d in row if d.group > 0]
+    assert len(shard_dims) == 1
+    assert shard_dims[0].halo is not None and shard_dims[0].halo.width >= 1
+    assert 1 in recombines
+
+
+def test_prompt_fast_path():
+    rng = np.random.default_rng(6)
+    a, b = rng.normal(size=(4, 6)), rng.normal(size=(6, 8))
+    op1 = MetaOp(np.matmul, (a, b), name="matmul")
+    space1, _ = op1.discover()
+
+    a2, b2 = rng.normal(size=(8, 12)), rng.normal(size=(12, 4))
+    op2 = MetaOp(np.matmul, (a2, b2), name="matmul")
+    space2, rec2 = op2.discover(prompt=space1)
+    assert groups_of(space2) == groups_of(space1)
+    assert set(rec2) == {1, 2, 3}
+
+
+def test_indivisible_dim_not_sharded():
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(3, 4))  # dim0 size 3 not divisible by 2 shards
+    op = MetaOp(np.tanh, (x,), name="tanh")
+    space, recombines = op.discover()
+    assert groups_of(space) == [[0, 1]]
+    assert recombines[1].keywords["dim"] == 1
+
+
+def test_mean_partial_avg_discovery():
+    # mean over dim0: sharding dim0 IS valid via PARTIAL(AVG) recombination
+    x = np.random.default_rng(8).normal(size=(4, 6))
+    op = MetaOp(lambda t: t.mean(axis=0), (x,), name="mean0")
+    space, recombines = op.discover()
+    assert groups_of(space) == [[1, 2]]
+    assert recombines[1].keywords["op"] is Reduction.AVG
+
+
+def test_conv_valid_needs_full_halo_width():
+    # valid conv with kernel 5 on 2 shards needs halo width 2 == out_dim // 2,
+    # the boundary case the retry loop must include
+    rng = np.random.default_rng(9)
+    x, k = rng.normal(size=(16,)), rng.normal(size=(5,))
+    op = MetaOp(lambda t, w: np.convolve(t, w, mode="valid"), (x, k), name="conv_valid")
+    space, recombines = op.discover()
+    shard_dims = [d for d in space.table[0] if d.group > 0]
+    assert len(shard_dims) == 1 and shard_dims[0].halo.width == 2
+    assert 1 in recombines

@@ -1,0 +1,71 @@
+"""Analytic reshape-rule tests (reference: tests/test_unfiyshard/test_view_propagation.py)."""
+
+from easydist_tpu.metashard.annotation import DimSharding
+from easydist_tpu.metashard.view_propagation import view_rule, view_rule_for_space
+
+
+def groups(space):
+    return [d.group for d in space.table[0]]
+
+
+def test_identity_reshape():
+    r = view_rule([4, 8], [4, 8], world_size=2)
+    assert groups(r["space"]) == [1, 2]
+    assert r["recombines"][1].keywords["dim"] == 0
+    assert r["recombines"][2].keywords["dim"] == 1
+
+
+def test_merge_dims():
+    # [4, 8] -> [32]: leading dim of the merged run shardable, concat on dim 0
+    r = view_rule([4, 8], [32], world_size=2)
+    assert groups(r["space"]) == [1, 0]
+    assert r["recombines"][1].keywords["dim"] == 0
+
+
+def test_split_dim():
+    # [32] -> [4, 8]: shard maps to leftmost output dim of the split run
+    r = view_rule([32], [4, 8], world_size=2)
+    assert groups(r["space"]) == [1]
+    assert r["recombines"][1].keywords["dim"] == 0
+
+
+def test_mixed_reshape():
+    # [2, 6, 4] -> [12, 4]: merge (2,6)->12, keep 4
+    r = view_rule([2, 6, 4], [12, 4], world_size=2)
+    assert groups(r["space"]) == [1, 0, 2]
+    assert r["recombines"][1].keywords["dim"] == 0
+    assert r["recombines"][2].keywords["dim"] == 1
+
+
+def test_unit_dims_skipped():
+    r = view_rule([4, 1, 8], [4, 8], world_size=2)
+    assert groups(r["space"])[0] == 1
+    assert groups(r["space"])[2] == 2
+
+
+def test_world_size_gates_small_dims():
+    # dim of size 2 < world_size 4 is not shardable
+    r = view_rule([2, 8], [16], world_size=4)
+    assert groups(r["space"]) == [0, 0] or groups(r["space"])[0] == 0
+
+
+def test_negative_one_inference():
+    r = view_rule([4, 8], [-1], world_size=2)
+    assert groups(r["space"]) == [1, 0]
+    assert r["recombines"][1].keywords["dim"] == 0
+
+
+def test_preset_rule():
+    # input [4, 8] sharded on dim 0, reshape to [32]: output concat on dim 0
+    row = [DimSharding(group=1), DimSharding()]
+    fn = view_rule_for_space([4, 8], [32], row)
+    assert fn is not None and fn.keywords["dim"] == 0
+
+
+def test_split_dim_divisibility_gate():
+    # [12] -> [6, 2] with world_size 4: leftmost split dim 6 % 4 != 0 -> no shard
+    r = view_rule([12], [6, 2], world_size=4)
+    assert groups(r["space"]) == [0]
+    # with world_size 2 it divides -> shardable
+    r = view_rule([12], [6, 2], world_size=2)
+    assert groups(r["space"]) == [1]
