@@ -1,0 +1,311 @@
+"""Global SPMD strategy selection: binary ILP over cluster strategies.
+
+Formulation (reference AutoFlowSolver1D, autoflow/solver.py:224-730, rebuilt
+on scipy/HiGHS since neither `mip` nor `ortools` ships here):
+
+  variables   y[c,s] in {0,1}   cluster c uses strategy s
+              z[e,i,j] >= 0     edge e joins producer strategy i / consumer j
+  constraints sum_s y[c,s] == 1
+              z[e,i,j] >= y[up(e),i] + y[down(e),j] - 1
+  objective   min sum_e C_e[i,j] z[e,i,j]  +  w_mem * sum_e M_e[i,j] z[e,i,j]
+
+With one-hot y and non-negative costs the z lower bounds make z behave as the
+product y_up*y_down at the optimum, so z stays continuous — the model has far
+fewer integers than the reference's all-binary AND-linearization.
+
+Optionally a hard per-device memory cap is enforced per liveness step
+(the reference left this half-finished: solver.py:665-707 commented out).
+
+An ND mesh is solved one axis at a time by the frontend (reference
+compile_auto.py:128-173): strategies already chosen on earlier axes are
+excluded from pools and shapes pre-shrunk before the next 1D solve.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+from scipy import sparse
+from scipy.optimize import Bounds, LinearConstraint, milp
+
+from easydist_tpu import config as edconfig
+from easydist_tpu.metashard.metair import (MetaGraph, MetaNode, NodeStrategy,
+                                           Placement)
+from .cost_model import MeshAxisSpec, placement_bytes, resharding_cost
+
+logger = logging.getLogger(__name__)
+
+
+class _Edge:
+    """One producer-cluster -> consumer-cluster tensor dependency."""
+
+    def __init__(self, var, up_cluster, up_node, out_idx,
+                 down_cluster, down_node, in_idx):
+        self.var = var
+        self.up_cluster = up_cluster
+        self.up_node = up_node
+        self.out_idx = out_idx
+        self.down_cluster = down_cluster
+        self.down_node = down_node
+        self.in_idx = in_idx
+        self.comm: Optional[np.ndarray] = None
+        self.mem: Optional[np.ndarray] = None
+        self.z_offset: int = -1
+
+    def up_placement(self, i: int) -> Placement:
+        s = self.up_cluster.strategies[i][self.up_node.uid][1]
+        return s.out_placements[self.out_idx]
+
+    def down_placement(self, j: int) -> Placement:
+        s = self.down_cluster.strategies[j][self.down_node.uid][1]
+        if self.down_node.is_input:
+            # state_io edge: the placeholder's "need" is its own out placement
+            return s.out_placements[self.in_idx]
+        return s.in_placements[self.in_idx]
+
+
+class SpmdSolver:
+    """Solve one mesh axis for a coarsened MetaGraph."""
+
+    def __init__(self, graph: MetaGraph, axis: MeshAxisSpec):
+        self.graph = graph
+        self.axis = axis
+        self.clusters = graph.clusters
+        self.edges: List[_Edge] = []
+        self._collect_edges()
+        self._build_matrices()
+
+    # ------------------------------------------------------------ model build
+
+    def _cluster_of(self, node: MetaNode):
+        return self.clusters[
+            next(i for i, c in enumerate(self.clusters)
+                 if node.uid in c.nodes)] if node.cluster_id < 0 \
+            else next(c for c in self.clusters if c.cid == node.cluster_id)
+
+    def _collect_edges(self):
+        by_cid = {c.cid: c for c in self.clusters}
+        for node in self.graph.all_nodes():
+            down_c = by_cid[node.cluster_id]
+            for in_idx, var in enumerate(node.invars):
+                if var is None or var.producer is None:
+                    continue
+                up = var.producer
+                if up.cluster_id == node.cluster_id:
+                    continue  # intra-cluster: sync-free by construction
+                self.edges.append(_Edge(var, by_cid[up.cluster_id], up,
+                                        var.producer_idx, down_c, node, in_idx))
+        # state threading: the producer of an updated state tensor should land
+        # on the same placement the matching input placeholder chose, else the
+        # next step pays a reshard (reference state_io_map edges,
+        # solver.py:279-283)
+        for out_name, placeholder in self.graph.state_io.items():
+            var = next((v for v in self.graph.outputs if v.name == out_name), None)
+            if var is None or var.producer is None:
+                continue
+            self.edges.append(_Edge(var, by_cid[var.producer.cluster_id],
+                                    var.producer, var.producer_idx,
+                                    by_cid[placeholder.cluster_id], placeholder,
+                                    0))
+
+        # non-state graph outputs are handed back to the user replicated, so a
+        # PARTIAL or SHARD producer pays the final collective here (reference
+        # forces returns to REPLICATE, torch/passes/sharding.py:920-949).
+        # Linear cost on the producer cluster's y variables.
+        self.output_y_cost: Dict[int, np.ndarray] = {}
+        state_outs = set(self.graph.state_io)
+        for var in self.graph.outputs:
+            if var.name in state_outs or var.producer is None:
+                continue
+            c = by_cid[var.producer.cluster_id]
+            costs = self.output_y_cost.setdefault(
+                c.cid, np.zeros(c.strategy_count()))
+            for s in range(c.strategy_count()):
+                p = c.strategies[s][var.producer.uid][1].out_placements[
+                    var.producer_idx]
+                if p is not None:
+                    costs[s] += resharding_cost(var.size_bytes(), p,
+                                                Placement.replicate(), self.axis)
+
+    def _build_matrices(self):
+        for e in self.edges:
+            n_up = e.up_cluster.strategy_count()
+            n_down = e.down_cluster.strategy_count()
+            comm = np.zeros((n_up, n_down))
+            mem = np.zeros((n_up, n_down))
+            size = e.var.size_bytes()
+            for i in range(n_up):
+                pu = e.up_placement(i)
+                for j in range(n_down):
+                    pd = e.down_placement(j)
+                    if pu is None or pd is None:
+                        continue
+                    comm[i, j] = resharding_cost(size, pu, pd, self.axis)
+                    mem[i, j] = (placement_bytes(size, pu, self.axis.size)
+                                 + placement_bytes(size, pd, self.axis.size))
+            e.comm, e.mem = comm, mem
+
+    # ----------------------------------------------------------------- solve
+
+    def solve(self) -> Dict[str, NodeStrategy]:
+        if edconfig.solver_backend == "beam" or not self.edges:
+            return self.beam_search()
+        try:
+            return self._ilp_solve()
+        except Exception:
+            logger.exception("ILP solve failed; falling back to beam search")
+            return self.beam_search()
+
+    def _ilp_solve(self) -> Dict[str, NodeStrategy]:
+        start = time.perf_counter()
+        y_offset: Dict[int, int] = {}
+        nvar = 0
+        for c in self.clusters:
+            y_offset[c.cid] = nvar
+            nvar += c.strategy_count()
+        n_y = nvar
+        for e in self.edges:
+            e.z_offset = nvar
+            nvar += e.up_cluster.strategy_count() * e.down_cluster.strategy_count()
+
+        # objective = comm (dominant) + memory (strict tie-breaker).
+        # Comm is rescaled to O(1): raw costs in seconds (~1e-8) sit below
+        # HiGHS's default tolerances, which silently accepts suboptimal
+        # incumbents.  Memory is then scaled so that the TOTAL memory term
+        # stays below the smallest nonzero comm difference — it can order
+        # comm-equivalent solutions (shard beats replicate) but never flip a
+        # real comm decision.
+        comm = np.zeros(nvar)
+        mem = np.zeros(nvar)
+        for e in self.edges:
+            comm[e.z_offset:e.z_offset + e.comm.size] = e.comm.ravel()
+            mem[e.z_offset:e.z_offset + e.mem.size] = e.mem.ravel()
+        for cid, costs in self.output_y_cost.items():
+            off = y_offset[cid]
+            comm[off:off + costs.size] += costs
+        cost_scale = float(comm.max())
+        if cost_scale > 0:
+            comm = comm / cost_scale
+        positive = comm[comm > 0]
+        min_comm_step = positive.min() if positive.size else 1.0
+        mem_max = float(mem.max())
+        if mem_max > 0:
+            n_active = max(len(self.edges), 1)
+            mem = mem * (min_comm_step / (10.0 * n_active * mem_max))
+        cost = comm + mem
+
+        rows, cols, vals, lbs, ubs = [], [], [], [], []
+        row = 0
+        # one-hot cluster choice
+        for c in self.clusters:
+            for s in range(c.strategy_count()):
+                rows.append(row); cols.append(y_offset[c.cid] + s); vals.append(1.0)
+            lbs.append(1.0); ubs.append(1.0)
+            row += 1
+        # z >= y_up + y_down - 1  <=>  z - y_up - y_down >= -1
+        for e in self.edges:
+            n_up = e.up_cluster.strategy_count()
+            n_down = e.down_cluster.strategy_count()
+            for i in range(n_up):
+                for j in range(n_down):
+                    z = e.z_offset + i * n_down + j
+                    rows += [row, row, row]
+                    cols += [z, y_offset[e.up_cluster.cid] + i,
+                             y_offset[e.down_cluster.cid] + j]
+                    vals += [1.0, -1.0, -1.0]
+                    lbs.append(-1.0); ubs.append(np.inf)
+                    row += 1
+
+        # optional hard memory cap per liveness step
+        cap = edconfig.per_device_memory_cap
+        if cap > 0:
+            cap_eff = cap * edconfig.memory_ratio
+            producer_cluster = {}
+            for c in self.clusters:
+                for n in c.nodes.values():
+                    for v in n.outvars:
+                        if v is not None:
+                            producer_cluster[v.name] = (c, n, v.producer_idx)
+            for live in self.graph.liveness():
+                any_entry = False
+                for v in live:
+                    hit = producer_cluster.get(v.name)
+                    if hit is None:
+                        continue
+                    c, n, out_idx = hit
+                    for s in range(c.strategy_count()):
+                        p = c.strategies[s][n.uid][1].out_placements[out_idx]
+                        if p is None:
+                            continue
+                        rows.append(row); cols.append(y_offset[c.cid] + s)
+                        vals.append(placement_bytes(v.size_bytes(), p,
+                                                    self.axis.size))
+                        any_entry = True
+                if any_entry:
+                    lbs.append(-np.inf); ubs.append(cap_eff)
+                    row += 1
+
+        A = sparse.csr_matrix((vals, (rows, cols)), shape=(row, nvar))
+        integrality = np.zeros(nvar)
+        integrality[:n_y] = 1
+        res = milp(c=cost,
+                   constraints=LinearConstraint(A, np.array(lbs), np.array(ubs)),
+                   integrality=integrality,
+                   bounds=Bounds(0, 1),
+                   options={"time_limit": edconfig.solver_time_limit})
+        if res.status != 0 or res.x is None:
+            raise RuntimeError(f"MILP failed: status={res.status} {res.message}")
+        logger.info("[SpmdSolver] axis=%s clusters=%d edges=%d vars=%d "
+                    "cost=%.3e time=%.2fs", self.axis.name, len(self.clusters),
+                    len(self.edges), nvar, res.fun, time.perf_counter() - start)
+
+        chosen: Dict[str, NodeStrategy] = {}
+        for c in self.clusters:
+            ys = res.x[y_offset[c.cid]:y_offset[c.cid] + c.strategy_count()]
+            s_idx = int(np.argmax(ys))
+            for uid, (_, strat) in c.strategies[s_idx].items():
+                chosen[c.nodes[uid].name] = strat
+        return chosen
+
+    # ----------------------------------------------------------- beam search
+
+    def beam_search(self, width: Optional[int] = None) -> Dict[str, NodeStrategy]:
+        """Greedy beam over clusters in order (reference solver.py:814-890)."""
+        width = width or edconfig.beam_width
+        in_edges: Dict[int, List[_Edge]] = {}
+        for e in self.edges:
+            in_edges.setdefault(e.down_cluster.cid, []).append(e)
+
+        # same comm >> memory hierarchy as the ILP objective
+        all_comm = [c for e in self.edges for c in e.comm.ravel() if c > 0]
+        min_comm = min(all_comm) if all_comm else 1.0
+        max_mem = max((float(e.mem.max()) for e in self.edges), default=0.0)
+        w_mem = (min_comm / (10.0 * max(len(self.edges), 1) * max_mem)
+                 if max_mem > 0 else 0.0)
+        # beam entries: (cost, {cid: strategy_idx})
+        beam: List[Tuple[float, Dict[int, int]]] = [(0.0, {})]
+        for c in self.clusters:
+            grown: List[Tuple[float, Dict[int, int]]] = []
+            out_cost = self.output_y_cost.get(c.cid)
+            for base_cost, assign in beam:
+                for s in range(c.strategy_count()):
+                    delta = 0.0 if out_cost is None else float(out_cost[s])
+                    for e in in_edges.get(c.cid, []):
+                        i = assign.get(e.up_cluster.cid)
+                        if i is not None:
+                            delta += e.comm[i, s] + w_mem * e.mem[i, s]
+                    grown.append((base_cost + delta, {**assign, c.cid: s}))
+            grown.sort(key=lambda t: t[0])
+            beam = grown[:width]
+
+        best_cost, best = beam[0]
+        logger.info("[SpmdSolver.beam] axis=%s cost=%.3e", self.axis.name,
+                    best_cost)
+        chosen: Dict[str, NodeStrategy] = {}
+        for c in self.clusters:
+            for uid, (_, strat) in c.strategies[best[c.cid]].items():
+                chosen[c.nodes[uid].name] = strat
+        return chosen

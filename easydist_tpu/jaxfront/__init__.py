@@ -1,0 +1,9 @@
+"""JAX frontend: jaxpr tracing -> MetaIR -> solver -> GSPMD emission.
+
+Reference: easydist/jax/ — but where the reference supports only a 1xN mesh
+(jax/device_mesh.py:28-29), this frontend solves true ND meshes axis by axis
+and lowers to `NamedSharding` over arbitrary ICI/DCN meshes.
+"""
+
+from .api import easydist_compile  # noqa: F401
+from .mesh import get_device_mesh, set_device_mesh, make_device_mesh  # noqa: F401

@@ -29,23 +29,24 @@ logger = logging.getLogger(__name__)
 
 class MetaOp:
 
-    def __init__(self, fn: Callable, args, nshards: Optional[int] = None,
-                 name: Optional[str] = None):
+    def __init__(self, fn: Callable, args, kwargs=None,
+                 nshards: Optional[int] = None, name: Optional[str] = None):
         self.fn = fn
         self.name = name or getattr(fn, "__name__", repr(fn))
         self.nshards = nshards or edconfig.discovery_nshards
-        self.flat_args, self.args_spec = platform.tree_flatten(args)
+        # args are the op's positional arguments, kwargs its keyword
+        # arguments — kept explicit so a dict-valued positional arg is never
+        # mistaken for keywords
+        self.flat_args, self.args_spec = platform.tree_flatten(
+            (tuple(args), dict(kwargs or {})))
         self.tensor_indices = [i for i, a in enumerate(self.flat_args)
                                if isinstance(a, platform.Tensor)]
 
     # ------------------------------------------------------------- execution
 
     def _call(self, flat_args):
-        args = platform.tree_unflatten(flat_args, self.args_spec)
-        if isinstance(args, tuple) and len(args) == 2 and isinstance(args[1], dict):
-            a, kw = args
-            return self.fn(*a, **kw)
-        return self.fn(*args)
+        args, kwargs = platform.tree_unflatten(flat_args, self.args_spec)
+        return self.fn(*args, **kwargs)
 
     def run_global(self):
         return self._call(list(self.flat_args))

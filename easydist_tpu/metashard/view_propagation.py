@@ -43,7 +43,8 @@ def view_rule(input_shape: List[int], output_shape: List[int], world_size: int =
 
     def emit(in_dim: int, out_dim: int):
         nonlocal group
-        if input_shape[in_dim] >= world_size:
+        if input_shape[in_dim] >= world_size \
+                and input_shape[in_dim] % world_size == 0:
             row[in_dim] = DimSharding(group=group)
             recombines[group] = functools.partial(Recombine.concat, dim=out_dim)
             group += 1

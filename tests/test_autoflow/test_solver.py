@@ -1,0 +1,127 @@
+"""Solver unit tests on hand-built MetaGraphs (no jax tracing needed)."""
+
+import functools
+
+import pytest
+
+from easydist_tpu.autoflow import MeshAxisSpec, SpmdSolver, resharding_cost
+from easydist_tpu.metashard.annotation import DimSharding, ShardSpace
+from easydist_tpu.metashard.combination import Recombine, Reduction
+from easydist_tpu.metashard.metair import (MetaGraph, MetaNode, MetaVar,
+                                           Placement)
+
+
+def concat(dim):
+    return functools.partial(Recombine.concat, dim=dim)
+
+
+def reduce_sum():
+    return functools.partial(Recombine.reduce, op=Reduction.SUM)
+
+
+def placeholder(name, shape, dtype="float32", world=8):
+    from easydist_tpu.metashard import view_rule
+
+    mv = MetaVar(name, shape, dtype)
+    rule = view_rule(list(shape), list(shape), world_size=world)
+    node = MetaNode(name=name, op_key="placeholder", invars=[], outvars=[mv],
+                    space=rule["space"], recombines=rule["recombines"],
+                    is_input=True)
+    return node, mv
+
+
+def matmul_node(name, a, b, out_shape):
+    # space: [[S1, S2], [S2, S3]], recombines 1->concat0, 2->reduce, 3->concat1
+    space = ShardSpace([[DimSharding(1), DimSharding(2)],
+                        [DimSharding(2), DimSharding(3)]])
+    recombines = {1: concat(0), 2: reduce_sum(), 3: concat(1)}
+    out = MetaVar(f"{name}_out", out_shape, "float32")
+    node = MetaNode(name=name, op_key="matmul", invars=[a, b], outvars=[out],
+                    space=space, recombines=recombines)
+    return node, out
+
+
+def build_chain_graph():
+    """x[64,32] @ w1[32,128] @ w2[128,32] — the classic 2-matmul chain where
+    megatron-style column-then-row weight sharding avoids resharding the
+    activations."""
+    g = MetaGraph("chain")
+    nx, vx = placeholder("x", (64, 32))
+    nw1, vw1 = placeholder("w1", (32, 128))
+    nw2, vw2 = placeholder("w2", (128, 32))
+    for n in (nx, nw1, nw2):
+        g.add_input(n)
+    m1, v1 = matmul_node("mm1", vx, vw1, (64, 128))
+    m2, v2 = matmul_node("mm2", v1, vw2, (64, 32))
+    g.add_op(m1)
+    g.add_op(m2)
+    g.outputs.append(v2)
+    return g
+
+
+AXIS = MeshAxisSpec("d", 8)
+
+
+@pytest.mark.parametrize("level", [0, 1])
+def test_chain_solver_zero_comm(level):
+    g = build_chain_graph()
+    g.coarsen(AXIS.size, level=level)
+    chosen = SpmdSolver(g, AXIS).solve()
+    # batch-sharding everything is communication-free: x S(0), weights
+    # replicated, activations S(0)
+    assert chosen["mm1"].in_placements[0] == Placement.shard(0)
+    assert chosen["mm2"].in_placements[0] == Placement.shard(0)
+    assert chosen["x"].out_placements[0] == Placement.shard(0)
+
+
+def test_beam_matches_ilp_on_chain():
+    g1 = build_chain_graph()
+    g1.coarsen(AXIS.size, level=0)
+    ilp = SpmdSolver(g1, AXIS)._ilp_solve()
+    g2 = build_chain_graph()
+    g2.coarsen(AXIS.size, level=0)
+    beam = SpmdSolver(g2, AXIS).beam_search()
+    assert ilp["mm1"].in_placements[0] == beam["mm1"].in_placements[0]
+
+
+def test_exclude_forces_different_strategy():
+    g = build_chain_graph()
+    batch = None
+    g.coarsen(AXIS.size, level=0)
+    chosen1 = SpmdSolver(g, AXIS).solve()
+    batch = chosen1["mm1"]
+
+    g2 = build_chain_graph()
+    g2.coarsen(AXIS.size, level=0,
+               exclude_map=lambda node: [batch] if node.name == "mm1" else [])
+    chosen2 = SpmdSolver(g2, AXIS).solve()
+    assert chosen2["mm1"] != batch
+
+
+def test_resharding_cost_model():
+    axis = MeshAxisSpec("d", 4, bandwidth=1.0)
+    x = 100.0
+    r, s0, s1 = Placement.replicate(), Placement.shard(0), Placement.shard(1)
+    p = Placement.partial()
+    assert resharding_cost(x, r, s0, axis) == 0
+    assert resharding_cost(x, s0, s0, axis) == 0
+    assert resharding_cost(x, s0, r, axis) == pytest.approx(75.0)  # all_gather
+    assert resharding_cost(x, p, r, axis) == pytest.approx(150.0)  # all_reduce
+    assert resharding_cost(x, p, s0, axis) == pytest.approx(75.0)  # reduce_scatter
+    a2a = resharding_cost(x, s0, s1, axis)
+    assert 0 < a2a < resharding_cost(x, s0, r, axis)
+
+
+def test_memory_cap_forces_sharding():
+    import easydist_tpu.config as edconfig
+
+    g = build_chain_graph()
+    g.coarsen(AXIS.size, level=0)
+    # cap below the replicated footprint of the biggest tensors forces shards
+    edconfig.per_device_memory_cap = 40 * 1024
+    try:
+        chosen = SpmdSolver(g, AXIS).solve()
+        assert any(s.out_placements[0].is_shard() for s in chosen.values()
+                   if s.out_placements and s.out_placements[0] is not None)
+    finally:
+        edconfig.per_device_memory_cap = 0

@@ -144,3 +144,38 @@ def test_conv_valid_needs_full_halo_width():
     shard_dims = [d for d in space.table[0] if d.group > 0]
     assert len(shard_dims) == 1 and shard_dims[0].halo.width == 2
     assert 1 in recombines
+
+
+def test_dict_positional_arg():
+    # a dict as a positional arg must not be mistaken for kwargs
+    x = np.random.default_rng(10).normal(size=(4, 4))
+    op = MetaOp(lambda t, opts: t * opts["scale"], (x, {"scale": 2.0}),
+                name="scaled")
+    space, rec = op.discover()
+    assert groups_of(space) == [[1, 2]]
+
+
+def test_kwargs_explicit():
+    x = np.random.default_rng(11).normal(size=(4, 6))
+    op = MetaOp(lambda t, axis: np.cumsum(t, axis=axis), (x,),
+                kwargs={"axis": 1}, name="cumsum")
+    space, rec = op.discover()
+    assert groups_of(space) == [[1, 0]]
+
+
+def test_array_like_aux_output():
+    # aux outputs that are numpy arrays under a different backend Tensor type
+    # must compare without raising
+    from easydist_tpu.metashard.combination import match_recombine
+    x = np.arange(8.0).reshape(4, 2)
+    halves = np.split(x, 2, axis=0)
+    aux = np.array([1, 2, 3])
+    platform.init_backend("jax")  # Tensor = jax.Array; numpy aux is "non-tensor"
+    try:
+        sharded = [(h, aux) for h in halves]
+        import jax.numpy as jnp
+        jh = [jnp.asarray(h) for h in halves]
+        res = match_recombine([(jh[0], aux), (jh[1], aux)], (jnp.asarray(x), aux))
+        assert res is not None
+    finally:
+        platform.init_backend("numpy")

@@ -69,3 +69,11 @@ def test_split_dim_divisibility_gate():
     # with world_size 2 it divides -> shardable
     r = view_rule([12], [6, 2], world_size=2)
     assert groups(r["space"]) == [1]
+
+
+def test_identity_divisibility_gate():
+    # size 6 dims not divisible by world 4 must not shard
+    r = view_rule([6, 8], [6, 8], world_size=4)
+    assert groups(r["space"]) == [0, 1]
+    r = view_rule([6, 2], [12], world_size=4)
+    assert groups(r["space"]) == [0, 0]
