@@ -32,8 +32,8 @@ from scipy import sparse
 from scipy.optimize import Bounds, LinearConstraint, milp
 
 from easydist_tpu import config as edconfig
-from easydist_tpu.metashard.metair import (MetaGraph, MetaNode, NodeStrategy,
-                                           Placement)
+from easydist_tpu.metashard.metair import (MetaGraph, NodeStrategy,
+                                          Placement)
 from .cost_model import MeshAxisSpec, placement_bytes, resharding_cost
 
 logger = logging.getLogger(__name__)
@@ -79,12 +79,6 @@ class SpmdSolver:
         self._build_matrices()
 
     # ------------------------------------------------------------ model build
-
-    def _cluster_of(self, node: MetaNode):
-        return self.clusters[
-            next(i for i, c in enumerate(self.clusters)
-                 if node.uid in c.nodes)] if node.cluster_id < 0 \
-            else next(c for c in self.clusters if c.cid == node.cluster_id)
 
     def _collect_edges(self):
         by_cid = {c.cid: c for c in self.clusters}
@@ -256,7 +250,8 @@ class SpmdSolver:
                    integrality=integrality,
                    bounds=Bounds(0, 1),
                    options={"time_limit": edconfig.solver_time_limit})
-        if res.status != 0 or res.x is None:
+        # status 1 = iteration/time limit: keep the incumbent if HiGHS found one
+        if res.x is None or res.status not in (0, 1):
             raise RuntimeError(f"MILP failed: status={res.status} {res.message}")
         logger.info("[SpmdSolver] axis=%s clusters=%d edges=%d vars=%d "
                     "cost=%.3e time=%.2fs", self.axis.name, len(self.clusters),
@@ -275,9 +270,14 @@ class SpmdSolver:
     def beam_search(self, width: Optional[int] = None) -> Dict[str, NodeStrategy]:
         """Greedy beam over clusters in order (reference solver.py:814-890)."""
         width = width or edconfig.beam_width
+        # an edge's cost is charged when its SECOND endpoint gets assigned, so
+        # edges in either direction (incl. state_io edges, whose producer
+        # cluster comes after the placeholder consumer) are all priced
         in_edges: Dict[int, List[_Edge]] = {}
+        out_edges: Dict[int, List[_Edge]] = {}
         for e in self.edges:
             in_edges.setdefault(e.down_cluster.cid, []).append(e)
+            out_edges.setdefault(e.up_cluster.cid, []).append(e)
 
         # same comm >> memory hierarchy as the ILP objective
         all_comm = [c for e in self.edges for c in e.comm.ravel() if c > 0]
@@ -297,6 +297,10 @@ class SpmdSolver:
                         i = assign.get(e.up_cluster.cid)
                         if i is not None:
                             delta += e.comm[i, s] + w_mem * e.mem[i, s]
+                    for e in out_edges.get(c.cid, []):
+                        j = assign.get(e.down_cluster.cid)
+                        if j is not None and e.down_cluster.cid != c.cid:
+                            delta += e.comm[s, j] + w_mem * e.mem[s, j]
                     grown.append((base_cost + delta, {**assign, c.cid: s}))
             grown.sort(key=lambda t: t[0])
             beam = grown[:width]

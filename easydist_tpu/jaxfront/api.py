@@ -25,7 +25,7 @@ import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
-import jax.numpy as jnp
+
 from jax.extend import core as jex_core
 from jax.sharding import NamedSharding, PartitionSpec
 
@@ -44,36 +44,33 @@ logger = logging.getLogger(__name__)
 def infer_state_io(args, out_shape) -> Dict[int, int]:
     """Pair output leaves with input leaves for train-state threading.
 
-    A top-level output subtree whose treedef and leaf avals exactly match a
-    top-level input subtree is assumed to be that input's updated value
-    (e.g. `(new_params, new_opt, loss) = step(params, opt, batch)`).
+    Pairing is strictly positional over the *leading* outputs and inputs —
+    `(new_params, new_opt, ...) = step(params, opt, ...)` — and stops at the
+    first mismatch.  Positional matching (rather than searching all inputs)
+    avoids spuriously pairing e.g. an inference output with a data input of
+    the same shape, which would wrongly donate the data buffer.
     Returns {flat_output_index: flat_input_index}.
     """
     def leaf_sig(x):
         return (tuple(x.shape), str(x.dtype)) if hasattr(x, "shape") else None
 
-    arg_subtrees = []
-    flat_idx = 0
-    for a in args:
-        leaves, treedef = jax.tree_util.tree_flatten(a)
-        arg_subtrees.append((treedef, [leaf_sig(l) for l in leaves], flat_idx))
-        flat_idx += len(leaves)
-
     outs = out_shape if isinstance(out_shape, tuple) else (out_shape,)
     pairs: Dict[int, int] = {}
-    used = set()
-    out_flat_idx = 0
-    for o in outs:
-        leaves, treedef = jax.tree_util.tree_flatten(o)
-        sig = [leaf_sig(l) for l in leaves]
-        for ai, (atd, asig, abase) in enumerate(arg_subtrees):
-            if ai in used or atd != treedef or asig != sig or not leaves:
-                continue
-            for k in range(len(leaves)):
-                pairs[out_flat_idx + k] = abase + k
-            used.add(ai)
+    in_base = out_base = 0
+    for o, a in zip(outs, args):
+        o_leaves, o_td = jax.tree_util.tree_flatten(o)
+        a_leaves, a_td = jax.tree_util.tree_flatten(a)
+        # only container subtrees qualify as state: a bare-array arg is
+        # almost always data, and pairing it would donate the data buffer
+        # (pass state_io explicitly for single-leaf state)
+        if (not o_leaves or o_td != a_td
+                or jax.tree_util.treedef_is_leaf(a_td)
+                or [leaf_sig(l) for l in o_leaves] != [leaf_sig(l) for l in a_leaves]):
             break
-        out_flat_idx += len(leaves)
+        for k in range(len(o_leaves)):
+            pairs[out_base + k] = in_base + k
+        in_base += len(a_leaves)
+        out_base += len(o_leaves)
     return pairs
 
 
@@ -188,7 +185,10 @@ def compile_step(func, args, kwargs, mesh=None, state_io="auto",
     logger.info("[trace] %d eqns in %.2fs", len(jaxpr.eqns),
                 time.perf_counter() - t0)
 
-    world = max((s.size for s in axis_specs), default=1)
+    # gate shardability on the SMALLEST axis: per-axis pools re-check
+    # divisibility, so a dim only shardable on a small axis must not be
+    # filtered out by a larger one
+    world = min((s.size for s in axis_specs), default=1)
     t0 = time.perf_counter()
     analyzer = ShardingAnalyzer(closed_jaxpr, world_size=world)
     rules, shape_info = analyzer.run()
@@ -311,6 +311,8 @@ class CompiledFunction:
 
     def __call__(self, *args, **kwargs):
         result = self.get_compiled(*args, **kwargs)
+        if self.compile_only:
+            return result
         flat_args, _ = jax.tree_util.tree_flatten((args, kwargs))
         flat_out = result.jitted(*flat_args)
         return jax.tree_util.tree_unflatten(result.out_tree, flat_out)

@@ -111,3 +111,44 @@ def test_recompile_on_new_shapes(mesh_1d):
     np.testing.assert_allclose(np.asarray(compiled(a2, b2)),
                                np.asarray(a2 @ b2), rtol=1e-5)
     assert len(compiled._cache) == 2
+
+
+@pytest.mark.world_8
+def test_stateless_fn_not_donated(mesh_1d):
+    # an inference output matching a data input's shape must NOT pair as
+    # state (would donate the data buffer on TPU)
+    from easydist_tpu.jaxfront.api import infer_state_io
+
+    w = jax.ShapeDtypeStruct((8, 8), jnp.float32)
+    x = jax.ShapeDtypeStruct((32, 8), jnp.float32)
+    out = jax.ShapeDtypeStruct((32, 8), jnp.float32)
+    assert infer_state_io((w, x), out) == {}
+    # but leading positional state still pairs
+    params = (w, w)
+    assert infer_state_io((params, x), (params, out)) == {0: 0, 1: 1}
+
+
+@pytest.mark.world_8
+def test_compile_only_returns_result(mesh_1d):
+    def f(a, b):
+        return a @ b
+
+    compiled = easydist_compile(f, mesh=mesh_1d, compile_only=True)
+    res = compiled(jnp.ones((8, 8)), jnp.ones((8, 8)))
+    assert hasattr(res, "jitted") and hasattr(res, "strategies")
+
+
+@pytest.mark.world_8
+def test_beam_solver_end_to_end(mesh_1d):
+    import easydist_tpu.config as edconfig
+
+    params, x, y = _mlp_init()
+    edconfig.solver_backend = "beam"
+    try:
+        compiled = easydist_compile(_mlp_step, mesh=mesh_1d, donate_state=False)
+        new_params, loss = compiled(params, x, y)
+        ref_params, ref_loss = _mlp_step(params, x, y)
+        np.testing.assert_allclose(float(loss), float(ref_loss),
+                                   rtol=1e-4, atol=1e-6)
+    finally:
+        edconfig.solver_backend = "milp"
