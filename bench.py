@@ -1,0 +1,79 @@
+"""Headline benchmark: GPT-2 train-step tokens/sec/chip on real TPU.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+`vs_baseline` is easydist-auto-sharded throughput over hand-written
+`jax.jit` (XLA-native GSPMD) throughput on the same step/model — the
+BASELINE.json north-star ratio (target >= 0.70).
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def _bench_step(fn, state, tokens, targets, warmup=2, iters=10):
+    """Times a state-threading train step; state is donated, so each call
+    feeds the previous call's output state back in."""
+    for _ in range(warmup):
+        state, loss = fn(state, tokens, targets)
+    jax.block_until_ready(loss)
+    start = time.perf_counter()
+    for _ in range(iters):
+        state, loss = fn(state, tokens, targets)
+    jax.block_until_ready(loss)
+    return (time.perf_counter() - start) / iters
+
+
+def main():
+    from easydist_tpu.jaxfront import easydist_compile, make_device_mesh
+    from easydist_tpu.models import GPTConfig, make_gpt_train_step
+
+    n_chips = len(jax.devices())
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = GPTConfig(vocab=50304, seq=512, dim=768, heads=12, layers=12,
+                        dtype="bfloat16")
+        batch = 8
+    else:  # CPU smoke mode
+        cfg = GPTConfig.tiny()
+        batch = 8
+
+    mesh = make_device_mesh((n_chips,), ("d",))
+    step, init_state = make_gpt_train_step(cfg)
+    state = init_state(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, cfg.seq), 0,
+                                cfg.vocab)
+    targets = jax.random.randint(jax.random.PRNGKey(2), (batch, cfg.seq), 0,
+                                 cfg.vocab)
+
+    # baseline: hand-GSPMD (plain jit, donated state)
+    base = jax.jit(step, donate_argnums=(0,))
+    t_base = _bench_step(base, state, tokens, targets)
+
+    # easydist auto-sharded
+    state2 = init_state(jax.random.PRNGKey(0))
+    compiled = easydist_compile(step, mesh=mesh)
+    t_ed = _bench_step(compiled, state2, tokens, targets)
+
+    tokens_per_step = batch * cfg.seq
+    ed_tps = tokens_per_step / t_ed / n_chips
+    base_tps = tokens_per_step / t_base / n_chips
+
+    print(json.dumps({
+        "metric": "gpt2_train_tokens_per_sec_per_chip",
+        "value": round(ed_tps, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(ed_tps / base_tps, 4),
+    }))
+    print(f"# easydist {ed_tps:.0f} tok/s/chip vs hand-jit {base_tps:.0f} "
+          f"tok/s/chip on {n_chips} {jax.default_backend()} chip(s)",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -133,6 +133,15 @@ class ShardingAnalyzer:
     def _discover_eqn(self, eqn, sig: str, read_concrete) -> dict:
         prim_name = eqn.primitive.name
 
+        # analytic preset rules cover the hot primitives; execution discovery
+        # is the fallback (reference preset short-circuit,
+        # torch/sharding_interpreter.py:336-338)
+        from .presets import preset_rule
+
+        preset = preset_rule(eqn, self.world_size)
+        if preset is not None:
+            return preset
+
         if prim_name in _VIEW_PRIMS:
             in_aval = eqn.invars[0].aval
             out_aval = eqn.outvars[0].aval

@@ -1,0 +1,491 @@
+"""Preset (analytic) SPMD rules for common jax primitives.
+
+Execution-based ShardCombine is the general mechanism, but the hot primitives
+of any transformer/convnet have well-known sharding rules — computing them
+analytically makes compile time independent of tensor sizes.  This is the
+TPU analog of the reference's discovery-bypass rule bank
+(easydist/torch/preset_propagation.py:32-378 and the preset short-circuit in
+sharding_interpreter.py:336-338).  Anything not covered here falls back to
+execution discovery, and tests cross-check these rules against discovery.
+
+A rule receives the eqn and returns {"space": ShardSpace, "recombines":
+{group: partial}} with rows covering the eqn's tensor (non-Literal-scalar)
+inputs in order, or None to decline.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, List, Optional
+
+from jax.extend import core as jex_core
+
+from easydist_tpu.metashard.annotation import DimSharding, ShardSpace
+from easydist_tpu.metashard.combination import Recombine, Reduction
+from easydist_tpu.metashard.view_propagation import view_rule
+
+_RULES: Dict[str, Callable] = {}
+
+
+def register_preset(*prim_names):
+    def deco(fn):
+        for name in prim_names:
+            _RULES[name] = fn
+        return fn
+
+    return deco
+
+
+def preset_rule(eqn, world_size: int) -> Optional[dict]:
+    fn = _RULES.get(eqn.primitive.name)
+    if fn is None:
+        return None
+    try:
+        return fn(eqn, world_size)
+    except Exception:
+        return None
+
+
+def _tensor_avals(eqn) -> List:
+    """Avals of the inputs that occupy discovery rows: every non-Literal var
+    plus array-valued literals (scalar literals take no row, matching
+    MetaOp's jax.Array check)."""
+    avals = []
+    for v in eqn.invars:
+        if isinstance(v, jex_core.Literal):
+            if getattr(v.val, "ndim", None) is not None and v.val.ndim > 0:
+                avals.append(v.aval)
+        else:
+            avals.append(v.aval)
+    return avals
+
+
+def _concat(dim):
+    return functools.partial(Recombine.concat, dim=dim)
+
+
+def _reduce(op=Reduction.SUM):
+    return functools.partial(Recombine.reduce, op=op)
+
+
+# ------------------------------------------------------------- elementwise
+
+_ELEMENTWISE = [
+    "add", "sub", "mul", "div", "pow", "max", "min", "rem", "atan2",
+    "and", "or", "xor", "shift_left", "shift_right_logical",
+    "shift_right_arithmetic", "nextafter",
+    "eq", "ne", "lt", "le", "gt", "ge", "select_n", "clamp", "add_any",
+    "exp", "log", "log1p", "expm1", "tanh", "sin", "cos", "tan", "asin",
+    "acos", "atan", "sinh", "cosh", "asinh", "acosh", "atanh", "logistic",
+    "sqrt", "rsqrt", "cbrt", "neg", "sign", "abs", "floor", "ceil", "round",
+    "is_finite", "not", "erf", "erfc", "erf_inv", "integer_pow", "square",
+    "convert_element_type", "stop_gradient", "copy", "real", "imag",
+    "exp2", "logb", "population_count", "clz",
+]
+
+
+@register_preset(*_ELEMENTWISE)
+def _elementwise_rule(eqn, world_size):
+    avals = _tensor_avals(eqn)
+    if not avals:
+        return None
+    rank = max(a.ndim for a in avals)
+    # jax lax elementwise prims require equal shapes after explicit broadcast;
+    # scalar (rank-0) args ride along replicated
+    for a in avals:
+        if a.ndim not in (0, rank):
+            return None
+    shape = next(a.shape for a in avals if a.ndim == rank)
+    for a in avals:
+        if a.ndim == rank and tuple(a.shape) != tuple(shape):
+            return None
+    out_rank = eqn.outvars[0].aval.ndim
+    if out_rank != rank:
+        return None
+
+    table, recombines = [], {}
+    group = 1
+    dim_groups = {}
+    for d in range(rank):
+        dim_groups[d] = group
+        recombines[group] = _concat(d)
+        group += 1
+    for a in avals:
+        if a.ndim == 0:
+            table.append([])
+        else:
+            table.append([DimSharding(group=dim_groups[d]) for d in range(rank)])
+    return {"space": ShardSpace(table), "recombines": recombines}
+
+
+# -------------------------------------------------------------- dot_general
+
+@register_preset("dot_general")
+def _dot_general_rule(eqn, world_size):
+    avals = _tensor_avals(eqn)
+    if len(avals) != 2:
+        return None
+    lhs, rhs = avals
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs_row = [DimSharding() for _ in range(lhs.ndim)]
+    rhs_row = [DimSharding() for _ in range(rhs.ndim)]
+    recombines = {}
+    group = 1
+
+    # output layout: batch dims, then lhs free dims, then rhs free dims
+    lhs_free = [d for d in range(lhs.ndim) if d not in lc and d not in lb]
+    rhs_free = [d for d in range(rhs.ndim) if d not in rc and d not in rb]
+
+    for i, (ld, rd) in enumerate(zip(lb, rb)):
+        lhs_row[ld] = DimSharding(group=group)
+        rhs_row[rd] = DimSharding(group=group)
+        recombines[group] = _concat(i)
+        group += 1
+    for ld, rd in zip(lc, rc):
+        lhs_row[ld] = DimSharding(group=group)
+        rhs_row[rd] = DimSharding(group=group)
+        recombines[group] = _reduce()
+        group += 1
+    for i, ld in enumerate(lhs_free):
+        lhs_row[ld] = DimSharding(group=group)
+        recombines[group] = _concat(len(lb) + i)
+        group += 1
+    for i, rd in enumerate(rhs_free):
+        rhs_row[rd] = DimSharding(group=group)
+        recombines[group] = _concat(len(lb) + len(lhs_free) + i)
+        group += 1
+    return {"space": ShardSpace([lhs_row, rhs_row]), "recombines": recombines}
+
+
+# ---------------------------------------------------------------- reshape &c
+
+@register_preset("transpose")
+def _transpose_rule(eqn, world_size):
+    (aval,) = _tensor_avals(eqn)
+    perm = eqn.params["permutation"]
+    row = [DimSharding() for _ in range(aval.ndim)]
+    recombines = {}
+    group = 1
+    for out_dim, in_dim in enumerate(perm):
+        row[in_dim] = DimSharding(group=group)
+        recombines[group] = _concat(out_dim)
+        group += 1
+    return {"space": ShardSpace([row]), "recombines": recombines}
+
+
+@register_preset("broadcast_in_dim")
+def _broadcast_rule(eqn, world_size):
+    avals = _tensor_avals(eqn)
+    if not avals:
+        return None  # scalar broadcast: create-op, replicate
+    (aval,) = avals
+    bcast_dims = eqn.params["broadcast_dimensions"]
+    out_shape = eqn.params["shape"]
+    row = [DimSharding() for _ in range(aval.ndim)]
+    recombines = {}
+    group = 1
+    for in_dim, out_dim in enumerate(bcast_dims):
+        # size-1 input dims are stretched, not sharded
+        if aval.shape[in_dim] == out_shape[out_dim]:
+            row[in_dim] = DimSharding(group=group)
+            recombines[group] = _concat(out_dim)
+            group += 1
+    return {"space": ShardSpace([row]), "recombines": recombines}
+
+
+@register_preset("squeeze")
+def _squeeze_rule(eqn, world_size):
+    (aval,) = _tensor_avals(eqn)
+    squeezed = set(eqn.params["dimensions"])
+    row = [DimSharding() for _ in range(aval.ndim)]
+    recombines = {}
+    group = 1
+    out_dim = 0
+    for d in range(aval.ndim):
+        if d in squeezed:
+            continue
+        row[d] = DimSharding(group=group)
+        recombines[group] = _concat(out_dim)
+        group += 1
+        out_dim += 1
+    return {"space": ShardSpace([row]), "recombines": recombines}
+
+
+@register_preset("reshape")
+def _reshape_rule(eqn, world_size):
+    (aval,) = _tensor_avals(eqn)
+    if eqn.params.get("dimensions") is not None:
+        return None
+    rule = view_rule(list(aval.shape), list(eqn.params["new_sizes"]),
+                     world_size=world_size)
+    return {"space": rule["space"], "recombines": rule["recombines"]}
+
+
+# ---------------------------------------------------------------- reductions
+
+_REDUCE_OPS = {
+    "reduce_sum": Reduction.SUM,
+    "reduce_max": Reduction.MAX,
+    "reduce_min": Reduction.MIN,
+}
+
+
+@register_preset("reduce_sum", "reduce_max", "reduce_min")
+def _reduce_rule(eqn, world_size):
+    (aval,) = _tensor_avals(eqn)
+    axes = set(eqn.params["axes"])
+    red = _REDUCE_OPS[eqn.primitive.name]
+    row = [DimSharding() for _ in range(aval.ndim)]
+    recombines = {}
+    group = 1
+    out_dim = 0
+    for d in range(aval.ndim):
+        row[d] = DimSharding(group=group)
+        if d in axes:
+            recombines[group] = _reduce(red)
+        else:
+            recombines[group] = _concat(out_dim)
+            out_dim += 1
+        group += 1
+    return {"space": ShardSpace([row]), "recombines": recombines}
+
+
+@register_preset("argmax", "argmin", "reduce_and", "reduce_or",
+                 "cumsum", "cumlogsumexp", "cumprod", "cummax", "cummin")
+def _scan_reduce_rule(eqn, world_size):
+    """Only non-reduced/non-scanned dims are shardable."""
+    avals = _tensor_avals(eqn)
+    if len(avals) != 1:
+        return None
+    (aval,) = avals
+    if "axes" in eqn.params:
+        special = set(eqn.params["axes"])
+        collapses = True
+    else:
+        special = {eqn.params["axis"]}
+        collapses = False
+    row = [DimSharding() for _ in range(aval.ndim)]
+    recombines = {}
+    group = 1
+    out_dim = 0
+    for d in range(aval.ndim):
+        if d in special:
+            if not collapses:
+                out_dim += 1
+            continue
+        row[d] = DimSharding(group=group)
+        recombines[group] = _concat(out_dim)
+        group += 1
+        out_dim += 1
+    return {"space": ShardSpace([row]), "recombines": recombines}
+
+
+# ------------------------------------------------------------------ slicing
+
+@register_preset("slice")
+def _slice_rule(eqn, world_size):
+    (aval,) = _tensor_avals(eqn)
+    starts = eqn.params["start_indices"]
+    limits = eqn.params["limit_indices"]
+    strides = eqn.params["strides"] or [1] * aval.ndim
+    row = [DimSharding() for _ in range(aval.ndim)]
+    recombines = {}
+    group = 1
+    for d in range(aval.ndim):
+        # only dims taken whole can shard
+        if starts[d] == 0 and limits[d] == aval.shape[d] and strides[d] == 1:
+            row[d] = DimSharding(group=group)
+            recombines[group] = _concat(d)
+            group += 1
+    return {"space": ShardSpace([row]), "recombines": recombines}
+
+
+@register_preset("pad")
+def _pad_rule(eqn, world_size):
+    avals = _tensor_avals(eqn)
+    aval = avals[0]
+    config = eqn.params["padding_config"]
+    row = [DimSharding() for _ in range(aval.ndim)]
+    table = [row] + [[] for _ in avals[1:]]  # padding value is scalar
+    recombines = {}
+    group = 1
+    for d, (lo, hi, interior) in enumerate(config):
+        if lo == 0 and hi == 0 and interior == 0:
+            row[d] = DimSharding(group=group)
+            recombines[group] = _concat(d)
+            group += 1
+    return {"space": ShardSpace(table), "recombines": recombines}
+
+
+@register_preset("concatenate")
+def _concatenate_rule(eqn, world_size):
+    avals = _tensor_avals(eqn)
+    cat_dim = eqn.params["dimension"]
+    rank = avals[0].ndim
+    table = [[DimSharding() for _ in range(rank)] for _ in avals]
+    recombines = {}
+    group = 1
+    for d in range(rank):
+        if d == cat_dim:
+            continue
+        for row in table:
+            row[d] = DimSharding(group=group)
+        recombines[group] = _concat(d)
+        group += 1
+    return {"space": ShardSpace(table), "recombines": recombines}
+
+
+@register_preset("rev")
+def _rev_rule(eqn, world_size):
+    (aval,) = _tensor_avals(eqn)
+    flipped = set(eqn.params["dimensions"])
+    row = [DimSharding() for _ in range(aval.ndim)]
+    recombines = {}
+    group = 1
+    for d in range(aval.ndim):
+        if d not in flipped:
+            row[d] = DimSharding(group=group)
+            recombines[group] = _concat(d)
+            group += 1
+    return {"space": ShardSpace([row]), "recombines": recombines}
+
+
+# -------------------------------------------------------------- convolution
+
+@register_preset("conv_general_dilated")
+def _conv_rule(eqn, world_size):
+    """Batch and feature-dim rules only; spatial sharding (halo exchange) is
+    left to execution discovery or the solver never picks it.  Layouts read
+    from dimension_numbers; grouped conv limited to feature_group_count=1
+    for the channel rules."""
+    avals = _tensor_avals(eqn)
+    if len(avals) != 2:
+        return None
+    lhs, rhs = avals
+    dn = eqn.params["dimension_numbers"]
+    lhs_spec, rhs_spec, out_spec = dn
+    groups_feat = eqn.params.get("feature_group_count", 1)
+    batch_count = eqn.params.get("batch_group_count", 1)
+    if batch_count != 1:
+        return None
+
+    lhs_row = [DimSharding() for _ in range(lhs.ndim)]
+    rhs_row = [DimSharding() for _ in range(rhs.ndim)]
+    recombines = {}
+    group = 1
+    # batch: lhs batch dim -> out batch dim
+    lhs_row[lhs_spec[0]] = DimSharding(group=group)
+    recombines[group] = _concat(out_spec[0])
+    group += 1
+    if groups_feat == 1:
+        # output channels: rhs out-feature dim -> out feature dim
+        rhs_row[rhs_spec[0]] = DimSharding(group=group)
+        recombines[group] = _concat(out_spec[1])
+        group += 1
+        # input channels: contraction -> partial
+        lhs_row[lhs_spec[1]] = DimSharding(group=group)
+        rhs_row[rhs_spec[1]] = DimSharding(group=group)
+        recombines[group] = _reduce()
+        group += 1
+    return {"space": ShardSpace([lhs_row, rhs_row]), "recombines": recombines}
+
+
+# ------------------------------------------------------- gather / scatter
+
+def _trailing_offset_dims(offset_dims, out_rank):
+    return tuple(offset_dims) == tuple(range(out_rank - len(offset_dims),
+                                             out_rank))
+
+
+@register_preset("gather")
+def _gather_rule(eqn, world_size):
+    """Embedding-style gather: operand [V, D...], int indices [..., 1] with
+    collapsed_slice_dims=(0,), start_index_map=(0,).  Index batch dims shard
+    to the matching output dims; operand feature dims shard to the trailing
+    output dims (GSPMD handles the static slice_sizes — the eager discovery
+    harness cannot, which is why this rule is analytic-only)."""
+    avals = _tensor_avals(eqn)
+    if len(avals) != 2:
+        return None
+    operand, indices = avals
+    dn = eqn.params["dimension_numbers"]
+    if (tuple(dn.collapsed_slice_dims) != (0,)
+            or tuple(dn.start_index_map) != (0,)
+            or dn.operand_batching_dims or dn.start_indices_batching_dims):
+        return None
+    slice_sizes = eqn.params["slice_sizes"]
+    if slice_sizes[0] != 1 or tuple(slice_sizes[1:]) != tuple(operand.shape[1:]):
+        return None
+    out_rank = eqn.outvars[0].aval.ndim
+    if not _trailing_offset_dims(dn.offset_dims, out_rank):
+        return None
+
+    op_row = [DimSharding() for _ in range(operand.ndim)]
+    idx_row = [DimSharding() for _ in range(indices.ndim)]
+    recombines = {}
+    group = 1
+    n_batch = indices.ndim - 1  # last indices dim is the index vector (size 1)
+    for d in range(n_batch):
+        idx_row[d] = DimSharding(group=group)
+        recombines[group] = _concat(d)
+        group += 1
+    for j in range(1, operand.ndim):
+        op_row[j] = DimSharding(group=group)
+        recombines[group] = _concat(n_batch + (j - 1))
+        group += 1
+    return {"space": ShardSpace([op_row, idx_row]), "recombines": recombines}
+
+
+@register_preset("scatter-add")
+def _scatter_add_rule(eqn, world_size):
+    """Embedding-gradient scatter-add: operand [V, D...], indices [..., 1],
+    updates [batch..., D...].  Feature dims shard through; sharding update
+    batch dims makes the output PARTIAL(SUM) — scatter-add over index subsets
+    sums to the full result."""
+    avals = _tensor_avals(eqn)
+    if len(avals) != 3:
+        return None
+    operand, indices, updates = avals
+    dn = eqn.params["dimension_numbers"]
+    if (tuple(dn.inserted_window_dims) != (0,)
+            or tuple(dn.scatter_dims_to_operand_dims) != (0,)
+            or dn.operand_batching_dims or dn.scatter_indices_batching_dims):
+        return None
+    n_batch = indices.ndim - 1
+    if not _trailing_offset_dims(dn.update_window_dims, updates.ndim):
+        return None
+
+    op_row = [DimSharding() for _ in range(operand.ndim)]
+    idx_row = [DimSharding() for _ in range(indices.ndim)]
+    upd_row = [DimSharding() for _ in range(updates.ndim)]
+    recombines = {}
+    group = 1
+    for d in range(n_batch):
+        idx_row[d] = DimSharding(group=group)
+        upd_row[d] = DimSharding(group=group)
+        recombines[group] = _reduce()
+        group += 1
+    for j in range(1, operand.ndim):
+        op_row[j] = DimSharding(group=group)
+        upd_row[n_batch + (j - 1)] = DimSharding(group=group)
+        recombines[group] = _concat(j)
+        group += 1
+    return {"space": ShardSpace([op_row, idx_row, upd_row]),
+            "recombines": recombines}
+
+
+@register_preset("split")
+def _split_rule(eqn, world_size):
+    (aval,) = _tensor_avals(eqn)
+    axis = eqn.params["axis"]
+    n_out = len(eqn.outvars)
+    row = [DimSharding() for _ in range(aval.ndim)]
+    recombines = {}
+    group = 1
+    for d in range(aval.ndim):
+        if d == axis:
+            continue
+        row[d] = DimSharding(group=group)
+        recombines[group] = [_concat(d)] * n_out
+        group += 1
+    return {"space": ShardSpace([row]), "recombines": recombines}

@@ -1,0 +1,12 @@
+"""Model zoo used by tests, examples, and benchmarks.
+
+Pure-functional jax models (init/apply pairs) mirroring the reference's
+benchmark model set (benchmark/torch/model/: GPT, wide-ResNet, GAT;
+benchmark/bench_case.py:5-25 for the headline configs).  Written TPU-first:
+bfloat16-friendly, static shapes, no data-dependent control flow.
+"""
+
+from .mlp import mlp_init, mlp_apply, make_mlp_train_step  # noqa: F401
+from .gpt import GPTConfig, gpt_init, gpt_apply, make_gpt_train_step  # noqa: F401
+from .resnet import resnet_init, resnet_apply, make_resnet_train_step  # noqa: F401
+from .optim import adam_init, adam_update, sgd_update  # noqa: F401

@@ -1,0 +1,138 @@
+"""GPT-2-style decoder transformer, pure jax (reference headline model:
+benchmark/torch/model/gpt.py; config GPT bs4 seq1024 d12288 h48 in
+benchmark/bench_case.py:5-14).
+
+TPU-first choices: bf16-ready matmuls on the MXU, static causal mask via
+lax.select on an iota comparison (no data-dependent control flow), shapes
+kept multiples of 128 at real sizes."""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from .optim import adam_init, adam_update
+
+
+@dataclass
+class GPTConfig:
+    vocab: int = 50257
+    seq: int = 1024
+    dim: int = 768
+    heads: int = 12
+    layers: int = 12
+    dtype: str = "float32"  # compute dtype; params stay float32
+
+    @staticmethod
+    def small(**kw):
+        return GPTConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab=128, seq=32, dim=32, heads=4, layers=2)
+        base.update(kw)
+        return GPTConfig(**base)
+
+
+def _init_linear(key, n_in, n_out, scale=None):
+    scale = scale if scale is not None else 1.0 / math.sqrt(n_in)
+    wk, _ = jax.random.split(key)
+    return {"w": jax.random.normal(wk, (n_in, n_out)) * scale,
+            "b": jnp.zeros((n_out,))}
+
+
+def gpt_init(cfg: GPTConfig, key) -> Dict:
+    keys = jax.random.split(key, 2 + cfg.layers)
+    params = {
+        "wte": jax.random.normal(keys[0], (cfg.vocab, cfg.dim)) * 0.02,
+        "wpe": jax.random.normal(keys[1], (cfg.seq, cfg.dim)) * 0.01,
+        "blocks": [],
+        "ln_f": {"g": jnp.ones((cfg.dim,)), "b": jnp.zeros((cfg.dim,))},
+    }
+    proj_scale = 1.0 / math.sqrt(cfg.dim) / math.sqrt(2.0 * cfg.layers)
+    for i in range(cfg.layers):
+        bk = jax.random.split(keys[2 + i], 4)
+        params["blocks"].append({
+            "ln1": {"g": jnp.ones((cfg.dim,)), "b": jnp.zeros((cfg.dim,))},
+            "attn": {
+                "qkv": _init_linear(bk[0], cfg.dim, 3 * cfg.dim),
+                "proj": _init_linear(bk[1], cfg.dim, cfg.dim, proj_scale),
+            },
+            "ln2": {"g": jnp.ones((cfg.dim,)), "b": jnp.zeros((cfg.dim,))},
+            "mlp": {
+                "fc": _init_linear(bk[2], cfg.dim, 4 * cfg.dim),
+                "proj": _init_linear(bk[3], 4 * cfg.dim, cfg.dim, proj_scale),
+            },
+        })
+    return params
+
+
+def _layernorm(x, g, b, eps=1e-5):
+    mu = x.mean(axis=-1, keepdims=True)
+    var = x.var(axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+
+def _attention(x, p, heads, dtype):
+    b, t, d = x.shape
+    hd = d // heads
+    qkv = x @ p["qkv"]["w"].astype(dtype) + p["qkv"]["b"].astype(dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def split_heads(t_):
+        return t_.reshape(b, t, heads, hd).transpose(0, 2, 1, 3)
+
+    q, k, v = split_heads(q), split_heads(k), split_heads(v)
+    att = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
+    qi = jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
+    ki = jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
+    att = jnp.where(ki <= qi, att, jnp.array(-1e9, dtype=att.dtype))
+    att = jax.nn.softmax(att, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", att, v)
+    out = out.transpose(0, 2, 1, 3).reshape(b, t, d)
+    return out @ p["proj"]["w"].astype(dtype) + p["proj"]["b"].astype(dtype)
+
+
+def gpt_apply(params, cfg: GPTConfig, tokens):
+    """tokens: int32 [batch, seq] -> logits [batch, seq, vocab]."""
+    dtype = jnp.dtype(cfg.dtype)
+    x = params["wte"][tokens].astype(dtype) + params["wpe"].astype(dtype)[None, :tokens.shape[1]]
+    for blk in params["blocks"]:
+        x = x + _attention(
+            _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"]).astype(dtype),
+            blk["attn"], cfg.heads, dtype)
+        h = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"]).astype(dtype)
+        h = jax.nn.gelu(h @ blk["mlp"]["fc"]["w"].astype(dtype)
+                        + blk["mlp"]["fc"]["b"].astype(dtype))
+        x = x + (h @ blk["mlp"]["proj"]["w"].astype(dtype)
+                 + blk["mlp"]["proj"]["b"].astype(dtype))
+    x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
+    return x.astype(jnp.float32) @ params["wte"].T
+
+
+def gpt_loss(params, cfg: GPTConfig, tokens, targets):
+    logits = gpt_apply(params, cfg, tokens)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return nll.mean()
+
+
+def make_gpt_train_step(cfg: GPTConfig, lr=1e-4):
+    """Returns (train_step, init_state): state = (params, opt_state);
+    step(state, tokens, targets) -> (new_state, loss)."""
+
+    def init_state(key):
+        params = gpt_init(cfg, key)
+        return (params, adam_init(params))
+
+    def train_step(state, tokens, targets):
+        params, opt = state
+        loss, grads = jax.value_and_grad(gpt_loss)(params, cfg, tokens, targets)
+        new_params, new_opt = adam_update(params, grads, opt, lr=lr)
+        return (new_params, new_opt), loss
+
+    return train_step, init_state

@@ -1,0 +1,35 @@
+"""Plain MLP — the minimal end-to-end model (reference examples use an
+equivalent toy net for the jax example: examples/jax/simple_function.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .optim import sgd_update
+
+
+def mlp_init(key, sizes=(16, 64, 64, 8)):
+    params = []
+    keys = jax.random.split(key, len(sizes) - 1)
+    for k, n_in, n_out in zip(keys, sizes[:-1], sizes[1:]):
+        params.append({"w": jax.random.normal(k, (n_in, n_out)) / jnp.sqrt(n_in),
+                       "b": jnp.zeros((n_out,))})
+    return params
+
+
+def mlp_apply(params, x):
+    for layer in params[:-1]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    return x @ params[-1]["w"] + params[-1]["b"]
+
+
+def make_mlp_train_step(lr=1e-2):
+    def train_step(params, x, y):
+        def loss_fn(p):
+            return jnp.mean((mlp_apply(p, x) - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        return sgd_update(params, grads, lr=lr), loss
+
+    return train_step

@@ -1,0 +1,36 @@
+"""Minimal pure-jax optimizers for traced train steps.
+
+Hand-written (rather than optax) so the optimizer update is plain jaxpr
+arithmetic the discovery engine shards like any other op — the analog of the
+reference tracing `optimizer.step()` into the same fx graph
+(torch/compile.py:52-83)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def adam_init(params):
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    return {"mu": zeros,
+            "nu": jax.tree_util.tree_map(jnp.zeros_like, params),
+            "count": jnp.zeros((), jnp.int32)}
+
+
+def adam_update(params, grads, state, lr=1e-3, b1=0.9, b2=0.999, eps=1e-8):
+    count = state["count"] + 1
+    mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g,
+                                state["mu"], grads)
+    nu = jax.tree_util.tree_map(lambda v, g: b2 * v + (1 - b2) * g * g,
+                                state["nu"], grads)
+    c1 = 1 - b1 ** count.astype(jnp.float32)
+    c2 = 1 - b2 ** count.astype(jnp.float32)
+    new_params = jax.tree_util.tree_map(
+        lambda p, m, v: p - lr * (m / c1) / (jnp.sqrt(v / c2) + eps),
+        params, mu, nu)
+    return new_params, {"mu": mu, "nu": nu, "count": count}
+
+
+def sgd_update(params, grads, lr=1e-2):
+    return jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)

@@ -1,0 +1,102 @@
+"""ResNet (18-ish, configurable widths) in pure jax, NHWC layout.
+
+Reference benchmark model: wide-ResNet50 bs128 (benchmark/bench_case.py:16-20).
+Uses GroupNorm instead of BatchNorm: batch-stat sync across shards is exactly
+the cross-replica dependence auto-SPMD should not have to special-case (the
+reference burns a whole DTensor prop-rule section on batch_norm variants,
+spmd_prop_rule.py); GroupNorm is the standard data-parallel-clean choice.
+
+Architecture statics (strides, shortcut flags) live in a separate `arch`
+structure, NOT in the params pytree, so grads/optimizer tree_maps only see
+float leaves."""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .optim import sgd_update
+
+
+def _conv_init(key, kh, kw, c_in, c_out):
+    fan_in = kh * kw * c_in
+    return jax.random.normal(key, (kh, kw, c_in, c_out)) * math.sqrt(2.0 / fan_in)
+
+
+def _conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w, (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _groupnorm(x, g, b, groups=8, eps=1e-5):
+    n, h, w, c = x.shape
+    xg = x.reshape(n, h, w, groups, c // groups)
+    mu = xg.mean(axis=(1, 2, 4), keepdims=True)
+    var = xg.var(axis=(1, 2, 4), keepdims=True)
+    xg = (xg - mu) * jax.lax.rsqrt(var + eps)
+    return xg.reshape(n, h, w, c) * g + b
+
+
+def resnet_init(key, widths=(16, 32, 64), blocks_per_stage=2, classes=10,
+                in_channels=3) -> Tuple[Dict, List]:
+    """Returns (params, arch): params is all-float pytree, arch is static."""
+    keys = iter(jax.random.split(key, 256))
+    params: Dict = {"stem": _conv_init(next(keys), 3, 3, in_channels, widths[0]),
+                    "stages": [], "head": {}}
+    arch: List[List[Dict]] = []
+    c_in = widths[0]
+    for c_out in widths:
+        stage: List[Dict] = []
+        stage_arch: List[Dict] = []
+        for b in range(blocks_per_stage):
+            stride = 2 if (b == 0 and c_out != widths[0]) else 1
+            blk = {
+                "conv1": _conv_init(next(keys), 3, 3, c_in, c_out),
+                "gn1": {"g": jnp.ones((c_out,)), "b": jnp.zeros((c_out,))},
+                "conv2": _conv_init(next(keys), 3, 3, c_out, c_out),
+                "gn2": {"g": jnp.ones((c_out,)), "b": jnp.zeros((c_out,))},
+            }
+            has_short = stride != 1 or c_in != c_out
+            if has_short:
+                blk["short"] = _conv_init(next(keys), 1, 1, c_in, c_out)
+            stage.append(blk)
+            stage_arch.append({"stride": stride, "has_short": has_short})
+            c_in = c_out
+        params["stages"].append(stage)
+        arch.append(stage_arch)
+    params["head"] = {"w": jax.random.normal(next(keys), (c_in, classes))
+                      / math.sqrt(c_in),
+                      "b": jnp.zeros((classes,))}
+    return params, arch
+
+
+def resnet_apply(params, arch, x):
+    x = _conv(x, params["stem"])
+    for stage, stage_arch in zip(params["stages"], arch):
+        for blk, meta in zip(stage, stage_arch):
+            h = _conv(x, blk["conv1"], stride=meta["stride"])
+            h = jax.nn.relu(_groupnorm(h, blk["gn1"]["g"], blk["gn1"]["b"]))
+            h = _conv(h, blk["conv2"])
+            h = _groupnorm(h, blk["gn2"]["g"], blk["gn2"]["b"])
+            sc = x if not meta["has_short"] else _conv(x, blk["short"],
+                                                      stride=meta["stride"])
+            x = jax.nn.relu(h + sc)
+    x = x.mean(axis=(1, 2))
+    return x @ params["head"]["w"] + params["head"]["b"]
+
+
+def make_resnet_train_step(arch, lr=1e-2):
+    def train_step(params, x, labels):
+        def loss_fn(p):
+            logits = resnet_apply(p, arch, x)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        return sgd_update(params, grads, lr=lr), loss
+
+    return train_step

@@ -1,0 +1,160 @@
+"""Cross-validate analytic preset rules against execution-based discovery.
+
+For each sample op we trace one eqn, compute the preset rule, and run real
+ShardCombine discovery on the same eqn — the preset's strategy set must be a
+superset-up-to-renumbering of what execution finds (discovery may miss
+strategies whose dims are too small, never the other way around).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.extend import core as jex_core
+
+from easydist_tpu.jaxfront.presets import preset_rule
+from easydist_tpu.metashard import MetaOp
+
+
+def get_eqn(fn, *args, prim=None):
+    jaxpr = jax.make_jaxpr(fn)(*args).jaxpr
+    if prim is None:
+        assert len(jaxpr.eqns) == 1, jaxpr
+        return jaxpr.eqns[0]
+    return next(e for e in jaxpr.eqns if e.primitive.name == prim)
+
+
+def strategy_set(space, recombines):
+    """Canonical set of (in_dims_tuple, out_kind) per group."""
+    out = set()
+    for g, fn in recombines.items():
+        dims = tuple(next((i for i, d in enumerate(row) if d.group == g), None)
+                     for row in space.table)
+        fns = fn if isinstance(fn, (list, tuple)) else [fn]
+        kinds = tuple(
+            (f.func.__name__, f.keywords.get("dim"),
+             f.keywords.get("op").value if "op" in f.keywords else None)
+            for f in fns)
+        out.add((dims, kinds))
+    return out
+
+
+def discover_eqn(eqn):
+    subfuns, bind_params = eqn.primitive.get_bind_params(eqn.params)
+    key = jax.random.PRNGKey(0)
+    invals = []
+    for v in eqn.invars:
+        if isinstance(v, jex_core.Literal):
+            invals.append(v.val)
+        else:
+            key, sub = jax.random.split(key)
+            if v.aval.dtype.name.startswith("float"):
+                invals.append(jax.random.normal(sub, v.aval.shape, v.aval.dtype))
+            elif v.aval.dtype.name == "bool":
+                invals.append(jax.random.bernoulli(sub, 0.5, v.aval.shape))
+            else:
+                invals.append(jax.random.randint(sub, v.aval.shape, 1, 8,
+                                                 v.aval.dtype))
+
+    def bind_fn(*tensors, **params):
+        with jax.disable_jit():
+            return eqn.primitive.bind(*subfuns, *tensors, **params)
+
+    op = MetaOp(bind_fn, tuple(invals), kwargs=bind_params, name=eqn.primitive.name)
+    return op.discover()
+
+
+CASES = [
+    ("add", lambda: get_eqn(jnp.add, jnp.ones((4, 6)), jnp.ones((4, 6)))),
+    ("tanh", lambda: get_eqn(jnp.tanh, jnp.ones((4, 6)))),
+    ("matmul", lambda: get_eqn(jnp.matmul, jnp.ones((4, 6)), jnp.ones((6, 8)),
+                               prim="dot_general")),
+    ("batched_matmul", lambda: get_eqn(jnp.matmul, jnp.ones((2, 4, 6)),
+                                       jnp.ones((2, 6, 8)), prim="dot_general")),
+    ("transpose", lambda: get_eqn(lambda x: jnp.transpose(x, (1, 0)),
+                                  jnp.ones((4, 6)))),
+    ("reduce_sum", lambda: get_eqn(lambda x: jnp.sum(x, axis=1),
+                                   jnp.ones((4, 6)), prim="reduce_sum")),
+    ("reduce_max", lambda: get_eqn(lambda x: jnp.max(x, axis=0),
+                                   jnp.ones((4, 6)), prim="reduce_max")),
+    ("concatenate", lambda: get_eqn(lambda a, b: jnp.concatenate([a, b], axis=1),
+                                    jnp.ones((4, 2)), jnp.ones((4, 6)))),
+    ("slice_full", lambda: get_eqn(lambda x: x[:, 1:5], jnp.ones((4, 8)),
+                                   prim="slice")),
+]
+
+
+@pytest.mark.parametrize("name,make_eqn", CASES, ids=[c[0] for c in CASES])
+def test_preset_matches_discovery(name, make_eqn):
+    eqn = make_eqn()
+    preset = preset_rule(eqn, world_size=2)
+    assert preset is not None, f"no preset for {eqn.primitive.name}"
+    discovered_space, discovered_rec = discover_eqn(eqn)
+
+    preset_set = strategy_set(preset["space"], preset["recombines"])
+    discovered_set = strategy_set(discovered_space, discovered_rec)
+    missing = discovered_set - preset_set
+    assert not missing, (f"{name}: execution discovery found strategies the "
+                         f"preset lacks: {missing}\npreset={preset_set}")
+
+
+def test_broadcast_in_dim_rule():
+    eqn = get_eqn(lambda x: jnp.broadcast_to(x[None], (3, 4, 6)),
+                  jnp.ones((4, 6)), prim="broadcast_in_dim")
+    rule = preset_rule(eqn, world_size=2)
+    s = strategy_set(rule["space"], rule["recombines"])
+    # input dims (4, 6) map to output dims 1, 2
+    assert ((0,), (("concat", 1, None),)) in s
+    assert ((1,), (("concat", 2, None),)) in s
+
+
+def test_conv_rule_batch_and_channels():
+    eqn = get_eqn(
+        lambda x, k: jax.lax.conv_general_dilated(
+            x, k, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")),
+        jnp.ones((8, 16, 16, 3)), jnp.ones((3, 3, 3, 32)),
+        prim="conv_general_dilated")
+    rule = preset_rule(eqn, world_size=2)
+    s = strategy_set(rule["space"], rule["recombines"])
+    assert ((0, None), (("concat", 0, None),)) in s  # batch
+    assert ((None, 3), (("concat", 3, None),)) in s  # out channels
+    assert ((3, 2), (("reduce", None, "sum"),)) in s  # in channels partial
+
+
+def test_gather_embedding_rule():
+    emb = jnp.ones((128, 32))
+    tok = jnp.zeros((8, 16), jnp.int32)
+    eqn = get_eqn(lambda e, t: e[t], emb, tok, prim="gather")
+    rule = preset_rule(eqn, world_size=2)
+    assert rule is not None
+    s = strategy_set(rule["space"], rule["recombines"])
+    # indices batch dims -> out dims 0,1; feature dim -> out dim 2
+    assert ((None, 0), (("concat", 0, None),)) in s
+    assert ((None, 1), (("concat", 1, None),)) in s
+    assert ((1, None), (("concat", 2, None),)) in s
+    # cross-check executable strategies against discovery
+    d_space, d_rec = discover_eqn(eqn)
+    assert strategy_set(d_space, d_rec) <= s
+
+
+def test_scatter_add_rule():
+    emb = jnp.ones((128, 32))
+    tok = jnp.zeros((8, 16), jnp.int32)
+
+    def emb_grad(e, t):
+        return jax.grad(lambda ee: ee[t].sum())(e)
+
+    eqn = get_eqn(emb_grad, emb, tok, prim="scatter-add")
+    rule = preset_rule(eqn, world_size=2)
+    assert rule is not None
+    s = strategy_set(rule["space"], rule["recombines"])
+    kinds = {k for _, k in s}
+    assert (("reduce", None, "sum"),) in kinds  # batch shard -> partial
+
+
+def test_split_rule():
+    eqn = get_eqn(lambda x: jnp.split(x, 2, axis=1)[0], jnp.ones((4, 8)),
+                  prim="split")
+    rule = preset_rule(eqn, world_size=2)
+    assert rule is not None
+    s = strategy_set(rule["space"], rule["recombines"])
+    assert ((0,), (("concat", 0, None), ("concat", 0, None))) in s
