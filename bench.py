@@ -9,11 +9,14 @@ BASELINE.json north-star ratio (target >= 0.70).
 """
 
 import json
+import logging
 import sys
 import time
 
 import jax
 import jax.numpy as jnp
+
+logging.basicConfig(level=logging.INFO, stream=sys.stderr)
 
 
 def _bench_step(fn, state, tokens, targets, warmup=2, iters=10):

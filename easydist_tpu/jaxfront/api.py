@@ -181,6 +181,9 @@ def compile_step(func, args, kwargs, mesh=None, state_io="auto",
     t0 = time.perf_counter()
     closed_jaxpr, out_shape = jax.make_jaxpr(func, return_shape=True)(
         *args, **kwargs)
+    from .inline import inline_calls
+
+    closed_jaxpr = inline_calls(closed_jaxpr)
     jaxpr = closed_jaxpr.jaxpr
     logger.info("[trace] %d eqns in %.2fs", len(jaxpr.eqns),
                 time.perf_counter() - t0)
@@ -219,6 +222,11 @@ def compile_step(func, args, kwargs, mesh=None, state_io="auto",
     graph = None
     for axis_idx in order:
         axis = axis_specs[axis_idx]
+        if axis.size == 1:
+            # single-device axis: every placement is equivalent, skip solving
+            per_axis[axis_idx] = {}
+            prev_chosen.append({})
+            continue
         t0 = time.perf_counter()
         graph = jaxpr_to_metagraph(closed_jaxpr, rules, shape_info,
                                    world_size=world, names=names,
@@ -295,25 +303,33 @@ class CompiledFunction:
         self._cache: Dict[str, CompileResult] = {}
         functools.update_wrapper(self, func)
 
-    def _signature(self, args, kwargs) -> str:
-        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
-        sig = [f"{getattr(l, 'dtype', type(l).__name__)}"
-               f"{list(getattr(l, 'shape', ()))}" for l in leaves]
-        return f"{treedef}|{sig}"
+    @staticmethod
+    def _signature(flat_args, treedef):
+        # hashable tuple, not a formatted string — this runs on every call
+        return (treedef,
+                tuple((getattr(l, "shape", ()),
+                       str(getattr(l, "dtype", type(l).__name__)))
+                      for l in flat_args))
 
     def get_compiled(self, *args, **kwargs) -> CompileResult:
-        sig = self._signature(args, kwargs)
-        if sig not in self._cache:
-            self._cache[sig] = compile_step(
+        flat_args, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        return self._lookup(flat_args, treedef, args, kwargs)
+
+    def _lookup(self, flat_args, treedef, args, kwargs) -> CompileResult:
+        sig = self._signature(flat_args, treedef)
+        result = self._cache.get(sig)
+        if result is None:
+            result = compile_step(
                 self.func, args, kwargs, mesh=self.mesh,
                 state_io=self.state_io, donate_state=self.donate_state)
-        return self._cache[sig]
+            self._cache[sig] = result
+        return result
 
     def __call__(self, *args, **kwargs):
-        result = self.get_compiled(*args, **kwargs)
+        flat_args, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        result = self._lookup(flat_args, treedef, args, kwargs)
         if self.compile_only:
             return result
-        flat_args, _ = jax.tree_util.tree_flatten((args, kwargs))
         flat_out = result.jitted(*flat_args)
         return jax.tree_util.tree_unflatten(result.out_tree, flat_out)
 

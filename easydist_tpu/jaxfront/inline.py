@@ -1,0 +1,69 @@
+"""Flatten nested `jit` (pjit) call equations into the outer jaxpr.
+
+jax.nn helpers (log_softmax, gelu, take_along_axis, ...) trace as nested
+pjit equations whose inner ops would otherwise be opaque to the preset rule
+bank — execution discovery would eagerly run whole subgraphs at full shape
+on the host.  Inlining is done by re-tracing an evaluator that recursively
+evaluates pjit bodies, letting jax handle all variable bookkeeping.
+
+`remat`/`checkpoint` equations are deliberately NOT inlined: their body must
+stay demarcated so XLA preserves rematerialization.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.extend import core as jex_core
+
+_INLINE_PRIMS = ("jit", "pjit", "custom_jvp_call", "custom_vjp_call",
+                 "custom_vjp_call_jaxpr", "closed_call", "core_call")
+
+
+def _inner_closed_jaxpr(eqn):
+    for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+        inner = eqn.params.get(key)
+        if inner is not None:
+            return inner
+    return None
+
+
+def _eval_inline(jaxpr, consts, *args):
+    env = {}
+
+    def read(v):
+        return v.val if isinstance(v, jex_core.Literal) else env[v]
+
+    for var, val in zip(jaxpr.invars, args):
+        env[var] = val
+    for var, val in zip(jaxpr.constvars, consts):
+        env[var] = val
+
+    for eqn in jaxpr.eqns:
+        invals = [read(v) for v in eqn.invars]
+        inner = (_inner_closed_jaxpr(eqn)
+                 if eqn.primitive.name in _INLINE_PRIMS else None)
+        if inner is not None:
+            out = _eval_inline(inner.jaxpr, inner.consts, *invals)
+        else:
+            subfuns, bind_params = eqn.primitive.get_bind_params(eqn.params)
+            out = eqn.primitive.bind(*subfuns, *invals, **bind_params)
+            if not eqn.primitive.multiple_results:
+                out = [out]
+        for var, val in zip(eqn.outvars, out):
+            env[var] = val
+
+    return [read(v) for v in jaxpr.outvars]
+
+
+def inline_calls(closed_jaxpr):
+    """Return a new ClosedJaxpr with nested call prims flattened."""
+    if not any(e.primitive.name in _INLINE_PRIMS
+               for e in closed_jaxpr.jaxpr.eqns):
+        return closed_jaxpr
+    avals = [jax.ShapeDtypeStruct(v.aval.shape, v.aval.dtype)
+             for v in closed_jaxpr.jaxpr.invars]
+
+    def flat_fn(*args):
+        return _eval_inline(closed_jaxpr.jaxpr, closed_jaxpr.consts, *args)
+
+    return jax.make_jaxpr(flat_fn)(*avals)

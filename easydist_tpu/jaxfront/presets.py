@@ -88,24 +88,22 @@ _ELEMENTWISE = [
 def _elementwise_rule(eqn, world_size):
     avals = _tensor_avals(eqn)
     if not avals:
-        return None
-    rank = max(a.ndim for a in avals)
-    # jax lax elementwise prims require equal shapes after explicit broadcast;
-    # scalar (rank-0) args ride along replicated
+        # all-literal-scalar op: nothing to shard, nothing to execute either
+        return {"space": ShardSpace([]), "recombines": {}}
+    out_aval = eqn.outvars[0].aval
+    rank = out_aval.ndim
+    # inputs are same-rank (possibly with broadcasting size-1 dims) or scalar
     for a in avals:
         if a.ndim not in (0, rank):
             return None
-    shape = next(a.shape for a in avals if a.ndim == rank)
-    for a in avals:
-        if a.ndim == rank and tuple(a.shape) != tuple(shape):
-            return None
-    out_rank = eqn.outvars[0].aval.ndim
-    if out_rank != rank:
-        return None
+        if a.ndim == rank:
+            for d in range(rank):
+                if a.shape[d] not in (1, out_aval.shape[d]):
+                    return None
 
     table, recombines = [], {}
-    group = 1
     dim_groups = {}
+    group = 1
     for d in range(rank):
         dim_groups[d] = group
         recombines[group] = _concat(d)
@@ -114,7 +112,14 @@ def _elementwise_rule(eqn, world_size):
         if a.ndim == 0:
             table.append([])
         else:
-            table.append([DimSharding(group=dim_groups[d]) for d in range(rank)])
+            # size-1 (broadcast) dims ride along replicated in that group
+            table.append([DimSharding(group=dim_groups[d])
+                          if a.shape[d] == out_aval.shape[d] != 1
+                          else DimSharding()
+                          for d in range(rank)])
+    # drop groups where no input actually shards (out dim size 1)
+    live = {d.group for row in table for d in row if d.group > 0}
+    recombines = {g: fn for g, fn in recombines.items() if g in live}
     return {"space": ShardSpace(table), "recombines": recombines}
 
 
@@ -177,7 +182,10 @@ def _transpose_rule(eqn, world_size):
 def _broadcast_rule(eqn, world_size):
     avals = _tensor_avals(eqn)
     if not avals:
-        return None  # scalar broadcast: create-op, replicate
+        # scalar broadcast: a create-op with no shardable inputs; returning
+        # the empty rule (replicate) avoids materializing the (possibly
+        # huge) output in eager discovery
+        return {"space": ShardSpace([]), "recombines": {}}
     (aval,) = avals
     bcast_dims = eqn.params["broadcast_dimensions"]
     out_shape = eqn.params["shape"]
@@ -399,77 +407,112 @@ def _trailing_offset_dims(offset_dims, out_rank):
 
 @register_preset("gather")
 def _gather_rule(eqn, world_size):
-    """Embedding-style gather: operand [V, D...], int indices [..., 1] with
-    collapsed_slice_dims=(0,), start_index_map=(0,).  Index batch dims shard
-    to the matching output dims; operand feature dims shard to the trailing
-    output dims (GSPMD handles the static slice_sizes — the eager discovery
-    harness cannot, which is why this rule is analytic-only)."""
+    """General gather rule (embedding lookup, take_along_axis, batched
+    gathers).  GSPMD handles the static slice_sizes under sharding — the
+    eager discovery harness cannot, which is why this rule is analytic-only.
+
+    Shardable:
+      - indices dims (except the trailing index-vector dim): concat at the
+        matching output dim; batching dims also shard the paired operand dim
+      - operand slice dims taken WHOLE (slice_sizes[j] == shape[j]): concat
+        at the matching offset output dim
+    The gathered (start_index_map / collapsed) operand dims never shard."""
     avals = _tensor_avals(eqn)
     if len(avals) != 2:
         return None
     operand, indices = avals
     dn = eqn.params["dimension_numbers"]
-    if (tuple(dn.collapsed_slice_dims) != (0,)
-            or tuple(dn.start_index_map) != (0,)
-            or dn.operand_batching_dims or dn.start_indices_batching_dims):
-        return None
     slice_sizes = eqn.params["slice_sizes"]
-    if slice_sizes[0] != 1 or tuple(slice_sizes[1:]) != tuple(operand.shape[1:]):
-        return None
     out_rank = eqn.outvars[0].aval.ndim
-    if not _trailing_offset_dims(dn.offset_dims, out_rank):
+
+    offset_dims = tuple(dn.offset_dims)
+    # output dims not in offset_dims correspond, in order, to indices dims
+    # 0..n-2 (the last indices dim is the index vector)
+    batch_out_dims = [d for d in range(out_rank) if d not in offset_dims]
+    n_idx_batch = indices.ndim - 1
+    if len(batch_out_dims) != n_idx_batch:
         return None
+    # operand slice dims (not collapsed, not batching) map in order to
+    # offset_dims
+    slice_dims = [j for j in range(operand.ndim)
+                  if j not in dn.collapsed_slice_dims
+                  and j not in dn.operand_batching_dims]
+    if len(slice_dims) != len(offset_dims):
+        return None
+    idx_batching = list(dn.start_indices_batching_dims)
+    op_batching = list(dn.operand_batching_dims)
 
     op_row = [DimSharding() for _ in range(operand.ndim)]
     idx_row = [DimSharding() for _ in range(indices.ndim)]
     recombines = {}
     group = 1
-    n_batch = indices.ndim - 1  # last indices dim is the index vector (size 1)
-    for d in range(n_batch):
-        idx_row[d] = DimSharding(group=group)
-        recombines[group] = _concat(d)
+    for i in range(n_idx_batch):
+        idx_row[i] = DimSharding(group=group)
+        if i in idx_batching:
+            op_row[op_batching[idx_batching.index(i)]] = DimSharding(group=group)
+        recombines[group] = _concat(batch_out_dims[i])
         group += 1
-    for j in range(1, operand.ndim):
-        op_row[j] = DimSharding(group=group)
-        recombines[group] = _concat(n_batch + (j - 1))
-        group += 1
+    for k, j in enumerate(slice_dims):
+        if slice_sizes[j] == operand.shape[j]:
+            op_row[j] = DimSharding(group=group)
+            recombines[group] = _concat(offset_dims[k])
+            group += 1
     return {"space": ShardSpace([op_row, idx_row]), "recombines": recombines}
 
 
 @register_preset("scatter-add")
 def _scatter_add_rule(eqn, world_size):
-    """Embedding-gradient scatter-add: operand [V, D...], indices [..., 1],
-    updates [batch..., D...].  Feature dims shard through; sharding update
-    batch dims makes the output PARTIAL(SUM) — scatter-add over index subsets
-    sums to the full result."""
+    """General scatter-add rule (embedding gradients, take_along_axis
+    gradients, batched scatters).
+
+    Shardable:
+      - operand window dims (taken whole): shard operand + the matching
+        updates window dim, concat at that output dim
+      - indices dims: batching dims shard indices+updates+operand together
+        (concat); non-batching index dims shard indices+updates and make the
+        output PARTIAL(SUM) — scatter-add over index subsets sums exactly."""
     avals = _tensor_avals(eqn)
     if len(avals) != 3:
         return None
     operand, indices, updates = avals
     dn = eqn.params["dimension_numbers"]
-    if (tuple(dn.inserted_window_dims) != (0,)
-            or tuple(dn.scatter_dims_to_operand_dims) != (0,)
-            or dn.operand_batching_dims or dn.scatter_indices_batching_dims):
+    window_dims = tuple(dn.update_window_dims)
+    # updates dims not in update_window_dims correspond to indices dims 0..n-2
+    upd_batch_dims = [d for d in range(updates.ndim) if d not in window_dims]
+    n_idx_batch = indices.ndim - 1
+    if len(upd_batch_dims) != n_idx_batch:
         return None
-    n_batch = indices.ndim - 1
-    if not _trailing_offset_dims(dn.update_window_dims, updates.ndim):
+    # operand window dims (not inserted, not batching) map in order to
+    # update_window_dims
+    op_window = [j for j in range(operand.ndim)
+                 if j not in dn.inserted_window_dims
+                 and j not in dn.operand_batching_dims]
+    if len(op_window) != len(window_dims):
         return None
+    idx_batching = list(dn.scatter_indices_batching_dims)
+    op_batching = list(dn.operand_batching_dims)
 
     op_row = [DimSharding() for _ in range(operand.ndim)]
     idx_row = [DimSharding() for _ in range(indices.ndim)]
     upd_row = [DimSharding() for _ in range(updates.ndim)]
     recombines = {}
     group = 1
-    for d in range(n_batch):
-        idx_row[d] = DimSharding(group=group)
-        upd_row[d] = DimSharding(group=group)
-        recombines[group] = _reduce()
+    for i in range(n_idx_batch):
+        idx_row[i] = DimSharding(group=group)
+        upd_row[upd_batch_dims[i]] = DimSharding(group=group)
+        if i in idx_batching:
+            j = op_batching[idx_batching.index(i)]
+            op_row[j] = DimSharding(group=group)
+            recombines[group] = _concat(j)
+        else:
+            recombines[group] = _reduce()
         group += 1
-    for j in range(1, operand.ndim):
-        op_row[j] = DimSharding(group=group)
-        upd_row[n_batch + (j - 1)] = DimSharding(group=group)
-        recombines[group] = _concat(j)
-        group += 1
+    for k, j in enumerate(op_window):
+        if updates.shape[window_dims[k]] == operand.shape[j]:
+            op_row[j] = DimSharding(group=group)
+            upd_row[window_dims[k]] = DimSharding(group=group)
+            recombines[group] = _concat(j)
+            group += 1
     return {"space": ShardSpace([op_row, idx_row, upd_row]),
             "recombines": recombines}
 
@@ -489,3 +532,12 @@ def _split_rule(eqn, world_size):
         recombines[group] = [_concat(d)] * n_out
         group += 1
     return {"space": ShardSpace([row]), "recombines": recombines}
+
+
+# ------------------------------------------------------------- create ops
+
+@register_preset("iota")
+def _create_rule(eqn, world_size):
+    """No tensor inputs to shard; output stays replicated (consumers slice
+    for free under GSPMD)."""
+    return {"space": ShardSpace([]), "recombines": {}}

@@ -1,0 +1,12 @@
+"""Manual parallelism building blocks (the TPU-native equivalents of the
+reference's pp/, compile_dp, and the missing-in-reference long-context and
+MoE support — SURVEY.md §2.9 requires SP/CP/EP as first-class here).
+
+Everything is expressed as compiled collective programs (`shard_map` +
+`ppermute`/`all_to_all`/`psum`) inside one XLA program — no eager P2P.
+"""
+
+from .pipeline import spmd_pipeline, PipelineConfig  # noqa: F401
+from .dp import ddp_step, zero_shard_params, zero2_step  # noqa: F401
+from .ring_attention import ring_attention  # noqa: F401
+from .ulysses import ulysses_attention  # noqa: F401

@@ -1,0 +1,143 @@
+"""Manual data-parallel and ZeRO modes (reference: easydist/torch/compile_dp.py).
+
+The auto solver discovers DP on its own; these wrappers are the explicit
+`parallel_mode="ddp"/"zero2"/"zero3"` equivalents (compile_dp.py:55-198),
+expressed as sharding annotations + shard_map collectives instead of graph
+surgery over NCCL ops:
+
+  ddp    — batch sharded, params replicated, grads pmean'd
+  zero2  — + optimizer state sharded over dp: reduce_scatter grads, update
+           the local shard, all_gather updated params
+  zero3  — fully sharded params too: handled by running zero2 with params
+           stored sharded and gathered inside the step (XLA does the
+           gather/free scheduling)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def ddp_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2):
+    """SGD DDP step: batch sharded over `axis`, grads averaged with psum.
+    Returns step(params, batch...) -> (new_params, loss)."""
+
+    def local_step(params, *batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.pmean(g, axis), grads)
+        loss = jax.lax.pmean(loss, axis)
+        new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                            params, grads)
+        return new_params, loss
+
+    def step(params, *batch):
+        p_spec = jax.tree_util.tree_map(lambda _: P(), params)
+        b_spec = tuple(P(axis) for _ in batch)
+        fn = shard_map(local_step, mesh=mesh,
+                       in_specs=(p_spec,) + b_spec,
+                       out_specs=(p_spec, P()),
+                       check_rep=False)
+        return fn(params, *batch)
+
+    return jax.jit(step)
+
+
+def zero_shard_params(params, mesh, axis: str = "dp"):
+    """Shard every param leaf's dim 0 over `axis` when divisible (ZeRO-3
+    placement); indivisible leaves stay replicated."""
+    n = mesh.shape[axis]
+
+    def place(p):
+        if p.ndim > 0 and p.shape[0] % n == 0:
+            return jax.device_put(p, NamedSharding(mesh, P(axis)))
+        return jax.device_put(p, NamedSharding(mesh, P()))
+
+    return jax.tree_util.tree_map(place, params)
+
+
+def zero2_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2,
+               b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8):
+    """Adam ZeRO-2: params replicated, optimizer moments sharded over dp.
+
+    reduce_scatter(grads) -> local Adam shard update -> all_gather(params)
+    (reference transform_fsdp shard_param=False, compile_dp.py:125-183).
+    Leaves whose dim 0 does not divide the axis fall back to replicated
+    moments with pmean'd grads.
+    Returns (step, init_opt): step((params, opt, count), batch...) ->
+    ((new_params, new_opt, count), loss).
+    """
+    n = mesh.shape[axis]
+
+    def shardable(p):
+        return p.ndim > 0 and p.shape[0] % n == 0
+
+    def init_opt(params):
+        def moment(p):
+            if shardable(p):
+                shard_shape = (p.shape[0] // n,) + p.shape[1:]
+                z = jnp.zeros((n,) + shard_shape, p.dtype)
+                return jax.device_put(z, NamedSharding(mesh, P(axis)))
+            return jnp.zeros_like(p)
+
+        return {"mu": jax.tree_util.tree_map(moment, params),
+                "nu": jax.tree_util.tree_map(moment, params)}
+
+    def local_step(params, mu, nu, count, *batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+        loss = jax.lax.pmean(loss, axis)
+        count = count + 1
+        c1 = 1 - b1 ** count.astype(jnp.float32)
+        c2 = 1 - b2 ** count.astype(jnp.float32)
+
+        def update(p, g, m, v):
+            if shardable(p):
+                # grads: [d0, ...] -> reduce_scatter -> [d0/n, ...]
+                g_shard = jax.lax.psum_scatter(g, axis, scatter_dimension=0,
+                                               tiled=True) / n
+                m, v = m[0], v[0]
+                p_shard = jax.lax.dynamic_slice_in_dim(
+                    p, jax.lax.axis_index(axis) * g_shard.shape[0],
+                    g_shard.shape[0], axis=0)
+                m = b1 * m + (1 - b1) * g_shard
+                v = b2 * v + (1 - b2) * g_shard * g_shard
+                p_new = p_shard - lr * (m / c1) / (jnp.sqrt(v / c2) + eps)
+                p_full = jax.lax.all_gather(p_new, axis, axis=0, tiled=True)
+                return p_full, m[None], v[None]
+            g = jax.lax.pmean(g, axis)
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            return p - lr * (m / c1) / (jnp.sqrt(v / c2) + eps), m, v
+
+        flat_p, tdef = jax.tree_util.tree_flatten(params)
+        flat_g = jax.tree_util.tree_flatten(grads)[0]
+        flat_m = jax.tree_util.tree_flatten(mu)[0]
+        flat_v = jax.tree_util.tree_flatten(nu)[0]
+        new = [update(p, g, m, v) for p, g, m, v in
+               zip(flat_p, flat_g, flat_m, flat_v)]
+        new_params = jax.tree_util.tree_unflatten(tdef, [t[0] for t in new])
+        new_mu = jax.tree_util.tree_unflatten(tdef, [t[1] for t in new])
+        new_nu = jax.tree_util.tree_unflatten(tdef, [t[2] for t in new])
+        return new_params, new_mu, new_nu, count, loss
+
+    def step(state, *batch):
+        params, opt, count = state
+        p_spec = jax.tree_util.tree_map(lambda _: P(), params)
+        m_spec = jax.tree_util.tree_map(
+            lambda p: P(axis) if shardable(p) else P(), params)
+        b_spec = tuple(P(axis) for _ in batch)
+        fn = shard_map(local_step, mesh=mesh,
+                       in_specs=(p_spec, m_spec, m_spec, P()) + b_spec,
+                       out_specs=(p_spec, m_spec, m_spec, P(), P()),
+                       check_rep=False)
+        new_params, mu, nu, count, loss = fn(params, opt["mu"], opt["nu"],
+                                             count, *batch)
+        return (new_params, {"mu": mu, "nu": nu}, count), loss
+
+    return jax.jit(step), init_opt

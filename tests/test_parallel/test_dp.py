@@ -1,0 +1,62 @@
+"""Manual DDP / ZeRO-2 vs single-device reference (reference parity:
+easydist/torch/compile_dp.py transform_ddp / transform_fsdp)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from easydist_tpu.jaxfront import make_device_mesh
+from easydist_tpu.models import mlp_init, mlp_apply
+from easydist_tpu.models.optim import adam_init, adam_update
+from easydist_tpu.parallel import ddp_step, zero2_step
+
+
+@pytest.fixture(scope="module")
+def mesh_dp(cpu_devices):
+    return make_device_mesh((8,), ("dp",))
+
+
+def loss_fn(params, x, y):
+    return jnp.mean((mlp_apply(params, x) - y) ** 2)
+
+
+@pytest.mark.world_8
+def test_ddp_matches_single(mesh_dp):
+    params = mlp_init(jax.random.PRNGKey(0), sizes=(16, 32, 8))
+    x = jax.random.normal(jax.random.PRNGKey(1), (64, 16))
+    y = jax.random.normal(jax.random.PRNGKey(2), (64, 8))
+
+    step = ddp_step(loss_fn, mesh_dp, lr=0.1)
+    got_params, got_loss = step(params, x, y)
+
+    ref_loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+    ref_params = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, params, grads)
+    np.testing.assert_allclose(float(got_loss), float(ref_loss), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(got_params),
+                    jax.tree_util.tree_leaves(ref_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.world_8
+def test_zero2_matches_adam(mesh_dp):
+    params = mlp_init(jax.random.PRNGKey(3), sizes=(16, 32, 8))
+    x = jax.random.normal(jax.random.PRNGKey(4), (64, 16))
+    y = jax.random.normal(jax.random.PRNGKey(5), (64, 8))
+
+    step, init_opt = zero2_step(loss_fn, mesh_dp, lr=1e-3)
+    state = (params, init_opt(params), jnp.zeros((), jnp.int32))
+    for _ in range(3):
+        state, loss = step(state, x, y)
+
+    ref_params, ref_opt = params, adam_init(params)
+    for _ in range(3):
+        ref_loss, grads = jax.value_and_grad(loss_fn)(ref_params, x, y)
+        ref_params, ref_opt = adam_update(ref_params, grads, ref_opt, lr=1e-3)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(state[0]),
+                    jax.tree_util.tree_leaves(ref_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)

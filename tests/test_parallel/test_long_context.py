@@ -1,0 +1,73 @@
+"""Ring attention and Ulysses vs full attention (new capability — the
+reference has neither, SURVEY.md §2.9)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from easydist_tpu.jaxfront import make_device_mesh
+from easydist_tpu.parallel import ring_attention, ulysses_attention
+
+
+@pytest.fixture(scope="module")
+def mesh_sp(cpu_devices):
+    return make_device_mesh((4,), ("sp",), devices=cpu_devices[:4])
+
+
+def full_attention(q, k, v, causal):
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        t = q.shape[2]
+        qi = jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
+        ki = jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
+        s = jnp.where(ki <= qi, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def make_qkv(key, b=2, h=4, t=32, d=8):
+    k1, k2, k3 = jax.random.split(key, 3)
+    return (jax.random.normal(k1, (b, h, t, d)),
+            jax.random.normal(k2, (b, h, t, d)),
+            jax.random.normal(k3, (b, h, t, d)))
+
+
+@pytest.mark.world_8
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(mesh_sp, causal):
+    q, k, v = make_qkv(jax.random.PRNGKey(0))
+    got = ring_attention(q, k, v, mesh_sp, axis="sp", causal=causal)
+    want = full_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.world_8
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_full(mesh_sp, causal):
+    q, k, v = make_qkv(jax.random.PRNGKey(1))
+    got = ulysses_attention(q, k, v, mesh_sp, axis="sp", causal=causal)
+    want = full_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.world_8
+def test_ring_attention_grads(mesh_sp):
+    q, k, v = make_qkv(jax.random.PRNGKey(2))
+
+    def loss_ring(q, k, v):
+        return jnp.mean(ring_attention(q, k, v, mesh_sp, causal=True) ** 2)
+
+    def loss_full(q, k, v):
+        return jnp.mean(full_attention(q, k, v, causal=True) ** 2)
+
+    g1 = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)

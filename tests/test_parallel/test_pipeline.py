@@ -1,0 +1,88 @@
+"""SPMD pipeline vs sequential execution (reference parity:
+tests/test_torch/test_pp/test_runtime.py — pipeline output must match the
+unpipelined model, including gradients)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from easydist_tpu.jaxfront import make_device_mesh
+from easydist_tpu.parallel import PipelineConfig, spmd_pipeline
+from easydist_tpu.parallel.pipeline import stack_stage_params
+
+
+@pytest.fixture(scope="module")
+def mesh_pp(cpu_devices):
+    return make_device_mesh((4,), ("pp",), devices=cpu_devices[:4])
+
+
+def stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def make_stages(key, n_stages, d):
+    keys = jax.random.split(key, n_stages)
+    return [{"w": jax.random.normal(k, (d, d)) / jnp.sqrt(d),
+             "b": jnp.zeros((d,))} for k in keys]
+
+
+def sequential(stages, x_mb):
+    outs = []
+    for i in range(x_mb.shape[0]):
+        h = x_mb[i]
+        for p in stages:
+            h = stage_fn(p, h)
+        outs.append(h)
+    return jnp.stack(outs)
+
+
+@pytest.mark.world_8
+def test_pipeline_forward_matches_sequential(mesh_pp):
+    S, M, mb, d = 4, 8, 4, 16
+    stages = make_stages(jax.random.PRNGKey(0), S, d)
+    x = jax.random.normal(jax.random.PRNGKey(1), (M, mb, d))
+    stacked = stack_stage_params(stages)
+    pipe = spmd_pipeline(stage_fn, mesh_pp, PipelineConfig(S, M))
+    got = pipe(stacked, x)
+    want = sequential(stages, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.world_8
+def test_pipeline_grads_match_sequential(mesh_pp):
+    S, M, mb, d = 4, 4, 2, 8
+    stages = make_stages(jax.random.PRNGKey(2), S, d)
+    x = jax.random.normal(jax.random.PRNGKey(3), (M, mb, d))
+    stacked = stack_stage_params(stages)
+    pipe = spmd_pipeline(stage_fn, mesh_pp, PipelineConfig(S, M))
+
+    def pipe_loss(params):
+        return jnp.mean(pipe(params, x) ** 2)
+
+    def seq_loss(params):
+        out = sequential([jax.tree_util.tree_map(lambda q: q[i], params)
+                          for i in range(S)], x)
+        return jnp.mean(out ** 2)
+
+    g_pipe = jax.grad(pipe_loss)(stacked)
+    g_seq = jax.grad(seq_loss)(stacked)
+    for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                    jax.tree_util.tree_leaves(g_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.world_8
+def test_pipeline_remat_schedule(mesh_pp):
+    S, M, mb, d = 4, 4, 2, 8
+    stages = make_stages(jax.random.PRNGKey(4), S, d)
+    x = jax.random.normal(jax.random.PRNGKey(5), (M, mb, d))
+    stacked = stack_stage_params(stages)
+    pipe = spmd_pipeline(stage_fn, mesh_pp,
+                         PipelineConfig(S, M, schedule="remat"))
+    got = jax.jit(pipe)(stacked, x)
+    want = sequential(stages, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
