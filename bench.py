@@ -19,7 +19,7 @@ import jax.numpy as jnp
 logging.basicConfig(level=logging.INFO, stream=sys.stderr)
 
 
-def _bench_step(fn, state, tokens, targets, warmup=2, iters=10):
+def _bench_step(fn, state, tokens, targets, warmup=3, iters=20):
     """Times a state-threading train step; state is donated, so each call
     feeds the previous call's output state back in."""
     for _ in range(warmup):
@@ -54,24 +54,35 @@ def main():
     targets = jax.random.randint(jax.random.PRNGKey(2), (batch, cfg.seq), 0,
                                  cfg.vocab)
 
-    # baseline: hand-GSPMD (plain jit, donated state)
+    # baseline: hand-GSPMD (plain jit, donated state).  Interleave repeated
+    # measurements — device/tunnel throughput drifts between runs, so a
+    # sequential A-then-B comparison is biased; the median of per-rep ratios
+    # cancels the drift.
     base = jax.jit(step, donate_argnums=(0,))
-    t_base = _bench_step(base, state, tokens, targets)
-
-    # easydist auto-sharded
-    state2 = init_state(jax.random.PRNGKey(0))
     compiled = easydist_compile(step, mesh=mesh)
-    t_ed = _bench_step(compiled, state2, tokens, targets)
+    ratios, t_eds, t_bases = [], [], []
+    for rep in range(3):
+        t_base = _bench_step(base, init_state(jax.random.PRNGKey(0)),
+                             tokens, targets, iters=20)
+        t_ed = _bench_step(compiled, init_state(jax.random.PRNGKey(0)),
+                           tokens, targets, iters=20)
+        ratios.append(t_base / t_ed)
+        t_eds.append(t_ed)
+        t_bases.append(t_base)
+        print(f"# rep{rep}: base {t_base*1e3:.2f}ms easydist {t_ed*1e3:.2f}ms",
+              file=sys.stderr)
 
+    ratio = sorted(ratios)[len(ratios) // 2]
+    t_ed = sorted(t_eds)[len(t_eds) // 2]
     tokens_per_step = batch * cfg.seq
     ed_tps = tokens_per_step / t_ed / n_chips
-    base_tps = tokens_per_step / t_base / n_chips
+    base_tps = tokens_per_step / sorted(t_bases)[1] / n_chips
 
     print(json.dumps({
         "metric": "gpt2_train_tokens_per_sec_per_chip",
         "value": round(ed_tps, 1),
         "unit": "tokens/s/chip",
-        "vs_baseline": round(ed_tps / base_tps, 4),
+        "vs_baseline": round(ratio, 4),
     }))
     print(f"# easydist {ed_tps:.0f} tok/s/chip vs hand-jit {base_tps:.0f} "
           f"tok/s/chip on {n_chips} {jax.default_backend()} chip(s)",

@@ -148,11 +148,16 @@ def emit_sharded_fn(closed_jaxpr, names: VarNames,
 
 # ----------------------------------------------------------------- compiler
 
+class SignatureMismatch(Exception):
+    """Raised at trace time when a compiled result sees new shapes/tree."""
+
+
 class CompileResult:
 
-    def __init__(self, jitted, in_shardings, strategies, graph, mesh,
-                 in_tree, out_tree, n_flat_in):
-        self.jitted = jitted
+    def __init__(self, jitted, tree_jitted, in_shardings, strategies, graph,
+                 mesh, in_tree, out_tree, n_flat_in):
+        self.jitted = jitted  # flat calling convention (driver/debug use)
+        self.tree_jitted = tree_jitted  # pytree convention (steady state)
         self.in_shardings = in_shardings
         self.strategies = strategies  # per-axis {node_name: NodeStrategy}
         self.graph = graph
@@ -284,8 +289,44 @@ def compile_step(func, args, kwargs, mesh=None, state_io="auto",
 
     jitted = jax.jit(sharded_fn, in_shardings=in_shardings,
                      donate_argnums=donate)
-    return CompileResult(jitted, in_shardings, per_axis_final, graph, mesh,
-                         in_tree, out_tree, len(flat_args))
+
+    # pytree-native variant: flattening/unflattening happens inside the
+    # trace, so the per-call path is jax's C++ dispatch (the flat wrapper
+    # costs several ms per call at ~250 leaves).  The signature guard runs
+    # at TRACE time only: steady-state calls are pure jit cache hits, and a
+    # shape/tree change raises SignatureMismatch for the wrapper to catch.
+    out_tree_local = out_tree
+    expected_tree = in_tree
+    expected_avals = [(tuple(v.aval.shape), v.aval.dtype)
+                      for v in jaxpr.invars]
+
+    def tree_fn(*t_args, **t_kwargs):
+        flat, td = jax.tree_util.tree_flatten((t_args, t_kwargs))
+        if td != expected_tree or len(flat) != len(expected_avals) or any(
+                tuple(getattr(x, "shape", ())) != s
+                or getattr(x, "dtype", None) != d
+                for x, (s, d) in zip(flat, expected_avals)):
+            raise SignatureMismatch
+        return jax.tree_util.tree_unflatten(out_tree_local, sharded_fn(*flat))
+
+    # per-top-level-arg sharding pytrees; donate the positional args whose
+    # leaves are all state (positional prefix pairing guarantees this shape)
+    args_sharding, kwargs_sharding = jax.tree_util.tree_unflatten(
+        in_tree, in_shardings)
+    donate_args = []
+    if donate:
+        donated = set(donate)
+        base = 0
+        for i, a in enumerate(args):
+            n = len(jax.tree_util.tree_leaves(a))
+            if n and all(base + k in donated for k in range(n)):
+                donate_args.append(i)
+            base += n
+    tree_jitted = jax.jit(tree_fn, in_shardings=args_sharding,
+                          donate_argnums=tuple(donate_args))
+
+    return CompileResult(jitted, tree_jitted, in_shardings, per_axis_final,
+                         graph, mesh, in_tree, out_tree, len(flat_args))
 
 
 class CompiledFunction:
@@ -300,15 +341,16 @@ class CompiledFunction:
         self.state_io = state_io
         self.donate_state = donate_state
         self.compile_only = compile_only
-        self._cache: Dict[str, CompileResult] = {}
+        self._cache: Dict[object, CompileResult] = {}
+        self._last: Optional[CompileResult] = None
         functools.update_wrapper(self, func)
 
     @staticmethod
     def _signature(flat_args, treedef):
-        # hashable tuple, not a formatted string — this runs on every call
+        # hashable tuple, no string formatting — this runs on every call
         return (treedef,
                 tuple((getattr(l, "shape", ()),
-                       str(getattr(l, "dtype", type(l).__name__)))
+                       getattr(l, "dtype", None) or type(l))
                       for l in flat_args))
 
     def get_compiled(self, *args, **kwargs) -> CompileResult:
@@ -326,12 +368,19 @@ class CompiledFunction:
         return result
 
     def __call__(self, *args, **kwargs):
+        if not self.compile_only and self._last is not None:
+            # hot path: zero Python beyond jit dispatch; a shape/tree change
+            # raises SignatureMismatch during retrace and falls through
+            try:
+                return self._last.tree_jitted(*args, **kwargs)
+            except SignatureMismatch:
+                pass
         flat_args, treedef = jax.tree_util.tree_flatten((args, kwargs))
         result = self._lookup(flat_args, treedef, args, kwargs)
+        self._last = result
         if self.compile_only:
             return result
-        flat_out = result.jitted(*flat_args)
-        return jax.tree_util.tree_unflatten(result.out_tree, flat_out)
+        return result.tree_jitted(*args, **kwargs)
 
 
 def easydist_compile(func=None, mesh=None, state_io="auto",

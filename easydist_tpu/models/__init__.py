@@ -10,3 +10,6 @@ from .mlp import mlp_init, mlp_apply, make_mlp_train_step  # noqa: F401
 from .gpt import GPTConfig, gpt_init, gpt_apply, make_gpt_train_step  # noqa: F401
 from .resnet import resnet_init, resnet_apply, make_resnet_train_step  # noqa: F401
 from .optim import adam_init, adam_update, sgd_update  # noqa: F401
+from .llama import LlamaConfig, llama_init, llama_apply, make_llama_train_step  # noqa: F401
+from .vit import ViTConfig, vit_init, vit_apply, make_vit_train_step  # noqa: F401
+from .gat import GATConfig, gat_init, gat_apply, make_gat_train_step  # noqa: F401

@@ -1,0 +1,76 @@
+"""Graph attention network (reference benchmark: GAT 4096 nodes x 12288
+features, benchmark/bench_case.py:21-25; model benchmark/torch/model/gat.py
+behavior).  Dense-adjacency formulation — static shapes for XLA."""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from .optim import sgd_update
+
+
+@dataclass
+class GATConfig:
+    nodes: int = 4096
+    features: int = 12288
+    hidden: int = 256
+    classes: int = 16
+    layers: int = 2
+
+    @staticmethod
+    def bench(**kw):
+        return GATConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(nodes=64, features=32, hidden=16, classes=4, layers=2)
+        base.update(kw)
+        return GATConfig(**base)
+
+
+def gat_init(cfg: GATConfig, key) -> Dict:
+    dims = [cfg.features] + [cfg.hidden] * (cfg.layers - 1) + [cfg.classes]
+    params = {"layers": []}
+    keys = jax.random.split(key, cfg.layers)
+    for k, d_in, d_out in zip(keys, dims[:-1], dims[1:]):
+        k1, k2, k3 = jax.random.split(k, 3)
+        params["layers"].append({
+            "w": jax.random.normal(k1, (d_in, d_out)) / math.sqrt(d_in),
+            "a_src": jax.random.normal(k2, (d_out,)) / math.sqrt(d_out),
+            "a_dst": jax.random.normal(k3, (d_out,)) / math.sqrt(d_out),
+        })
+    return params
+
+
+def gat_apply(params, adj, x):
+    """adj: [N, N] dense 0/1 adjacency (self-loops included); x: [N, F]."""
+    h = x
+    for i, layer in enumerate(params["layers"]):
+        z = h @ layer["w"]  # [N, D]
+        e_src = z @ layer["a_src"]  # [N]
+        e_dst = z @ layer["a_dst"]  # [N]
+        e = jax.nn.leaky_relu(e_src[:, None] + e_dst[None, :], 0.2)
+        e = jnp.where(adj > 0, e, -1e30)
+        att = jax.nn.softmax(e, axis=-1)
+        h = att @ z
+        if i < len(params["layers"]) - 1:
+            h = jax.nn.elu(h)
+    return h
+
+
+def make_gat_train_step(cfg: GATConfig, lr=1e-2):
+    def train_step(params, adj, x, labels):
+        def loss_fn(p):
+            logits = gat_apply(p, adj, x)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        return sgd_update(params, grads, lr=lr), loss
+
+    return train_step

@@ -1,0 +1,143 @@
+"""Llama-style decoder: RMSNorm, rotary embeddings, SwiGLU, grouped-query
+attention (BASELINE.json config: "Llama-2-7B pretrain, autoflow 2D (DPxTP)
+plan").  Pure jax, bf16-ready, static shapes."""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from .optim import adam_init, adam_update
+
+
+@dataclass
+class LlamaConfig:
+    vocab: int = 32000
+    seq: int = 2048
+    dim: int = 4096
+    heads: int = 32
+    kv_heads: int = 32
+    layers: int = 32
+    ffn_dim: int = 11008
+    rope_theta: float = 10000.0
+    dtype: str = "bfloat16"
+
+    @staticmethod
+    def llama2_7b(**kw):
+        return LlamaConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab=128, seq=32, dim=32, heads=4, kv_heads=2, layers=2,
+                    ffn_dim=64, dtype="float32")
+        base.update(kw)
+        return LlamaConfig(**base)
+
+
+def llama_init(cfg: LlamaConfig, key) -> Dict:
+    keys = jax.random.split(key, 1 + cfg.layers)
+    hd = cfg.dim // cfg.heads
+    params = {
+        "wte": jax.random.normal(keys[0], (cfg.vocab, cfg.dim)) * 0.02,
+        "blocks": [],
+        "norm_f": jnp.ones((cfg.dim,)),
+    }
+    scale = 1.0 / math.sqrt(cfg.dim)
+    for i in range(cfg.layers):
+        bk = jax.random.split(keys[1 + i], 7)
+        params["blocks"].append({
+            "attn_norm": jnp.ones((cfg.dim,)),
+            "wq": jax.random.normal(bk[0], (cfg.dim, cfg.heads * hd)) * scale,
+            "wk": jax.random.normal(bk[1], (cfg.dim, cfg.kv_heads * hd)) * scale,
+            "wv": jax.random.normal(bk[2], (cfg.dim, cfg.kv_heads * hd)) * scale,
+            "wo": jax.random.normal(bk[3], (cfg.heads * hd, cfg.dim)) * scale,
+            "ffn_norm": jnp.ones((cfg.dim,)),
+            "w_gate": jax.random.normal(bk[4], (cfg.dim, cfg.ffn_dim)) * scale,
+            "w_up": jax.random.normal(bk[5], (cfg.dim, cfg.ffn_dim)) * scale,
+            "w_down": jax.random.normal(bk[6], (cfg.ffn_dim, cfg.dim))
+                      * (1.0 / math.sqrt(cfg.ffn_dim)),
+        })
+    return params
+
+
+def _rmsnorm(x, g, eps=1e-5):
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    return x * jax.lax.rsqrt(var + eps) * g
+
+
+def _rope(x, theta):
+    """x: [b, h, t, d]; rotate pairs along d with position-dependent angles."""
+    b, h, t, d = x.shape
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    pos = jnp.arange(t, dtype=jnp.float32)
+    ang = pos[:, None] * freqs[None, :]  # [t, d/2]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    r1 = x1 * cos - x2 * sin
+    r2 = x1 * sin + x2 * cos
+    return jnp.stack([r1, r2], axis=-1).reshape(b, h, t, d)
+
+
+def _gqa_attention(x, blk, cfg: LlamaConfig, dtype):
+    b, t, _ = x.shape
+    hd = cfg.dim // cfg.heads
+    rep = cfg.heads // cfg.kv_heads
+
+    def heads(y, n):
+        return y.reshape(b, t, n, hd).transpose(0, 2, 1, 3)
+
+    q = heads(x @ blk["wq"].astype(dtype), cfg.heads)
+    k = heads(x @ blk["wk"].astype(dtype), cfg.kv_heads)
+    v = heads(x @ blk["wv"].astype(dtype), cfg.kv_heads)
+    q = _rope(q.astype(jnp.float32), cfg.rope_theta).astype(dtype)
+    k = _rope(k.astype(jnp.float32), cfg.rope_theta).astype(dtype)
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    att = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
+    qi = jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
+    ki = jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
+    att = jnp.where(ki <= qi, att, jnp.array(-1e9, att.dtype))
+    att = jax.nn.softmax(att, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", att, v)
+    out = out.transpose(0, 2, 1, 3).reshape(b, t, cfg.heads * hd)
+    return out @ blk["wo"].astype(dtype)
+
+
+def llama_apply(params, cfg: LlamaConfig, tokens):
+    dtype = jnp.dtype(cfg.dtype)
+    x = params["wte"][tokens].astype(dtype)
+    for blk in params["blocks"]:
+        h = _rmsnorm(x, blk["attn_norm"]).astype(dtype)
+        x = x + _gqa_attention(h, blk, cfg, dtype)
+        h = _rmsnorm(x, blk["ffn_norm"]).astype(dtype)
+        gated = jax.nn.silu(h @ blk["w_gate"].astype(dtype)) \
+            * (h @ blk["w_up"].astype(dtype))
+        x = x + gated @ blk["w_down"].astype(dtype)
+    x = _rmsnorm(x, params["norm_f"])
+    return x.astype(jnp.float32) @ params["wte"].T
+
+
+def llama_loss(params, cfg: LlamaConfig, tokens, targets):
+    logits = llama_apply(params, cfg, tokens)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, targets[..., None], axis=-1).mean()
+
+
+def make_llama_train_step(cfg: LlamaConfig, lr=1e-4):
+    def init_state(key):
+        params = llama_init(cfg, key)
+        return (params, adam_init(params))
+
+    def train_step(state, tokens, targets):
+        params, opt = state
+        loss, grads = jax.value_and_grad(llama_loss)(params, cfg, tokens,
+                                                     targets)
+        new_params, new_opt = adam_update(params, grads, opt, lr=lr)
+        return (new_params, new_opt), loss
+
+    return train_step, init_state

@@ -1,0 +1,131 @@
+"""ViT-B/16-style vision transformer (BASELINE.json config: "ViT-B/16
+auto-shard vs manual FSDP").  Patchify is a conv; the encoder reuses
+pre-norm transformer blocks."""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from .optim import adam_init, adam_update
+
+
+@dataclass
+class ViTConfig:
+    image: int = 224
+    patch: int = 16
+    dim: int = 768
+    heads: int = 12
+    layers: int = 12
+    classes: int = 1000
+    dtype: str = "float32"
+
+    @staticmethod
+    def b16(**kw):
+        return ViTConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(image=32, patch=8, dim=32, heads=4, layers=2, classes=10)
+        base.update(kw)
+        return ViTConfig(**base)
+
+    @property
+    def n_patches(self):
+        return (self.image // self.patch) ** 2
+
+
+def _linear_init(key, n_in, n_out):
+    return {"w": jax.random.normal(key, (n_in, n_out)) / math.sqrt(n_in),
+            "b": jnp.zeros((n_out,))}
+
+
+def vit_init(cfg: ViTConfig, key) -> Dict:
+    keys = jax.random.split(key, 3 + cfg.layers)
+    params = {
+        "patch": jax.random.normal(
+            keys[0], (cfg.patch, cfg.patch, 3, cfg.dim))
+            * math.sqrt(2.0 / (cfg.patch * cfg.patch * 3)),
+        "pos": jax.random.normal(keys[1], (cfg.n_patches + 1, cfg.dim)) * 0.02,
+        "cls": jnp.zeros((cfg.dim,)),
+        "blocks": [],
+        "ln_f": {"g": jnp.ones((cfg.dim,)), "b": jnp.zeros((cfg.dim,))},
+        "head": _linear_init(keys[2], cfg.dim, cfg.classes),
+    }
+    for i in range(cfg.layers):
+        bk = jax.random.split(keys[3 + i], 4)
+        params["blocks"].append({
+            "ln1": {"g": jnp.ones((cfg.dim,)), "b": jnp.zeros((cfg.dim,))},
+            "qkv": _linear_init(bk[0], cfg.dim, 3 * cfg.dim),
+            "proj": _linear_init(bk[1], cfg.dim, cfg.dim),
+            "ln2": {"g": jnp.ones((cfg.dim,)), "b": jnp.zeros((cfg.dim,))},
+            "fc": _linear_init(bk[2], cfg.dim, 4 * cfg.dim),
+            "fc2": _linear_init(bk[3], 4 * cfg.dim, cfg.dim),
+        })
+    return params
+
+
+def _layernorm(x, g, b, eps=1e-5):
+    mu = x.mean(axis=-1, keepdims=True)
+    var = x.var(axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+
+def _mha(x, blk, heads):
+    b, t, d = x.shape
+    hd = d // heads
+    qkv = x @ blk["qkv"]["w"] + blk["qkv"]["b"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def sh(y):
+        return y.reshape(b, t, heads, hd).transpose(0, 2, 1, 3)
+
+    q, k, v = sh(q), sh(k), sh(v)
+    att = jax.nn.softmax(
+        jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd), axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", att, v)
+    out = out.transpose(0, 2, 1, 3).reshape(b, t, d)
+    return out @ blk["proj"]["w"] + blk["proj"]["b"]
+
+
+def vit_apply(params, cfg: ViTConfig, images):
+    """images: [batch, H, W, 3] -> logits [batch, classes]."""
+    b = images.shape[0]
+    x = jax.lax.conv_general_dilated(
+        images, params["patch"], (cfg.patch, cfg.patch), "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    x = x.reshape(b, -1, cfg.dim)
+    cls = jnp.broadcast_to(params["cls"], (b, 1, cfg.dim))
+    x = jnp.concatenate([cls, x], axis=1) + params["pos"][None]
+    for blk in params["blocks"]:
+        x = x + _mha(_layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"]),
+                     blk, cfg.heads)
+        h = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"])
+        h = jax.nn.gelu(h @ blk["fc"]["w"] + blk["fc"]["b"])
+        x = x + h @ blk["fc2"]["w"] + blk["fc2"]["b"]
+    x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
+    return x[:, 0] @ params["head"]["w"] + params["head"]["b"]
+
+
+def make_vit_train_step(cfg: ViTConfig, lr=1e-4):
+    def init_state(key):
+        params = vit_init(cfg, key)
+        return (params, adam_init(params))
+
+    def train_step(state, images, labels):
+        params, opt = state
+
+        def loss_fn(p):
+            logits = vit_apply(p, cfg, images)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_params, new_opt = adam_update(params, grads, opt, lr=lr)
+        return (new_params, new_opt), loss
+
+    return train_step, init_state

@@ -1,0 +1,140 @@
+"""Mixture-of-Experts with expert parallelism (EP).
+
+Absent from the reference (SURVEY.md §2.9: "Expert parallel (EP / MoE) —
+Absent") and first-class here.  Switch-style top-1 routing with capacity
+buffers, GShard-style dense dispatch (einsum with one-hot masks — MXU
+friendly, no dynamic shapes), experts sharded over the `ep` mesh axis, and
+token exchange via `lax.all_to_all` inside one compiled program.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+@dataclass
+class MoEConfig:
+    n_experts: int
+    d_model: int
+    d_ff: int
+    capacity_factor: float = 1.25
+
+
+def moe_init(cfg: MoEConfig, key) -> Dict:
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "router": jax.random.normal(k1, (cfg.d_model, cfg.n_experts)) * 0.02,
+        "w_in": jax.random.normal(k2, (cfg.n_experts, cfg.d_model, cfg.d_ff))
+                / math.sqrt(cfg.d_model),
+        "w_out": jax.random.normal(k3, (cfg.n_experts, cfg.d_ff, cfg.d_model))
+                 / math.sqrt(cfg.d_ff),
+    }
+
+
+def _moe_local(x, router, w_in, w_out, *, axis: str, n_experts: int,
+               capacity: int):
+    """x: [n_local, d]; w_in/w_out: [E/n, ...] local expert shards."""
+    n_local, d = x.shape
+    ep = jax.lax.psum(1, axis)
+
+    logits = x @ router  # [n, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)  # [n]
+    gate = jnp.max(probs, axis=-1)  # [n]
+
+    onehot = jax.nn.one_hot(expert, n_experts, dtype=x.dtype)  # [n, E]
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(onehot, axis=0) - 1.0  # [n, E]
+    pos_tok = jnp.sum(pos * onehot, axis=-1)  # [n]
+    keep = pos_tok < capacity
+    gate = gate * keep
+
+    pos_oh = jax.nn.one_hot(pos_tok.astype(jnp.int32), capacity,
+                            dtype=x.dtype)  # [n, C]
+    dispatch = onehot[:, :, None] * pos_oh[:, None, :] * keep[:, None, None]
+    # [n, E, C] -> buffers [E, C, d]
+    buffers = jnp.einsum("nec,nd->ecd", dispatch, x)
+
+    # exchange: every device sends its per-expert buffers to the expert
+    # owner; E splits across devices, capacity concatenates
+    buffers = jax.lax.all_to_all(buffers, axis, split_axis=0, concat_axis=1,
+                                 tiled=True)  # [E/ep, C*ep, d]
+
+    h = jnp.einsum("ecd,edf->ecf", buffers, w_in)
+    h = jax.nn.gelu(h)
+    out = jnp.einsum("ecf,efd->ecd", h, w_out)  # [E/ep, C*ep, d]
+
+    out = jax.lax.all_to_all(out, axis, split_axis=1, concat_axis=0,
+                             tiled=True)  # [E, C, d]
+    y = jnp.einsum("nec,ecd->nd", dispatch, out) * gate[:, None]
+
+    # Switch load-balancing loss: E * sum_e frac_tokens_e * mean_prob_e,
+    # averaged over devices
+    frac = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = n_experts * jnp.sum(frac * mean_prob)
+    aux = jax.lax.pmean(aux, axis)
+    return y, aux
+
+
+def moe_layer(params: Dict, x, mesh, cfg: MoEConfig,
+              axis: str = "ep") -> Tuple[jax.Array, jax.Array]:
+    """x: [tokens, d_model] (token dim sharded over `axis`); experts sharded
+    over `axis`.  Returns (output [tokens, d_model], aux_loss scalar)."""
+    ep = mesh.shape[axis]
+    if cfg.n_experts % ep != 0:
+        raise ValueError(f"n_experts {cfg.n_experts} not divisible by "
+                         f"ep axis size {ep}")
+    n_tokens = x.shape[0]
+    n_local = n_tokens // ep
+    capacity = max(1, int(math.ceil(n_local * cfg.capacity_factor
+                                    / cfg.n_experts)))
+
+    fn = shard_map(
+        lambda xl, r, wi, wo: _moe_local(
+            xl, r, wi, wo, axis=axis, n_experts=cfg.n_experts,
+            capacity=capacity),
+        mesh=mesh,
+        in_specs=(P(axis), P(), P(axis), P(axis)),
+        out_specs=(P(axis), P()),
+        check_rep=False)
+    return fn(x, params["router"], params["w_in"], params["w_out"])
+
+
+def moe_reference(params: Dict, x, cfg: MoEConfig, n_devices: int = 1):
+    """Single-device semantics-equivalent reference (same capacity limits per
+    source shard) used by tests."""
+    n = x.shape[0]
+    n_local = n // n_devices
+    capacity = max(1, int(math.ceil(n_local * cfg.capacity_factor
+                                    / cfg.n_experts)))
+    ys = []
+    auxes = []
+    for s in range(n_devices):
+        xs = x[s * n_local:(s + 1) * n_local]
+        logits = xs @ params["router"]
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert = jnp.argmax(probs, axis=-1)
+        gate = jnp.max(probs, axis=-1)
+        onehot = jax.nn.one_hot(expert, cfg.n_experts, dtype=x.dtype)
+        pos = jnp.cumsum(onehot, axis=0) - 1.0
+        pos_tok = jnp.sum(pos * onehot, axis=-1)
+        keep = pos_tok < capacity
+        gate = gate * keep
+        out = jnp.zeros_like(xs)
+        for i in range(xs.shape[0]):
+            e = int(expert[i])
+            h = jax.nn.gelu(xs[i] @ params["w_in"][e])
+            out = out.at[i].set(h @ params["w_out"][e])
+        ys.append(out * gate[:, None])
+        frac = jnp.mean(onehot, axis=0)
+        mean_prob = jnp.mean(probs, axis=0)
+        auxes.append(cfg.n_experts * jnp.sum(frac * mean_prob))
+    return jnp.concatenate(ys), jnp.mean(jnp.stack(auxes))

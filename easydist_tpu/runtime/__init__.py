@@ -1,0 +1,11 @@
+"""Runtime services: checkpointing, profiling, perf DB, memory analysis.
+
+TPU mappings of the reference's aux subsystems (SURVEY.md §5): the C++
+CUPTI tracer becomes `jax.profiler` + XLA cost analysis; the custom CUDA
+allocator's planning role becomes donation/remat + XLA's allocator; the perf
+pickle DB keeps its shape.
+"""
+
+from .checkpoint import save_checkpoint, load_checkpoint, latest_step  # noqa: F401
+from .perfdb import PerfDB  # noqa: F401
+from .profiler import profile_compiled, op_cost_analysis, memory_analysis  # noqa: F401

@@ -1,0 +1,37 @@
+"""Persistent op-performance cache (reference: easydist/utils/
+graph_profile_db.py:24-48 — pickle at ~/.easydist/perf.db)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional
+
+from easydist_tpu import config as edconfig
+
+
+class PerfDB:
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path or edconfig.prof_db_path
+        self._db = {}
+        if os.path.exists(self.path):
+            try:
+                with open(self.path, "rb") as f:
+                    self._db = pickle.load(f)
+            except Exception:
+                self._db = {}
+
+    def get_op_perf(self, key: str, sub_key: str) -> Optional[Any]:
+        return self._db.get(key, {}).get(sub_key)
+
+    def record_op_perf(self, key: str, sub_key: str, value: Any) -> None:
+        self._db.setdefault(key, {})[sub_key] = value
+
+    def persist(self) -> None:
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        with open(self.path, "wb") as f:
+            pickle.dump(self._db, f)
+
+    def __len__(self) -> int:
+        return sum(len(v) for v in self._db.values())

@@ -1,0 +1,71 @@
+"""Profiling & cost analysis on compiled programs.
+
+TPU replacement for the reference's profiling stack: per-op runtime
+benchmarking (passes/runtime_prof.py) becomes XLA cost analysis + wall-clock
+timing of the compiled program; the CUPTI C++ stream tracer
+(csrc/stream_tracer.cpp) becomes `jax.profiler` traces (XLA already exposes
+per-op scheduling); allocator profiling becomes `memory_analysis()` on the
+compiled executable.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import jax
+
+from .perfdb import PerfDB
+
+
+def op_cost_analysis(compiled) -> Dict[str, float]:
+    """FLOPs / bytes-accessed / estimated seconds from XLA for a compiled
+    function (jax `Compiled` object or our CompileResult)."""
+    compiled = getattr(compiled, "jitted", compiled)
+    if hasattr(compiled, "cost_analysis"):
+        cost = compiled.cost_analysis()
+    else:
+        raise TypeError("expected a lowered+compiled jax function")
+    if isinstance(cost, list):
+        cost = cost[0] if cost else {}
+    return dict(cost or {})
+
+
+def memory_analysis(compiled) -> Dict[str, int]:
+    """Per-device memory breakdown of the compiled executable."""
+    compiled = getattr(compiled, "jitted", compiled)
+    mem = compiled.memory_analysis()
+    out = {}
+    for attr in ("temp_size_in_bytes", "argument_size_in_bytes",
+                 "output_size_in_bytes", "alias_size_in_bytes",
+                 "generated_code_size_in_bytes"):
+        if hasattr(mem, attr):
+            out[attr] = getattr(mem, attr)
+    return out
+
+
+def profile_compiled(fn, args, key: Optional[str] = None,
+                     trials: int = 5, warmup: int = 2,
+                     db: Optional[PerfDB] = None,
+                     trace_dir: Optional[str] = None) -> float:
+    """Wall-clock seconds/call of `fn(*args)`, optionally recorded into the
+    perf DB and captured as a `jax.profiler` trace for xprof."""
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+
+    if trace_dir:
+        with jax.profiler.trace(trace_dir):
+            out = fn(*args)
+            jax.block_until_ready(out)
+
+    start = time.perf_counter()
+    for _ in range(trials):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    elapsed = (time.perf_counter() - start) / trials
+
+    if db is not None and key is not None:
+        db.record_op_perf("compiled", key, elapsed)
+    return elapsed

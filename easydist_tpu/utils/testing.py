@@ -1,0 +1,31 @@
+"""Testing helpers: virtual multi-device CPU meshes in one process — the TPU
+analog of the reference's mock device meshes (utils/testing/mock.py:16-50)
+and its multi-process `spawn` harness (spawn.py): XLA's
+`--xla_force_host_platform_device_count` gives N-device semantics with no
+hardware and no process fleet."""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_devices(n: int = 8) -> None:
+    """Must run before jax initializes a backend (e.g. top of conftest)."""
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+        f" --xla_force_host_platform_device_count={n}"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def cpu_mesh(shape, axis_names, dcn_axes=()):
+    """Build a CPU mesh for tests; requires force_cpu_devices() earlier."""
+    import jax
+
+    from easydist_tpu.jaxfront.mesh import make_device_mesh
+
+    n = 1
+    for s in shape:
+        n *= s
+    return make_device_mesh(shape, axis_names, devices=jax.devices()[:n],
+                            dcn_axes=dcn_axes)

@@ -1,0 +1,29 @@
+"""Benchmark timer (reference: easydist/utils/timer.py:24-56 — cuda-event
+timing there; `block_until_ready` fencing here)."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+import jax
+
+
+class EDTimer:
+
+    def __init__(self, func: Callable, trials: int = 10, warmup_trials: int = 3):
+        self.func = func
+        self.trials = trials
+        self.warmup_trials = warmup_trials
+
+    def time(self) -> float:
+        """Mean seconds per call, device-fenced."""
+        out = None
+        for _ in range(self.warmup_trials):
+            out = self.func()
+        jax.block_until_ready(out)
+        start = time.perf_counter()
+        for _ in range(self.trials):
+            out = self.func()
+        jax.block_until_ready(out)
+        return (time.perf_counter() - start) / self.trials

@@ -70,3 +70,58 @@ def test_resnet_tiny(mesh):
     np.testing.assert_allclose(float(got_loss), float(ref_loss),
                                rtol=1e-3, atol=1e-5)
     _tree_allclose(got_params, ref_params, rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.world_8
+@pytest.mark.long_duration
+def test_llama_tiny(mesh):
+    from easydist_tpu.models import LlamaConfig, make_llama_train_step
+
+    cfg = LlamaConfig.tiny()
+    step, init_state = make_llama_train_step(cfg)
+    state = init_state(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, cfg.seq), 0, cfg.vocab)
+    targets = jax.random.randint(jax.random.PRNGKey(2), (8, cfg.seq), 0, cfg.vocab)
+    compiled = easydist_compile(step, mesh=mesh, donate_state=False)
+    got_state, got_loss = compiled(state, tokens, targets)
+    ref_state, ref_loss = step(state, tokens, targets)
+    np.testing.assert_allclose(float(got_loss), float(ref_loss),
+                               rtol=1e-3, atol=1e-5)
+    _tree_allclose(got_state[0], ref_state[0], rtol=1e-3, atol=1e-5)
+
+
+@pytest.mark.world_8
+@pytest.mark.long_duration
+def test_vit_tiny(mesh):
+    from easydist_tpu.models import ViTConfig, make_vit_train_step
+
+    cfg = ViTConfig.tiny()
+    step, init_state = make_vit_train_step(cfg)
+    state = init_state(jax.random.PRNGKey(0))
+    images = jax.random.normal(jax.random.PRNGKey(1), (16, cfg.image, cfg.image, 3))
+    labels = jax.random.randint(jax.random.PRNGKey(2), (16,), 0, cfg.classes)
+    compiled = easydist_compile(step, mesh=mesh, donate_state=False)
+    got_state, got_loss = compiled(state, images, labels)
+    ref_state, ref_loss = step(state, images, labels)
+    np.testing.assert_allclose(float(got_loss), float(ref_loss),
+                               rtol=1e-3, atol=1e-5)
+
+
+@pytest.mark.world_8
+def test_gat_tiny(mesh):
+    from easydist_tpu.models import GATConfig, gat_init, make_gat_train_step
+
+    cfg = GATConfig.tiny()
+    params = gat_init(cfg, jax.random.PRNGKey(0))
+    step = make_gat_train_step(cfg)
+    key = jax.random.PRNGKey(1)
+    adj = (jax.random.uniform(key, (cfg.nodes, cfg.nodes)) < 0.1).astype(jnp.float32)
+    adj = jnp.maximum(adj, jnp.eye(cfg.nodes))
+    x = jax.random.normal(jax.random.PRNGKey(2), (cfg.nodes, cfg.features))
+    labels = jax.random.randint(jax.random.PRNGKey(3), (cfg.nodes,), 0, cfg.classes)
+    compiled = easydist_compile(step, mesh=mesh, donate_state=False)
+    got_params, got_loss = compiled(params, adj, x, labels)
+    ref_params, ref_loss = step(params, adj, x, labels)
+    np.testing.assert_allclose(float(got_loss), float(ref_loss),
+                               rtol=1e-3, atol=1e-5)
+    _tree_allclose(got_params, ref_params, rtol=1e-3, atol=1e-4)

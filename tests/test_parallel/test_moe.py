@@ -1,0 +1,43 @@
+"""Expert-parallel MoE vs single-device reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from easydist_tpu.jaxfront import make_device_mesh
+from easydist_tpu.parallel.moe import MoEConfig, moe_init, moe_layer, moe_reference
+
+
+@pytest.fixture(scope="module")
+def mesh_ep(cpu_devices):
+    return make_device_mesh((4,), ("ep",), devices=cpu_devices[:4])
+
+
+@pytest.mark.world_8
+def test_moe_matches_reference(mesh_ep):
+    cfg = MoEConfig(n_experts=8, d_model=16, d_ff=32, capacity_factor=2.0)
+    params = moe_init(cfg, jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (64, cfg.d_model))
+    y, aux = moe_layer(params, x, mesh_ep, cfg)
+    y_ref, aux_ref = moe_reference(params, x, cfg, n_devices=4)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-5)
+
+
+@pytest.mark.world_8
+def test_moe_gradients_flow(mesh_ep):
+    cfg = MoEConfig(n_experts=4, d_model=8, d_ff=16, capacity_factor=2.0)
+    params = moe_init(cfg, jax.random.PRNGKey(2))
+    x = jax.random.normal(jax.random.PRNGKey(3), (32, cfg.d_model))
+
+    def loss(p):
+        y, aux = moe_layer(p, x, mesh_ep, cfg)
+        return jnp.mean(y ** 2) + 0.01 * aux
+
+    grads = jax.grad(loss)(params)
+    for leaf in jax.tree_util.tree_leaves(grads):
+        assert np.isfinite(np.asarray(leaf)).all()
+    # expert weights must receive nonzero gradient
+    assert float(jnp.abs(grads["w_in"]).sum()) > 0

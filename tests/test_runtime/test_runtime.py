@@ -1,0 +1,80 @@
+"""Checkpoint/perfdb/profiler/timer tests."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from easydist_tpu.runtime import (PerfDB, latest_step, load_checkpoint,
+                                  memory_analysis, op_cost_analysis,
+                                  profile_compiled, save_checkpoint)
+from easydist_tpu.utils import EDTimer
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    state = {"params": {"w": jnp.arange(12.0).reshape(3, 4)},
+             "count": jnp.array(7)}
+    save_checkpoint(str(tmp_path), state, step=1)
+    save_checkpoint(str(tmp_path), state, step=2)
+    assert latest_step(str(tmp_path)) == 2
+    restored = load_checkpoint(str(tmp_path), state)
+    np.testing.assert_allclose(np.asarray(restored["params"]["w"]),
+                               np.asarray(state["params"]["w"]))
+    assert int(restored["count"]) == 7
+
+
+def test_checkpoint_resharded_restore(tmp_path, cpu_devices):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    mesh = Mesh(np.array(cpu_devices).reshape(8), ("d",))
+    sharded = jax.device_put(jnp.arange(32.0),
+                             NamedSharding(mesh, PartitionSpec("d")))
+    save_checkpoint(str(tmp_path), {"x": sharded}, step=0)
+    # restore replicated (different sharding than saved)
+    like = {"x": jnp.zeros(32)}
+    restored = load_checkpoint(str(tmp_path), like)
+    np.testing.assert_allclose(np.asarray(restored["x"]),
+                               np.arange(32.0))
+
+
+def test_checkpoint_gc(tmp_path):
+    state = {"x": jnp.ones(4)}
+    for s in range(5):
+        save_checkpoint(str(tmp_path), state, step=s, keep=2)
+    steps = sorted(d for d in os.listdir(tmp_path) if d.startswith("step_"))
+    assert steps == ["step_3", "step_4"]
+
+
+def test_perfdb_roundtrip(tmp_path):
+    db = PerfDB(path=str(tmp_path / "perf.db"))
+    db.record_op_perf("dot_general", "f32[8,8]", 1.5e-6)
+    db.persist()
+    db2 = PerfDB(path=str(tmp_path / "perf.db"))
+    assert db2.get_op_perf("dot_general", "f32[8,8]") == 1.5e-6
+    assert len(db2) == 1
+
+
+def test_cost_and_memory_analysis():
+    fn = jax.jit(lambda x: (x @ x).sum())
+    compiled = fn.lower(jnp.ones((64, 64))).compile()
+    cost = op_cost_analysis(compiled)
+    assert cost.get("flops", 0) > 0
+    mem = memory_analysis(compiled)
+    assert mem  # non-empty dict
+
+
+def test_profile_compiled(tmp_path):
+    fn = jax.jit(lambda x: jnp.tanh(x).sum())
+    x = jnp.ones((256,))
+    db = PerfDB(path=str(tmp_path / "perf.db"))
+    t = profile_compiled(fn, (x,), key="tanh_sum", db=db, trials=3)
+    assert t > 0
+    assert db.get_op_perf("compiled", "tanh_sum") == t
+
+
+def test_edtimer():
+    fn = jax.jit(lambda: jnp.ones((64,)).sum())
+    t = EDTimer(lambda: fn(), trials=3, warmup_trials=1).time()
+    assert t > 0
