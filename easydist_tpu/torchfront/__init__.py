@@ -1,0 +1,12 @@
+"""PyTorch frontend: torch modules -> jax functions -> the same auto-parallel
+pipeline, executing GPU-free through XLA.
+
+The reference's torch frontend (easydist/torch/, ~15k LoC) traces
+model+optimizer into one fx graph and runs it over NCCL; per the north star
+(BASELINE.json) this frontend instead lowers `torch.export`'s aten graph to
+jax, reuses the jax solver/emission stack unchanged, and replaces the
+CUDA/NCCL runtime entirely.
+"""
+
+from .convert import torch_module_to_jax  # noqa: F401
+from .api import easydist_compile_torch, make_torch_train_step  # noqa: F401

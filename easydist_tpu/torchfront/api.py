@@ -1,0 +1,72 @@
+"""User API for the torch frontend.
+
+`easydist_compile_torch(module, example_args)` — auto-parallel inference on
+the converted module.  `make_torch_train_step(module, loss, ...)` — full
+training: the converted forward runs under jax autodiff with our Adam/SGD,
+and the whole step goes through `easydist_compile` (reference equivalent:
+`@easydist_compile()(train_step)(model, opt, ...)`, torch/api.py:227 — there
+via fx-tracing torch autograd+optimizer; here via jax transforms on the
+converted function, which is the TPU-native route to the same contract).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+
+from easydist_tpu.jaxfront.api import easydist_compile
+from easydist_tpu.models.optim import adam_init, adam_update, sgd_update
+from .convert import torch_module_to_jax
+
+
+def easydist_compile_torch(module, example_args, mesh=None, **kwargs):
+    """Auto-parallelized inference callable for a torch module.
+
+    Returns (compiled_fn, params): compiled_fn(params, *jax_inputs) runs the
+    sharded forward; params is the converted jax param dict (update/replace
+    leaves to load new weights)."""
+    fn, params = torch_module_to_jax(module, example_args)
+    compiled = easydist_compile(fn, mesh=mesh, state_io={}, **kwargs)
+    return compiled, params
+
+
+def make_torch_train_step(module, example_args, loss_fn: Callable,
+                          optimizer: str = "adam", lr: float = 1e-3,
+                          mesh=None, **kwargs):
+    """Build an auto-parallelized train step from a torch module.
+
+    loss_fn(outputs, *targets) -> scalar jax loss.
+    Returns (compiled_step, init_state):
+      state = (params, opt_state) for adam, params for sgd
+      compiled_step(state, inputs, *targets) -> (new_state, loss)
+    """
+    fwd, params0 = torch_module_to_jax(module, example_args)
+
+    if optimizer == "adam":
+        def init_state():
+            return (params0, adam_init(params0))
+
+        def step(state, inputs, *targets):
+            params, opt = state
+
+            def objective(p):
+                return loss_fn(fwd(p, inputs), *targets)
+
+            loss, grads = jax.value_and_grad(objective)(params)
+            new_params, new_opt = adam_update(params, grads, opt, lr=lr)
+            return (new_params, new_opt), loss
+    elif optimizer == "sgd":
+        def init_state():
+            return params0
+
+        def step(params, inputs, *targets):
+            def objective(p):
+                return loss_fn(fwd(p, inputs), *targets)
+
+            loss, grads = jax.value_and_grad(objective)(params)
+            return sgd_update(params, grads, lr=lr), loss
+    else:
+        raise ValueError(f"unknown optimizer {optimizer!r}")
+
+    return easydist_compile(step, mesh=mesh, **kwargs), init_state

@@ -1,0 +1,490 @@
+"""aten graph -> jax function conversion.
+
+`torch.export.export` gives a functionalized aten-level fx graph whose
+placeholders are (params..., buffers..., user inputs...).  Each aten op maps
+to a jax implementation through the registry below (the conversion analog of
+the reference's DTensor prop-rule bank, torch/spmd_prop_rule.py — but
+producing executable jax instead of sharding metadata; sharding then comes
+from our own discovery on the jax side).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_ATEN: Dict[str, Callable] = {}
+
+
+def register_aten(*names):
+    def deco(fn):
+        for n in names:
+            _ATEN[n] = fn
+        return fn
+
+    return deco
+
+
+class UnsupportedAtenOp(NotImplementedError):
+    pass
+
+
+# ------------------------------------------------------------ conversions
+
+@register_aten("aten.linear.default")
+def _linear(x, w, b=None):
+    out = x @ w.T
+    return out + b if b is not None else out
+
+
+@register_aten("aten.mm.default", "aten.matmul.default", "aten.bmm.default")
+def _matmul(a, b):
+    return a @ b
+
+
+@register_aten("aten.addmm.default")
+def _addmm(bias, a, b):
+    return bias + a @ b
+
+
+@register_aten("aten.relu.default", "aten.relu_.default")
+def _relu(x):
+    return jax.nn.relu(x)
+
+
+@register_aten("aten.gelu.default")
+def _gelu(x, approximate="none"):
+    return jax.nn.gelu(x, approximate=(approximate == "tanh"))
+
+
+@register_aten("aten.silu.default")
+def _silu(x):
+    return jax.nn.silu(x)
+
+
+@register_aten("aten.sigmoid.default")
+def _sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@register_aten("aten.tanh.default")
+def _tanh(x):
+    return jnp.tanh(x)
+
+
+@register_aten("aten.add.Tensor", "aten.add_.Tensor")
+def _add(a, b, alpha=1):
+    return a + alpha * b
+
+
+@register_aten("aten.sub.Tensor")
+def _sub(a, b, alpha=1):
+    return a - alpha * b
+
+
+@register_aten("aten.mul.Tensor", "aten.mul_.Tensor")
+def _mul(a, b):
+    return a * b
+
+
+@register_aten("aten.div.Tensor")
+def _div(a, b):
+    return a / b
+
+
+@register_aten("aten.pow.Tensor_Scalar")
+def _pow(a, b):
+    return a ** b
+
+
+@register_aten("aten.neg.default")
+def _neg(x):
+    return -x
+
+
+@register_aten("aten.exp.default")
+def _exp(x):
+    return jnp.exp(x)
+
+
+@register_aten("aten.log.default")
+def _log(x):
+    return jnp.log(x)
+
+
+@register_aten("aten.sqrt.default")
+def _sqrt(x):
+    return jnp.sqrt(x)
+
+
+@register_aten("aten.rsqrt.default")
+def _rsqrt(x):
+    return jax.lax.rsqrt(x)
+
+
+@register_aten("aten.layer_norm.default")
+def _layer_norm(x, normalized_shape, weight=None, bias=None, eps=1e-5,
+                cudnn_enable=False):
+    axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    mu = x.mean(axis=axes, keepdims=True)
+    var = x.var(axis=axes, keepdims=True)
+    out = (x - mu) * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_aten("aten.group_norm.default")
+def _group_norm(x, groups, weight=None, bias=None, eps=1e-5):
+    n, c = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    xg = x.reshape(n, groups, c // groups, *spatial)
+    axes = tuple(range(2, xg.ndim))
+    mu = xg.mean(axis=axes, keepdims=True)
+    var = xg.var(axis=axes, keepdims=True)
+    xg = (xg - mu) * jax.lax.rsqrt(var + eps)
+    out = xg.reshape(x.shape)
+    if weight is not None:
+        shape = (1, c) + (1,) * len(spatial)
+        out = out * weight.reshape(shape)
+        if bias is not None:
+            out = out + bias.reshape(shape)
+    return out
+
+
+@register_aten("aten.softmax.int", "aten._softmax.default")
+def _softmax(x, dim, half_to_float=False):
+    return jax.nn.softmax(x, axis=dim)
+
+
+@register_aten("aten.log_softmax.int")
+def _log_softmax(x, dim, dtype=None):
+    return jax.nn.log_softmax(x, axis=dim)
+
+
+@register_aten("aten.embedding.default")
+def _embedding(weight, indices, padding_idx=-1, scale_grad=False, sparse=False):
+    return weight[indices]
+
+
+@register_aten("aten.dropout.default")
+def _dropout(x, p, train):
+    return x  # inference semantics; training dropout needs an rng plumb-in
+
+
+@register_aten("aten.conv2d.default", "aten.convolution.default")
+def _conv2d(x, w, bias=None, stride=(1, 1), padding=(0, 0), dilation=(1, 1),
+            *rest):
+    # torch NCHW / OIHW; groups is the last convolution arg when present
+    groups = 1
+    if rest:
+        if len(rest) >= 3:  # convolution.default: transposed, output_padding, groups
+            groups = rest[2]
+        else:
+            groups = rest[0]
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = (padding, padding)
+    if isinstance(dilation, int):
+        dilation = (dilation, dilation)
+    out = jax.lax.conv_general_dilated(
+        x, w, tuple(stride),
+        [(p, p) for p in padding],
+        rhs_dilation=tuple(dilation),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+@register_aten("aten.max_pool2d.default")
+def _max_pool2d(x, kernel, stride=None, padding=(0, 0), dilation=(1, 1),
+                ceil_mode=False):
+    if isinstance(kernel, int):
+        kernel = (kernel, kernel)
+    stride = stride or kernel
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = (padding, padding)
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max,
+        (1, 1) + tuple(kernel), (1, 1) + tuple(stride),
+        [(0, 0), (0, 0)] + [(p, p) for p in padding])
+
+
+@register_aten("aten.adaptive_avg_pool2d.default")
+def _adaptive_avg_pool2d(x, output_size):
+    if tuple(output_size) == (1, 1):
+        return x.mean(axis=(2, 3), keepdims=True)
+    raise UnsupportedAtenOp("adaptive_avg_pool2d with output != 1x1")
+
+
+@register_aten("aten.mean.dim")
+def _mean_dim(x, dims, keepdim=False, dtype=None):
+    return x.mean(axis=tuple(dims), keepdims=keepdim)
+
+
+@register_aten("aten.mean.default")
+def _mean(x, dtype=None):
+    return x.mean()
+
+
+@register_aten("aten.sum.dim_IntList")
+def _sum_dim(x, dims, keepdim=False, dtype=None):
+    return x.sum(axis=tuple(dims), keepdims=keepdim)
+
+
+@register_aten("aten.sum.default")
+def _sum(x, dtype=None):
+    return x.sum()
+
+
+@register_aten("aten.var.correction")
+def _var(x, dims=None, correction=1, keepdim=False):
+    ddof = int(correction) if correction is not None else 1
+    return x.var(axis=tuple(dims) if dims else None, ddof=ddof,
+                 keepdims=keepdim)
+
+
+@register_aten("aten.view.default", "aten.reshape.default",
+               "aten._unsafe_view.default")
+def _view(x, shape):
+    return x.reshape(tuple(shape))
+
+
+@register_aten("aten.permute.default")
+def _permute(x, dims):
+    return jnp.transpose(x, tuple(dims))
+
+
+@register_aten("aten.transpose.int")
+def _transpose(x, d0, d1):
+    return jnp.swapaxes(x, d0, d1)
+
+
+@register_aten("aten.t.default")
+def _t(x):
+    return x.T
+
+
+@register_aten("aten.contiguous.default", "aten.clone.default",
+               "aten.detach.default", "aten.alias.default")
+def _identity(x, *a, **k):
+    return x
+
+
+@register_aten("aten.unsqueeze.default")
+def _unsqueeze(x, dim):
+    return jnp.expand_dims(x, dim)
+
+
+@register_aten("aten.squeeze.dim")
+def _squeeze(x, dim):
+    return jnp.squeeze(x, axis=dim)
+
+
+@register_aten("aten.cat.default")
+def _cat(tensors, dim=0):
+    return jnp.concatenate(tensors, axis=dim)
+
+
+@register_aten("aten.stack.default")
+def _stack(tensors, dim=0):
+    return jnp.stack(tensors, axis=dim)
+
+
+@register_aten("aten.split.Tensor")
+def _split(x, size, dim=0):
+    n = x.shape[dim]
+    sizes = [size] * (n // size) + ([n % size] if n % size else [])
+    idx = np.cumsum(sizes)[:-1]
+    return jnp.split(x, idx, axis=dim)
+
+
+@register_aten("aten.chunk.default")
+def _chunk(x, chunks, dim=0):
+    return jnp.array_split(x, chunks, axis=dim)
+
+
+@register_aten("aten.slice.Tensor")
+def _slice(x, dim=0, start=None, end=None, step=1):
+    index = [slice(None)] * x.ndim
+    index[dim] = slice(start, end if end not in (None, 2**63 - 1) else None,
+                       step)
+    return x[tuple(index)]
+
+
+@register_aten("aten.select.int")
+def _select(x, dim, index):
+    return jnp.take(x, index, axis=dim)
+
+
+@register_aten("aten.expand.default")
+def _expand(x, sizes, implicit=False):
+    shape = [x.shape[i] if s == -1 else s for i, s in enumerate(sizes)]
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+@register_aten("aten.masked_fill.Scalar")
+def _masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.array(value, x.dtype), x)
+
+
+@register_aten("aten.where.self")
+def _where(cond, a, b):
+    return jnp.where(cond, a, b)
+
+
+@register_aten("aten.triu.default")
+def _triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+@register_aten("aten.tril.default")
+def _tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@register_aten("aten.arange.default", "aten.arange.start")
+def _arange(*args, dtype=None, layout=None, device=None, pin_memory=None):
+    return jnp.arange(*args)
+
+
+@register_aten("aten.scaled_dot_product_attention.default")
+def _sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None):
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("...qd,...kd->...qk", q, k) * scale
+    if is_causal:
+        t_q, t_k = q.shape[-2], k.shape[-2]
+        qi = jax.lax.broadcasted_iota(jnp.int32, (t_q, t_k), 0)
+        ki = jax.lax.broadcasted_iota(jnp.int32, (t_q, t_k), 1)
+        s = jnp.where(ki <= qi, s, jnp.array(-1e30, s.dtype))
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            s = jnp.where(attn_mask, s, jnp.array(-1e30, s.dtype))
+        else:
+            s = s + attn_mask
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", p, v)
+
+
+@register_aten("aten.batch_norm.default")
+def _batch_norm(x, weight, bias, running_mean, running_var, training,
+                momentum, eps, cudnn_enabled=True):
+    # inference semantics (running stats); training BN needs stat plumbing
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    out = (x - running_mean.reshape(shape)) * jax.lax.rsqrt(
+        running_var.reshape(shape) + eps)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+# --------------------------------------------------------------- converter
+
+def _to_jax_value(val):
+    import torch
+
+    if isinstance(val, torch.Tensor):
+        return jnp.asarray(val.detach().cpu().numpy())
+    return val
+
+
+def torch_module_to_jax(module, example_args):
+    """Export a torch nn.Module and convert to (jax_fn, params).
+
+    Returns (fn, params) where params is a {qualified_name: jax array} dict
+    of parameters AND buffers, and fn(params, *inputs) reproduces the torch
+    forward in jax (single tensor or tuple output, matching torch).
+    """
+    import torch
+
+    ep = torch.export.export(module.eval(), tuple(example_args))
+    gm = ep.graph_module
+    sig = ep.graph_signature
+    state = {**ep.state_dict, **getattr(ep, "constants", {})}
+
+    placeholder_specs: List = []  # ("state", qualname) | ("input", pos)
+    user_pos = 0
+    to_state = {}
+    to_state.update(sig.inputs_to_parameters)
+    to_state.update(sig.inputs_to_buffers)
+    to_state.update(getattr(sig, "inputs_to_lifted_tensor_constants", {}) or {})
+    for node in gm.graph.nodes:
+        if node.op != "placeholder":
+            continue
+        if node.target in to_state:
+            placeholder_specs.append(("state", to_state[node.target]))
+        else:
+            placeholder_specs.append(("input", user_pos))
+            user_pos += 1
+
+    params = {name: _to_jax_value(state[name])
+              for spec, name in placeholder_specs if spec == "state"
+              for name in [name]}
+
+    node_list = list(gm.graph.nodes)
+
+    def fn(params, *inputs):
+        env: Dict[Any, Any] = {}
+        ph_iter = iter(placeholder_specs)
+
+        def lookup(arg):
+            if isinstance(arg, (list, tuple)):
+                return type(arg)(lookup(a) for a in arg)
+            if hasattr(arg, "op"):  # fx.Node
+                return env[arg]
+            return arg
+
+        for node in node_list:
+            if node.op == "placeholder":
+                kind, key = next(ph_iter)
+                env[node] = params[key] if kind == "state" else inputs[key]
+            elif node.op == "call_function":
+                import operator
+
+                if node.target is operator.getitem:
+                    obj, idx = node.args
+                    env[node] = lookup(obj)[idx]
+                    continue
+                name = str(node.target)
+                impl = _ATEN.get(name)
+                if impl is None:
+                    raise UnsupportedAtenOp(
+                        f"no jax mapping for {name}; register one with "
+                        f"easydist_tpu.torchfront.convert.register_aten")
+                args = lookup(node.args)
+                kwargs = {k: lookup(v) for k, v in node.kwargs.items()}
+                env[node] = impl(*args, **kwargs)
+            elif node.op == "get_attr":
+                env[node] = _to_jax_value(getattr(gm, node.target))
+            elif node.op == "output":
+                out = lookup(node.args[0])
+                return out[0] if isinstance(out, (list, tuple)) \
+                    and len(out) == 1 else out
+        raise RuntimeError("graph had no output node")
+
+    return fn, params
+
+
+@register_aten("aten.flatten.using_ints")
+def _flatten(x, start_dim=0, end_dim=-1):
+    end_dim = end_dim if end_dim >= 0 else x.ndim + end_dim
+    shape = x.shape[:start_dim] + (-1,) + x.shape[end_dim + 1:]
+    return x.reshape(shape)
+
+
+@register_aten("aten.unbind.int")
+def _unbind(x, dim=0):
+    return tuple(jnp.take(x, i, axis=dim) for i in range(x.shape[dim]))
