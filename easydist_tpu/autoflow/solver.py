@@ -285,6 +285,31 @@ class SpmdSolver:
         max_mem = max((float(e.mem.max()) for e in self.edges), default=0.0)
         w_mem = (min_comm / (10.0 * max(len(self.edges), 1) * max_mem)
                  if max_mem > 0 else 0.0)
+
+        # hot loop: prefer the native C++ beam core when built
+        from easydist_tpu import native
+
+        pos = {c.cid: i for i, c in enumerate(self.clusters)}
+        if native.available():
+            strat_count = [c.strategy_count() for c in self.clusters]
+            y_cost_list = [
+                np.asarray(self.output_y_cost.get(c.cid,
+                                                  np.zeros(c.strategy_count())))
+                for c in self.clusters]
+            n_edges = [(pos[e.up_cluster.cid], pos[e.down_cluster.cid],
+                        e.comm + w_mem * e.mem) for e in self.edges]
+            res = native.beam_search_native(strat_count, y_cost_list, n_edges,
+                                            width)
+            if res is not None:
+                assign, best_cost = res
+                logger.info("[SpmdSolver.beam/native] axis=%s cost=%.3e",
+                            self.axis.name, best_cost)
+                chosen: Dict[str, NodeStrategy] = {}
+                for c in self.clusters:
+                    for uid, (_, strat) in \
+                            c.strategies[int(assign[pos[c.cid]])].items():
+                        chosen[c.nodes[uid].name] = strat
+                return chosen
         # beam entries: (cost, {cid: strategy_idx})
         beam: List[Tuple[float, Dict[int, int]]] = [(0.0, {})]
         for c in self.clusters:

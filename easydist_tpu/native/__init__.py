@@ -1,0 +1,201 @@
+"""Native (C++) runtime components, built lazily at first use.
+
+Mirrors the reference's JIT C++ extension loading (torch/meta_allocator.py:
+24-69 builds csrc with cpp_extension.load); here a plain g++ -shared build
+cached next to the sources and bound with ctypes (no pybind11 in the image).
+Falls back to pure-Python implementations when no compiler is present.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "csrc")
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _build() -> Optional[str]:
+    so_path = os.path.join(_DIR, "libed_native.so")
+    srcs = [os.path.join(_SRC, f) for f in sorted(os.listdir(_SRC))
+            if f.endswith(".cpp")]
+    newest_src = max(os.path.getmtime(s) for s in srcs)
+    if os.path.exists(so_path) and os.path.getmtime(so_path) > newest_src:
+        return so_path
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", so_path] + srcs
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return so_path
+    except Exception as e:
+        logger.warning("native build failed (%s); using Python fallbacks", e)
+        return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _LIB is None and not _TRIED:
+        _TRIED = True
+        so = _build()
+        if so is not None:
+            lib = ctypes.CDLL(so)
+            i64p = ctypes.POINTER(ctypes.c_int64)
+            f64p = ctypes.POINTER(ctypes.c_double)
+            i32p = ctypes.POINTER(ctypes.c_int32)
+            lib.ed_skyline_plan.restype = ctypes.c_int64
+            lib.ed_skyline_plan.argtypes = [ctypes.c_int64, i64p, i64p, i64p,
+                                            i64p]
+            lib.ed_check_plan.restype = ctypes.c_int64
+            lib.ed_check_plan.argtypes = [ctypes.c_int64, i64p, i64p, i64p,
+                                          i64p, ctypes.c_int64, i64p]
+            lib.ed_peak_live.restype = ctypes.c_int64
+            lib.ed_peak_live.argtypes = [ctypes.c_int64, i64p, i64p, i64p]
+            lib.ed_beam_search.restype = ctypes.c_double
+            lib.ed_beam_search.argtypes = [
+                ctypes.c_int64, i64p, f64p, i64p, ctypes.c_int64, i64p, i64p,
+                f64p, i64p, ctypes.c_int64, i32p]
+            _LIB = lib
+    return _LIB
+
+
+def _i64(a) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.int64)
+
+
+def _ptr(a, typ):
+    return a.ctypes.data_as(ctypes.POINTER(typ))
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+# ----------------------------------------------------------- memory planner
+
+def skyline_plan(starts: Sequence[int], ends: Sequence[int],
+                 sizes: Sequence[int]) -> Tuple[np.ndarray, int]:
+    """Assign non-overlapping offsets to buffers live over [start, end];
+    returns (offsets, peak_bytes)."""
+    n = len(starts)
+    s, e, z = _i64(starts), _i64(ends), _i64(sizes)
+    offsets = np.zeros(n, dtype=np.int64)
+    lib = get_lib()
+    if lib is not None and n:
+        peak = lib.ed_skyline_plan(n, _ptr(s, ctypes.c_int64),
+                                   _ptr(e, ctypes.c_int64),
+                                   _ptr(z, ctypes.c_int64),
+                                   _ptr(offsets, ctypes.c_int64))
+        return offsets, int(peak)
+    # python fallback: identical greedy best-fit
+    order = sorted(range(n), key=lambda i: (-z[i], s[i]))
+    placed: List[Tuple[int, int, int, int]] = []
+    peak = 0
+    for i in order:
+        blocked = sorted((off, off + size) for (bs, be, off, size) in placed
+                         if bs <= e[i] and s[i] <= be)
+        off = 0
+        for lo, hi in blocked:
+            if off + z[i] <= lo:
+                break
+            if off < hi:
+                off = hi
+        placed.append((int(s[i]), int(e[i]), off, int(z[i])))
+        offsets[i] = off
+        peak = max(peak, off + int(z[i]))
+    return offsets, int(peak)
+
+
+def check_plan(starts, ends, sizes, offsets, max_report: int = 16):
+    """Verify lifetime/address disjointness; returns list of violating index
+    pairs (empty = valid)."""
+    n = len(starts)
+    lib = get_lib()
+    s, e, z, o = _i64(starts), _i64(ends), _i64(sizes), _i64(offsets)
+    if lib is not None:
+        report = np.zeros(2 * max_report, dtype=np.int64)
+        count = lib.ed_check_plan(n, _ptr(s, ctypes.c_int64),
+                                  _ptr(e, ctypes.c_int64),
+                                  _ptr(z, ctypes.c_int64),
+                                  _ptr(o, ctypes.c_int64),
+                                  max_report, _ptr(report, ctypes.c_int64))
+        return [(int(report[2 * i]), int(report[2 * i + 1]))
+                for i in range(min(count, max_report))]
+    out = []
+    for i in range(n):
+        for j in range(i + 1, n):
+            if s[i] <= e[j] and s[j] <= e[i] and \
+                    o[i] < o[j] + z[j] and o[j] < o[i] + z[i]:
+                out.append((i, j))
+    return out
+
+
+def peak_live(starts, ends, sizes) -> int:
+    """Sum-of-live-sizes peak — the allocator-independent lower bound."""
+    n = len(starts)
+    if n == 0:
+        return 0
+    lib = get_lib()
+    s, e, z = _i64(starts), _i64(ends), _i64(sizes)
+    if lib is not None:
+        return int(lib.ed_peak_live(n, _ptr(s, ctypes.c_int64),
+                                    _ptr(e, ctypes.c_int64),
+                                    _ptr(z, ctypes.c_int64)))
+    max_t = int(e.max())
+    delta = np.zeros(max_t + 2, dtype=np.int64)
+    np.add.at(delta, s, z)
+    np.add.at(delta, e + 1, -z)
+    return int(np.cumsum(delta).max())
+
+
+# ------------------------------------------------------------- beam search
+
+def beam_search_native(strat_count, y_cost_list, edges, beam_width: int):
+    """Run the C++ beam core.
+
+    strat_count: [n_clusters]; y_cost_list: list of per-cluster cost arrays;
+    edges: list of (up, down, cost_matrix[up_s, down_s]).
+    Returns (assign array, cost) or None when the native lib is missing.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(strat_count)
+    sc = _i64(strat_count)
+    y_off = np.zeros(n, dtype=np.int64)
+    total = 0
+    for i, c in enumerate(strat_count):
+        y_off[i] = total
+        total += int(c)
+    y_cost = np.zeros(total, dtype=np.float64)
+    for i, costs in enumerate(y_cost_list):
+        y_cost[y_off[i]:y_off[i] + len(costs)] = costs
+
+    n_e = len(edges)
+    up = _i64([e[0] for e in edges])
+    down = _i64([e[1] for e in edges])
+    e_off = np.zeros(max(n_e, 1), dtype=np.int64)
+    tot = 0
+    mats = []
+    for i, (u, d, m) in enumerate(edges):
+        e_off[i] = tot
+        m = np.ascontiguousarray(m, dtype=np.float64)
+        mats.append(m.ravel())
+        tot += m.size
+    edge_cost = np.concatenate(mats) if mats else np.zeros(1)
+
+    assign = np.zeros(n, dtype=np.int32)
+    cost = lib.ed_beam_search(
+        n, _ptr(sc, ctypes.c_int64), _ptr(y_cost, ctypes.c_double),
+        _ptr(y_off, ctypes.c_int64), n_e, _ptr(up, ctypes.c_int64),
+        _ptr(down, ctypes.c_int64), _ptr(edge_cost, ctypes.c_double),
+        _ptr(e_off, ctypes.c_int64), beam_width,
+        _ptr(assign, ctypes.c_int32))
+    return assign, float(cost)

@@ -1,0 +1,9 @@
+"""Memory planning & analysis (reference: easydist/torch/schedule/).
+
+On TPU, XLA owns buffer addresses, so the planner's outputs are *analysis
+and policy*: per-strategy peak-memory estimates (feeding the solver's memory
+cap), a skyline packing that bounds what any allocator could achieve, and a
+lifetime-overlap validator (the op_mem_checker analog).  The heavy loops run
+in the native C++ planner (easydist_tpu/native)."""
+
+from .memory_planner import plan_graph_memory, MemoryPlan  # noqa: F401
