@@ -1,0 +1,3 @@
+"""Pallas TPU kernels for hot ops."""
+
+from .flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,145 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Blockwise online-softmax attention: Q blocks stream over the grid, K/V live
+in VMEM per (batch*head) program, statistics (running max / denominator)
+stay in f32 scratch.  O(seq) memory instead of materializing the [T, T]
+score matrix; MXU-shaped matmul blocks.
+
+The backward pass recomputes attention in plain jax (correct, O(T^2) bytes
+in the bwd only); a fused flash backward kernel is future work.  The ring
+variant composes this kernel with the ppermute loop in
+parallel/ring_attention.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_k: int,
+                  causal: bool, scale: float, q_block: int):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
+    bq, d = q.shape
+
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    o0 = jnp.zeros((bq, d), jnp.float32)
+
+    q_pos = qi * q_block + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(kb, carry):
+        o_acc, m_acc, l_acc = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k_blk.T  # [bq, block_k]
+        if causal:
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m_acc, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_acc - m_new)
+        l_new = l_acc * alpha + jnp.sum(p, axis=-1)
+        o_new = o_acc * alpha[:, None] + p @ v_blk
+        return o_new, m_new, l_new
+
+    n_kb = seq_k // block_k
+    if causal:
+        # blocks fully above the diagonal contribute nothing; bound the loop
+        # at the q block's last row
+        n_kb_eff = jnp.minimum(n_kb, (qi + 1) * q_block // block_k
+                               + (1 if q_block % block_k else 0))
+    else:
+        n_kb_eff = n_kb
+    o_acc, m_acc, l_acc = jax.lax.fori_loop(0, n_kb_eff, body, (o0, m0, l0))
+    o_ref[0] = (o_acc / jnp.maximum(l_acc, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
+                   block_k: int, interpret: bool):
+    b, h, t_q, d = q.shape
+    t_k = k.shape[2]
+    bq = min(block_q, t_q)
+    bk = min(block_k, t_k)
+    while t_q % bq:
+        bq //= 2
+    while t_k % bk:
+        bk //= 2
+    bq, bk = max(bq, 1), max(bk, 1)
+
+    qf = q.reshape(b * h, t_q, d)
+    kf = k.reshape(b * h, t_k, d)
+    vf = v.reshape(b * h, t_k, d)
+
+    kernel = functools.partial(_flash_kernel, block_k=bk, seq_k=t_k,
+                               causal=causal, scale=scale, q_block=bq)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, t_q // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, t_k, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, t_k, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t_q, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, t_q, d)
+
+
+def _reference_attention(q, k, v, causal: bool, scale: float):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        t_q, t_k = q.shape[2], k.shape[2]
+        qi = jax.lax.broadcasted_iota(jnp.int32, (t_q, t_k), 0)
+        ki = jax.lax.broadcasted_iota(jnp.int32, (t_q, t_k), 1)
+        s = jnp.where(ki <= qi, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal: bool = True,
+                    scale: Optional[float] = None, block_q: int = 256,
+                    block_k: int = 256, interpret: Optional[bool] = None):
+    """q, k, v: [batch, heads, seq, head_dim].  Returns same shape.
+
+    `interpret=None` auto-selects the Pallas interpreter off-TPU so tests
+    run on CPU; on TPU the kernel compiles natively.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+
+    def ref(q, k, v):
+        return _reference_attention(q, k, v, causal, scale)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

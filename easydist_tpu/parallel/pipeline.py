@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import functools
 from dataclasses import dataclass
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -37,6 +37,11 @@ class PipelineConfig:
     # "gpipe" keeps all microbatch activations (scan); "remat" wraps the
     # stage body in jax.checkpoint to trade recompute for memory
     schedule: str = "gpipe"
+    # hybrid PPxSPMD (reference compile_auto.py:683-715 mesh
+    # ['pp','spmd0','spmd1']): shard the microbatch dim over a data axis
+    # and/or stage params over a tensor axis, all inside the same program
+    data_axis: Optional[str] = None  # shards microbatches' batch dim
+    param_spec: Optional[object] = None  # extra PartitionSpec tail for params
 
 
 def spmd_pipeline(stage_fn: Callable, mesh, config: PipelineConfig):
@@ -59,15 +64,30 @@ def spmd_pipeline(stage_fn: Callable, mesh, config: PipelineConfig):
         body = jax.checkpoint(stage_fn)
 
     def pipelined(stage_params, microbatches):
-        in_specs = (jax.tree_util.tree_map(lambda _: P(axis), stage_params),
-                    P())
-        # stage-stacked params shard their leading dim over pp; data
-        # microbatches are replicated into every stage
-        param_specs = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+        # stage-stacked params shard their leading dim over pp (optionally
+        # with a tensor-parallel tail spec); microbatches shard their batch
+        # dim over the data axis when configured
+        if config.param_spec is None:
+            param_specs = jax.tree_util.tree_map(lambda _: P(axis),
+                                                 stage_params)
+        else:
+            is_spec = lambda x: isinstance(x, (tuple, P))  # noqa: E731
+            p_leaves, p_td = jax.tree_util.tree_flatten(stage_params)
+            s_leaves, s_td = jax.tree_util.tree_flatten(config.param_spec,
+                                                        is_leaf=is_spec)
+            if s_td == p_td:
+                # per-leaf spec tails (pytree matching stage_params)
+                specs = [P(axis, *tuple(t)) for t in s_leaves]
+                param_specs = jax.tree_util.tree_unflatten(p_td, specs)
+            else:
+                tail = tuple(config.param_spec)
+                param_specs = jax.tree_util.tree_map(
+                    lambda _: P(axis, *tail), stage_params)
+        data_spec = P(None, config.data_axis) if config.data_axis else P()
 
         @functools.partial(shard_map, mesh=mesh,
-                           in_specs=(param_specs, P()),
-                           out_specs=P(),
+                           in_specs=(param_specs, data_spec),
+                           out_specs=data_spec,
                            check_rep=False)
         def run(params, x_mb):
             stage_id = jax.lax.axis_index(axis)

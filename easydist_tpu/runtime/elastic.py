@@ -1,0 +1,67 @@
+"""Minimal failure handling: periodic checkpointing + restart-resume.
+
+The reference has no recovery story (SURVEY.md §5: "Failure detection /
+elastic recovery — Absent"; it delegates to torchrun and kills peers on
+failure).  On TPU pods the practical contract is: persist sharded state
+every N steps, re-`jax.distributed.initialize` on restart, restore onto the
+(possibly different) mesh, continue from the last step.  `run_training`
+implements that loop; `multihost_setup` is the DCN control-plane bring-up.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Optional
+
+from .checkpoint import latest_step, load_checkpoint, save_checkpoint
+
+logger = logging.getLogger(__name__)
+
+
+def multihost_setup(coordinator: Optional[str] = None,
+                    num_processes: Optional[int] = None,
+                    process_id: Optional[int] = None) -> None:
+    """Initialize the DCN control plane (reference analog: mpi4py +
+    jax.distributed.initialize, easydist/jax/__init__.py:36-53 — here jax's
+    own coordinator, no MPI)."""
+    import jax
+
+    kwargs = {}
+    if coordinator is not None:
+        kwargs = dict(coordinator_address=coordinator,
+                      num_processes=num_processes, process_id=process_id)
+    jax.distributed.initialize(**kwargs)
+
+
+def run_training(step_fn: Callable, init_state: Callable, data_iter,
+                 ckpt_dir: str, total_steps: int,
+                 checkpoint_every: int = 100,
+                 on_step: Optional[Callable] = None):
+    """Fault-tolerant training loop.
+
+    step_fn(state, *batch) -> (state, loss); init_state() -> fresh state.
+    Resumes from the latest checkpoint under `ckpt_dir` when one exists
+    (restore reshards onto the current mesh automatically).  Returns the
+    final state.
+    """
+    start = latest_step(ckpt_dir)
+    if start is None:
+        state = init_state()
+        start = 0
+        logger.info("elastic: fresh start")
+    else:
+        state = load_checkpoint(ckpt_dir, init_state(), step=start)
+        logger.info("elastic: resumed from step %d", start)
+
+    t0 = time.perf_counter()
+    for step in range(start, total_steps):
+        batch = next(data_iter)
+        state, loss = step_fn(state, *batch)
+        if on_step is not None:
+            on_step(step, loss)
+        if (step + 1) % checkpoint_every == 0 or step + 1 == total_steps:
+            save_checkpoint(ckpt_dir, state, step + 1)
+            logger.info("elastic: checkpointed step %d (%.1fs elapsed)",
+                        step + 1, time.perf_counter() - t0)
+    return state

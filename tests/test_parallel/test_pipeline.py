@@ -86,3 +86,46 @@ def test_pipeline_remat_schedule(mesh_pp):
     want = sequential(stages, x)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-6)
+
+
+@pytest.fixture(scope="module")
+def mesh_3d(cpu_devices):
+    return make_device_mesh((2, 2, 2), ("pp", "dp", "tp"),
+                            devices=cpu_devices)
+
+
+@pytest.mark.world_8
+def test_hybrid_pp_dp_tp(mesh_3d):
+    """3D hybrid: 2 stages x 2-way data x 2-way tensor parallel
+    (reference parity: tests/test_torch/test_hybrid.py)."""
+    import functools
+    from jax.sharding import PartitionSpec as P
+
+    S, M, mb, d = 2, 4, 8, 16
+    stages = make_stages(jax.random.PRNGKey(7), S, d)
+    x = jax.random.normal(jax.random.PRNGKey(8), (M, mb, d))
+    stacked = stack_stage_params(stages)
+
+    def tp_stage_fn(p, xb):
+        # column-parallel matmul over tp with psum'd bias add
+        h = xb @ p["w"]  # w sharded on dim 1 over tp inside shard_map
+        h = jax.lax.all_gather(h, "tp", axis=1, tiled=True)
+        return jnp.tanh(h + p["b"])
+
+    cfg = PipelineConfig(S, M, data_axis="dp",
+                         param_spec={"w": (None, "tp"), "b": ()})
+    pipe = spmd_pipeline(tp_stage_fn, mesh_3d, cfg)
+    got = pipe(stacked, x)
+
+    def plain_stage(p, xb):
+        return jnp.tanh(xb @ p["w"] + p["b"])
+
+    want = []
+    for i in range(M):
+        h = x[i]
+        for p in stages:
+            h = plain_stage(p, h)
+        want.append(h)
+    want = jnp.stack(want)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)

@@ -78,3 +78,30 @@ def test_edtimer():
     fn = jax.jit(lambda: jnp.ones((64,)).sum())
     t = EDTimer(lambda: fn(), trials=3, warmup_trials=1).time()
     assert t > 0
+
+
+def test_elastic_resume(tmp_path):
+    """Simulated failure: first run dies mid-way; second run resumes from
+    the checkpoint and reaches the same final state as an uninterrupted run."""
+    from easydist_tpu.runtime import run_training
+
+    def init_state():
+        return {"w": jnp.zeros(4), "n": jnp.array(0)}
+
+    def step_fn(state, x):
+        return ({"w": state["w"] + x, "n": state["n"] + 1},
+                float(state["n"]))
+
+    def data():
+        while True:
+            yield (jnp.ones(4),)
+
+    ckpt = str(tmp_path / "elastic")
+    # "crash" after 7 of 10 steps (checkpoint every 3 -> step 6 persisted)
+    run_training(step_fn, init_state, data(), ckpt, total_steps=7,
+                 checkpoint_every=3)
+    # restart: resumes at 6 (last checkpoint), finishes to 10
+    final = run_training(step_fn, init_state, data(), ckpt, total_steps=10,
+                        checkpoint_every=3)
+    assert int(final["n"]) == 10
+    np.testing.assert_allclose(np.asarray(final["w"]), 10 * np.ones(4))
