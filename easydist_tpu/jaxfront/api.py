@@ -307,12 +307,16 @@ def compile_step(func, args, kwargs, mesh=None, state_io="auto",
                 or getattr(x, "dtype", None) != d
                 for x, (s, d) in zip(flat, expected_avals)):
             raise SignatureMismatch
+        # constrain inputs INSIDE the trace rather than pinning jit
+        # in_shardings: donated state comes back with XLA-chosen output
+        # shardings, and pinned in_shardings would reject it on the next call
+        flat = [jax.lax.with_sharding_constraint(x, s)
+                if hasattr(x, "ndim") and x.ndim > 0 else x
+                for x, s in zip(flat, in_shardings)]
         return jax.tree_util.tree_unflatten(out_tree_local, sharded_fn(*flat))
 
-    # per-top-level-arg sharding pytrees; donate the positional args whose
-    # leaves are all state (positional prefix pairing guarantees this shape)
-    args_sharding, kwargs_sharding = jax.tree_util.tree_unflatten(
-        in_tree, in_shardings)
+    # donate the positional args whose leaves are all state (positional
+    # prefix pairing guarantees this shape)
     donate_args = []
     if donate:
         donated = set(donate)
@@ -322,8 +326,7 @@ def compile_step(func, args, kwargs, mesh=None, state_io="auto",
             if n and all(base + k in donated for k in range(n)):
                 donate_args.append(i)
             base += n
-    tree_jitted = jax.jit(tree_fn, in_shardings=args_sharding,
-                          donate_argnums=tuple(donate_args))
+    tree_jitted = jax.jit(tree_fn, donate_argnums=tuple(donate_args))
 
     return CompileResult(jitted, tree_jitted, in_shardings, per_axis_final,
                          graph, mesh, in_tree, out_tree, len(flat_args))

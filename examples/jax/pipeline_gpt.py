@@ -1,0 +1,52 @@
+"""Pipeline-parallel transformer blocks over a pp mesh axis
+(reference: benchmark/torch/pp/gpt/speed/easydist_pipeline.py).
+
+python examples/jax/pipeline_gpt.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+if not os.environ.get("EASYDIST_REAL_DEVICES"):
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+        " --xla_force_host_platform_device_count=8"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+else:
+    import jax
+
+import jax.numpy as jnp
+
+
+def main():
+    from easydist_tpu.jaxfront import make_device_mesh
+    from easydist_tpu.parallel import PipelineConfig, spmd_pipeline
+    from easydist_tpu.parallel.pipeline import stack_stage_params
+
+    S, M, mb, d = 4, 8, 4, 128
+    mesh = make_device_mesh((S, 2), ("pp", "dp"),
+                            devices=jax.devices()[:S * 2])
+
+    def stage_fn(p, x):
+        h = jnp.tanh(x @ p["w1"])
+        return x + h @ p["w2"]
+
+    keys = jax.random.split(jax.random.PRNGKey(0), S)
+    stages = [{"w1": jax.random.normal(k, (d, 4 * d)) / jnp.sqrt(d),
+               "w2": jax.random.normal(k, (4 * d, d)) / jnp.sqrt(4 * d)}
+              for k in keys]
+    stacked = stack_stage_params(stages)
+    x = jax.random.normal(jax.random.PRNGKey(1), (M, mb, d))
+
+    pipe = jax.jit(spmd_pipeline(
+        stage_fn, mesh, PipelineConfig(S, M, data_axis="dp")))
+    out = pipe(stacked, x)
+    print("pipeline output:", out.shape, "finite:", bool(jnp.isfinite(out).all()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""Smallest end-to-end example: one decorator auto-parallelizes a function
+(reference: examples/jax/simple_function.py).
+
+Run on any host:  python examples/jax/simple_function.py
+(uses the 8-device virtual CPU mesh when no TPU is attached)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+if not os.environ.get("EASYDIST_REAL_DEVICES"):
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+        " --xla_force_host_platform_device_count=8"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+else:
+    import jax
+
+import jax.numpy as jnp
+
+from easydist_tpu import easydist_compile
+from easydist_tpu.jaxfront import make_device_mesh
+
+
+@easydist_compile()
+def step(w, x):
+    return jnp.tanh(x @ w).sum()
+
+
+def main():
+    make_device_mesh()  # 1D mesh over every visible device
+    w = jax.random.normal(jax.random.PRNGKey(0), (512, 256))
+    x = jax.random.normal(jax.random.PRNGKey(1), (1024, 512))
+    out = step(w, x)
+    print("result:", float(out))
+    result = step.get_compiled(w, x)
+    print("input shardings:", [str(s.spec) for s in result.in_shardings])
+
+
+if __name__ == "__main__":
+    main()
