@@ -155,7 +155,7 @@ class SignatureMismatch(Exception):
 class CompileResult:
 
     def __init__(self, jitted, tree_jitted, in_shardings, strategies, graph,
-                 mesh, in_tree, out_tree, n_flat_in):
+                 mesh, in_tree, out_tree, n_flat_in, in_avals=None):
         self.jitted = jitted  # flat calling convention (driver/debug use)
         self.tree_jitted = tree_jitted  # pytree convention (steady state)
         self.in_shardings = in_shardings
@@ -165,6 +165,15 @@ class CompileResult:
         self.in_tree = in_tree
         self.out_tree = out_tree
         self.n_flat_in = n_flat_in
+        self.in_avals = in_avals or []
+        self._executable = None
+
+    def executable(self):
+        """Lower + compile the flat function (cached) — the object carrying
+        XLA cost_analysis()/memory_analysis()."""
+        if self._executable is None:
+            self._executable = self.jitted.lower(*self.in_avals).compile()
+        return self._executable
 
 
 def _axis_solve_order(axis_specs):
@@ -328,8 +337,11 @@ def compile_step(func, args, kwargs, mesh=None, state_io="auto",
             base += n
     tree_jitted = jax.jit(tree_fn, donate_argnums=tuple(donate_args))
 
+    in_avals = [jax.ShapeDtypeStruct(v.aval.shape, v.aval.dtype)
+                for v in jaxpr.invars]
     return CompileResult(jitted, tree_jitted, in_shardings, per_axis_final,
-                         graph, mesh, in_tree, out_tree, len(flat_args))
+                         graph, mesh, in_tree, out_tree, len(flat_args),
+                         in_avals=in_avals)
 
 
 class CompiledFunction:

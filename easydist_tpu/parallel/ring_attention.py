@@ -53,7 +53,10 @@ def _ring_attention_local(q, k, v, axis: str, causal: bool, scale: float):
             mask = k_pos[None, None, None, :] <= q_pos[None, None, :, None]
         else:
             mask = jnp.ones((1, 1, t_local, t_local), bool)
-        o_b, m_b, l_b = _block_attn(q, k_blk, v_blk, mask, scale)
+        # rotate k/v in their input dtype (half the ICI bytes for bf16);
+        # accumulate in f32 per block
+        o_b, m_b, l_b = _block_attn(q, k_blk.astype(jnp.float32),
+                                    v_blk.astype(jnp.float32), mask, scale)
 
         m_new = jnp.maximum(m_acc, m_b)
         alpha = jnp.exp(m_acc - m_new)
@@ -70,9 +73,8 @@ def _ring_attention_local(q, k, v, axis: str, causal: bool, scale: float):
     o0 = jnp.zeros((b, h, t, d), jnp.float32)
     m0 = jnp.full((b, h, t), -1e30 / 2, jnp.float32)
     l0 = jnp.zeros((b, h, t), jnp.float32)
-    (o, m, l, _, _), _ = jax.lax.scan(
-        step, (o0, m0, l0, k.astype(jnp.float32), v.astype(jnp.float32)),
-        jnp.arange(n))
+    (o, m, l, _, _), _ = jax.lax.scan(step, (o0, m0, l0, k, v),
+                                      jnp.arange(n))
     out = o / jnp.maximum(l[..., None], 1e-30)
     return out.astype(q.dtype)
 

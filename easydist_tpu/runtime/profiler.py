@@ -18,10 +18,17 @@ import jax
 from .perfdb import PerfDB
 
 
+def _as_executable(compiled):
+    """Accepts a jax Compiled object or our CompileResult."""
+    if hasattr(compiled, "executable"):  # CompileResult
+        return compiled.executable()
+    return compiled
+
+
 def op_cost_analysis(compiled) -> Dict[str, float]:
     """FLOPs / bytes-accessed / estimated seconds from XLA for a compiled
     function (jax `Compiled` object or our CompileResult)."""
-    compiled = getattr(compiled, "jitted", compiled)
+    compiled = _as_executable(compiled)
     if hasattr(compiled, "cost_analysis"):
         cost = compiled.cost_analysis()
     else:
@@ -33,7 +40,7 @@ def op_cost_analysis(compiled) -> Dict[str, float]:
 
 def memory_analysis(compiled) -> Dict[str, int]:
     """Per-device memory breakdown of the compiled executable."""
-    compiled = getattr(compiled, "jitted", compiled)
+    compiled = _as_executable(compiled)
     mem = compiled.memory_analysis()
     out = {}
     for attr in ("temp_size_in_bytes", "argument_size_in_bytes",

@@ -185,6 +185,8 @@ def _conv2d(x, w, bias=None, stride=(1, 1), padding=(0, 0), dilation=(1, 1),
     groups = 1
     if rest:
         if len(rest) >= 3:  # convolution.default: transposed, output_padding, groups
+            if rest[0]:
+                raise UnsupportedAtenOp("transposed convolution")
             groups = rest[2]
         else:
             groups = rest[0]
@@ -330,7 +332,18 @@ def _select(x, dim, index):
 
 @register_aten("aten.expand.default")
 def _expand(x, sizes, implicit=False):
-    shape = [x.shape[i] if s == -1 else s for i, s in enumerate(sizes)]
+    # torch aligns sizes from the RIGHT; extra leading entries add new dims
+    offset = len(sizes) - x.ndim
+    shape = []
+    for i, s in enumerate(sizes):
+        src = i - offset
+        if s == -1:
+            if src < 0:
+                raise UnsupportedAtenOp("expand: -1 in a new leading dim")
+            shape.append(x.shape[src])
+        else:
+            shape.append(s)
+    x = x.reshape((1,) * offset + x.shape) if offset > 0 else x
     return jnp.broadcast_to(x, tuple(shape))
 
 

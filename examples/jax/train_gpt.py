@@ -12,13 +12,10 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 
 if not os.environ.get("EASYDIST_REAL_DEVICES"):
-    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
-        " --xla_force_host_platform_device_count=8"
-    import jax
+    from easydist_tpu.utils.testing import force_cpu_devices
 
-    jax.config.update("jax_platforms", "cpu")
-else:
-    import jax
+    force_cpu_devices(8)
+import jax  # noqa: E402
 
 
 def main():
@@ -53,7 +50,12 @@ def main():
                          data(), args.ckpt, total_steps=args.steps,
                          checkpoint_every=5,
                          on_step=lambda s, l: losses.append(float(l)))
-    print(f"trained {args.steps} steps; loss {losses[0]:.3f} -> {losses[-1]:.3f}")
+    if losses:
+        print(f"trained {len(losses)} steps; "
+              f"loss {losses[0]:.3f} -> {losses[-1]:.3f}")
+    else:
+        print(f"checkpoint already at step {args.steps}; nothing to do "
+              f"(state restored OK)")
 
 
 if __name__ == "__main__":

@@ -11,13 +11,10 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 
 if not os.environ.get("EASYDIST_REAL_DEVICES"):
-    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
-        " --xla_force_host_platform_device_count=8"
-    import jax
+    from easydist_tpu.utils.testing import force_cpu_devices
 
-    jax.config.update("jax_platforms", "cpu")
-else:
-    import jax
+    force_cpu_devices(8)
+import jax  # noqa: E402
 
 import jax.numpy as jnp
 import torch

@@ -105,3 +105,14 @@ def test_elastic_resume(tmp_path):
                         checkpoint_every=3)
     assert int(final["n"]) == 10
     np.testing.assert_allclose(np.asarray(final["w"]), 10 * np.ones(4))
+
+
+def test_cost_analysis_on_compile_result(cpu_devices):
+    from easydist_tpu.jaxfront import easydist_compile, make_device_mesh
+
+    mesh = make_device_mesh((8,), ("d",))
+    compiled = easydist_compile(lambda a, b: a @ b, mesh=mesh)
+    res = compiled.get_compiled(jnp.ones((16, 8)), jnp.ones((8, 16)))
+    cost = op_cost_analysis(res)
+    assert cost.get("flops", 0) > 0
+    assert memory_analysis(res)

@@ -125,3 +125,27 @@ def test_torch_train_step_auto_parallel(mesh):
     for name, leaf in new_params.items():
         np.testing.assert_allclose(np.asarray(leaf), ref_sd[name],
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_expand_right_aligned():
+    class Expander(nn.Module):
+        def forward(self, x):
+            return x.unsqueeze(0).expand(3, -1, -1) * 1.0
+
+    assert_matches_torch(Expander(), (torch.randn(4, 5),))
+
+
+def test_transposed_conv_raises():
+    from easydist_tpu.torchfront.convert import UnsupportedAtenOp
+
+    class TConv(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.tc = nn.ConvTranspose2d(3, 8, 2, stride=2)
+
+        def forward(self, x):
+            return self.tc(x)
+
+    fn, params = torch_module_to_jax(TConv(), (torch.randn(1, 3, 4, 4),))
+    with pytest.raises((UnsupportedAtenOp, NotImplementedError)):
+        fn(params, jnp.zeros((1, 3, 4, 4)))
