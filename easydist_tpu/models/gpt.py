@@ -26,6 +26,11 @@ class GPTConfig:
     heads: int = 12
     layers: int = 12
     dtype: str = "float32"  # compute dtype; params stay float32
+    # attention backend: "einsum" (XLA), "flash" (Pallas kernel), or
+    # "ring" (sequence-parallel ring attention; needs attn_mesh + attn_axis)
+    attention: str = "einsum"
+    attn_mesh: object = None
+    attn_axis: str = "sp"
 
     @staticmethod
     def small(**kw):
@@ -77,7 +82,8 @@ def _layernorm(x, g, b, eps=1e-5):
     return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
 
 
-def _attention(x, p, heads, dtype):
+def _attention(x, p, cfg: "GPTConfig", dtype):
+    heads = cfg.heads
     b, t, d = x.shape
     hd = d // heads
     qkv = x @ p["qkv"]["w"].astype(dtype) + p["qkv"]["b"].astype(dtype)
@@ -87,12 +93,22 @@ def _attention(x, p, heads, dtype):
         return t_.reshape(b, t, heads, hd).transpose(0, 2, 1, 3)
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
-    att = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
-    qi = jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
-    ki = jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
-    att = jnp.where(ki <= qi, att, jnp.array(-1e9, dtype=att.dtype))
-    att = jax.nn.softmax(att, axis=-1)
-    out = jnp.einsum("bhqk,bhkd->bhqd", att, v)
+    if cfg.attention == "flash":
+        from easydist_tpu.ops import flash_attention
+
+        out = flash_attention(q, k, v, True)
+    elif cfg.attention == "ring":
+        from easydist_tpu.parallel import ring_attention
+
+        out = ring_attention(q, k, v, cfg.attn_mesh, axis=cfg.attn_axis,
+                             causal=True)
+    else:
+        att = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
+        qi = jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
+        ki = jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
+        att = jnp.where(ki <= qi, att, jnp.array(-1e9, dtype=att.dtype))
+        att = jax.nn.softmax(att, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", att, v)
     out = out.transpose(0, 2, 1, 3).reshape(b, t, d)
     return out @ p["proj"]["w"].astype(dtype) + p["proj"]["b"].astype(dtype)
 
@@ -104,7 +120,7 @@ def gpt_apply(params, cfg: GPTConfig, tokens):
     for blk in params["blocks"]:
         x = x + _attention(
             _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"]).astype(dtype),
-            blk["attn"], cfg.heads, dtype)
+            blk["attn"], cfg, dtype)
         h = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"]).astype(dtype)
         h = jax.nn.gelu(h @ blk["mlp"]["fc"]["w"].astype(dtype)
                         + blk["mlp"]["fc"]["b"].astype(dtype))

@@ -125,3 +125,18 @@ def test_gat_tiny(mesh):
     np.testing.assert_allclose(float(got_loss), float(ref_loss),
                                rtol=1e-3, atol=1e-5)
     _tree_allclose(got_params, ref_params, rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.world_8
+def test_gpt_flash_attention_matches_einsum(mesh):
+    cfg_e = GPTConfig.tiny()
+    cfg_f = GPTConfig.tiny(attention="flash")
+    params = gpt_init(cfg_e, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, cfg_e.seq), 0,
+                                cfg_e.vocab)
+    from easydist_tpu.models.gpt import gpt_apply
+
+    out_e = gpt_apply(params, cfg_e, tokens)
+    out_f = gpt_apply(params, cfg_f, tokens)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_e),
+                               rtol=1e-3, atol=1e-4)
