@@ -146,6 +146,24 @@ def emit_sharded_fn(closed_jaxpr, names: VarNames,
     return sharded_fn
 
 
+def _dump_strategies(graph, per_axis, axis_names):
+    """Write MetaIR + solved strategies into edconfig.dump_dir (reference
+    DUMP_STRATEGY/DUMP_CLUSTER flags, config.py and metair.py:933-939)."""
+    import os
+
+    os.makedirs(edconfig.dump_dir, exist_ok=True)
+    if graph is not None:
+        with open(os.path.join(edconfig.dump_dir, "metair.txt"), "w") as f:
+            f.write(repr(graph))
+    with open(os.path.join(edconfig.dump_dir, "strategies.txt"), "w") as f:
+        names = sorted({n for chosen in per_axis for n in chosen})
+        for name in names:
+            parts = [f"{ax}: {chosen.get(name)}"
+                     for ax, chosen in zip(axis_names, per_axis)]
+            f.write(f"{name}\n  " + "\n  ".join(parts) + "\n")
+    logger.info("strategies dumped to %s", edconfig.dump_dir)
+
+
 # ----------------------------------------------------------------- compiler
 
 class SignatureMismatch(Exception):
@@ -278,6 +296,9 @@ def compile_step(func, args, kwargs, mesh=None, state_io="auto",
 
     axis_names = [s.name for s in axis_specs]
     per_axis_final = [c if c is not None else {} for c in per_axis]
+
+    if edconfig.dump_dir:
+        _dump_strategies(graph, per_axis_final, axis_names)
 
     # ---- input shardings from placeholder strategies
     in_shardings = []

@@ -541,3 +541,14 @@ def _create_rule(eqn, world_size):
     """No tensor inputs to shard; output stays replicated (consumers slice
     for free under GSPMD)."""
     return {"space": ShardSpace([]), "recombines": {}}
+
+
+@register_preset("sharding_constraint")
+def _sharding_constraint_rule(eqn, world_size):
+    """User with_sharding_constraint markers pass through the solver as
+    freely shardable identity ops; XLA enforces the user's constraint at
+    emission (the scope_auto analog — reference easydist/scope_auto)."""
+    (aval,) = _tensor_avals(eqn)
+    row = [DimSharding(group=d + 1) for d in range(aval.ndim)]
+    recombines = {d + 1: _concat(d) for d in range(aval.ndim)}
+    return {"space": ShardSpace([row]), "recombines": recombines}

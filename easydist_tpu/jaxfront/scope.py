@@ -1,0 +1,28 @@
+"""User-directed sharding scopes (reference: easydist/scope_auto — scope
+markers grouping regions for per-scope strategies).
+
+`fix_sharding(x, *axes)` pins a tensor's placement inside a compiled step;
+the solver routes strategies around it and XLA enforces it.  This is the
+manual-override escape hatch when the automatic plan should be constrained
+(e.g. force megatron-style weight sharding for one layer).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .mesh import get_device_mesh
+
+
+def fix_sharding(x, *spec_entries, mesh=None):
+    """Pin `x` to PartitionSpec(*spec_entries) on the (global) mesh.
+
+    Works inside functions decorated with `easydist_compile` and in plain
+    jitted code alike.
+    """
+    mesh = mesh or get_device_mesh()
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, PartitionSpec(*spec_entries)))

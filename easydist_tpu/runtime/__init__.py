@@ -10,3 +10,4 @@ from .checkpoint import save_checkpoint, load_checkpoint, latest_step  # noqa: F
 from .perfdb import PerfDB  # noqa: F401
 from .profiler import profile_compiled, op_cost_analysis, memory_analysis  # noqa: F401
 from .elastic import run_training, multihost_setup  # noqa: F401
+from .data import TokenLoader  # noqa: F401

@@ -1,0 +1,89 @@
+"""High-throughput token data loading backed by the native C++ loader.
+
+Memory-mapped token files (the llm.c / nanoGPT .bin convention: flat
+uint16/uint32 tokens) are sampled into [batch, seq+1] windows by a C++
+prefetch thread, so host input preparation overlaps device steps — the
+host-IO role the reference delegates to its C++ memory machinery.  Falls
+back to a numpy implementation when the native lib is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from easydist_tpu import native
+
+
+class TokenLoader:
+    """Random-window sampler over a flat binary token file."""
+
+    def __init__(self, path: str, batch: int, seq: int,
+                 token_bytes: int = 2, prefetch: int = 4, seed: int = 0):
+        self.path = path
+        self.batch = batch
+        self.seq = seq
+        self.window = seq + 1
+        self.token_bytes = token_bytes
+        self._handle = None
+        self._np_tokens: Optional[np.ndarray] = None
+        self._rng = np.random.default_rng(seed)
+
+        lib = native.get_lib()
+        if lib is not None:
+            if not hasattr(lib, "ed_loader_open"):
+                lib = None
+            else:
+                lib.ed_loader_open.restype = ctypes.c_void_p
+                lib.ed_loader_open.argtypes = [
+                    ctypes.c_char_p, ctypes.c_int, ctypes.c_int64,
+                    ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64]
+                lib.ed_loader_next.restype = ctypes.c_int
+                lib.ed_loader_next.argtypes = [
+                    ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32)]
+                lib.ed_loader_num_tokens.restype = ctypes.c_int64
+                lib.ed_loader_num_tokens.argtypes = [ctypes.c_void_p]
+                lib.ed_loader_close.argtypes = [ctypes.c_void_p]
+        self._lib = lib
+        if lib is not None:
+            self._handle = lib.ed_loader_open(
+                path.encode(), token_bytes, batch, self.window, prefetch, seed)
+        if self._handle is None:
+            dtype = np.uint16 if token_bytes == 2 else np.int32
+            self._np_tokens = np.memmap(path, dtype=dtype, mode="r")
+
+    @property
+    def n_tokens(self) -> int:
+        if self._handle is not None:
+            return int(self._lib.ed_loader_num_tokens(self._handle))
+        return len(self._np_tokens)
+
+    def next_batch(self) -> np.ndarray:
+        """[batch, seq+1] int32 window samples."""
+        if self._handle is not None:
+            out = np.empty((self.batch, self.window), dtype=np.int32)
+            self._lib.ed_loader_next(
+                self._handle, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+            return out
+        starts = self._rng.integers(0, self.n_tokens - self.window,
+                                    self.batch)
+        return np.stack([self._np_tokens[s:s + self.window]
+                         for s in starts]).astype(np.int32)
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        while True:
+            w = self.next_batch()
+            yield w[:, :-1], w[:, 1:]
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.ed_loader_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

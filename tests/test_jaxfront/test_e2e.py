@@ -152,3 +152,20 @@ def test_beam_solver_end_to_end(mesh_1d):
                                    rtol=1e-4, atol=1e-6)
     finally:
         edconfig.solver_backend = "milp"
+
+
+@pytest.mark.world_8
+def test_fix_sharding_scope(mesh_1d):
+    """User-pinned shardings survive the auto-parallel pipeline."""
+    from easydist_tpu.jaxfront import fix_sharding
+
+    def fwd(w, x):
+        w = fix_sharding(w, None, "d")  # force column sharding
+        return jnp.tanh(x @ w)
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (16, 32))
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 16))
+    compiled = easydist_compile(fwd, mesh=mesh_1d)
+    got = compiled(w, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(jnp.tanh(x @ w)),
+                               rtol=1e-5, atol=1e-6)
