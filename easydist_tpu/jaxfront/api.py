@@ -211,8 +211,11 @@ def compile_step(func, args, kwargs, mesh=None, state_io="auto",
     axis_specs = get_axis_specs(mesh)
 
     t0 = time.perf_counter()
-    closed_jaxpr, out_shape = jax.make_jaxpr(func, return_shape=True)(
-        *args, **kwargs)
+    from .scope import _compile_mesh_ctx
+
+    with _compile_mesh_ctx(mesh):
+        closed_jaxpr, out_shape = jax.make_jaxpr(func, return_shape=True)(
+            *args, **kwargs)
     from .inline import inline_calls
 
     closed_jaxpr = inline_calls(closed_jaxpr)

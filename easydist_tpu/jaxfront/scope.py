@@ -14,14 +14,34 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from .mesh import get_device_mesh
 
+# mesh being compiled right now (set by compile_step around tracing), so
+# fix_sharding inside a step targets the step's mesh even when the global
+# mesh points elsewhere
+_COMPILE_MESH = None
+
+
+class _compile_mesh_ctx:
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        global _COMPILE_MESH
+        self._prev = _COMPILE_MESH
+        _COMPILE_MESH = self.mesh
+
+    def __exit__(self, *exc):
+        global _COMPILE_MESH
+        _COMPILE_MESH = self._prev
+
 
 def fix_sharding(x, *spec_entries, mesh=None):
-    """Pin `x` to PartitionSpec(*spec_entries) on the (global) mesh.
+    """Pin `x` to PartitionSpec(*spec_entries) on the current mesh
+    (the mesh under compilation, else the global mesh).
 
     Works inside functions decorated with `easydist_compile` and in plain
     jitted code alike.
     """
-    mesh = mesh or get_device_mesh()
+    mesh = mesh or _COMPILE_MESH or get_device_mesh()
     if mesh is None:
         return x
     return jax.lax.with_sharding_constraint(
