@@ -1,0 +1,69 @@
+"""Reachability map: comm/compute-overlap awareness for the cost model.
+
+Reference: easydist/torch/reachability.py (bitarray transitive closure +
+FlopCounterMode) feeding the overlap discount in solver.py:74-84 — a
+resharding collective whose producer and consumer have heavy *independent*
+compute nearby can overlap with that compute, so its effective cost shrinks
+by `comm_overlap_ratio`.
+
+The closure is a dense numpy bool matrix (row i = descendants of op i;
+column i = its ancestors), built in one reverse-topological vectorized
+sweep; per-edge independent FLOPs are then single vectorized masks."""
+
+from __future__ import annotations
+
+import math
+from typing import Dict
+
+import numpy as np
+
+from easydist_tpu.metashard.metair import MetaGraph, MetaNode
+
+_HEAVY_OPS = {"dot_general", "conv_general_dilated", "matmul", "mm", "bmm",
+              "dot"}
+
+
+def _node_flops(node: MetaNode) -> float:
+    if node.op_key not in _HEAVY_OPS:
+        return 0.0
+    out_elems = sum(math.prod(v.shape) for v in node.outvars if v is not None)
+    # contraction length ~ largest input size over output size
+    in_elems = max((math.prod(v.shape) for v in node.invars if v is not None),
+                   default=0)
+    k = max(in_elems / max(out_elems, 1), 1.0)
+    return 2.0 * out_elems * min(k, in_elems)
+
+
+class ReachabilityMap:
+    """Transitive closure over graph ops + per-edge independent peer FLOPs."""
+
+    def __init__(self, graph: MetaGraph):
+        ops = graph.ops
+        n = len(ops)
+        self.index: Dict[str, int] = {op.name: i for i, op in enumerate(ops)}
+        self.flops = np.array([_node_flops(op) for op in ops])
+
+        reach = np.zeros((n, n), dtype=bool)
+        for i in reversed(range(n)):
+            reach[i, i] = True
+            for v in ops[i].outvars:
+                if v is None:
+                    continue
+                for consumer, _ in v.consumers:
+                    j = self.index.get(consumer.name)
+                    if j is not None and j != i:
+                        reach[i] |= reach[j]
+        self.reach = reach
+        self.n = n
+
+    def independent_peer_flops(self, producer: str, consumer: str) -> float:
+        """FLOPs of ops independent of both endpoints (neither ancestor nor
+        descendant of either) — work a collective between them could hide
+        behind."""
+        i = self.index.get(producer)
+        j = self.index.get(consumer)
+        if i is None or j is None or self.n == 0:
+            return 0.0
+        related = (self.reach[i] | self.reach[j]
+                   | self.reach[:, i] | self.reach[:, j])
+        return float(self.flops[~related].sum())

@@ -70,9 +70,11 @@ class _Edge:
 class SpmdSolver:
     """Solve one mesh axis for a coarsened MetaGraph."""
 
-    def __init__(self, graph: MetaGraph, axis: MeshAxisSpec):
+    def __init__(self, graph: MetaGraph, axis: MeshAxisSpec,
+                 reachability=None):
         self.graph = graph
         self.axis = axis
+        self.reachability = reachability
         self.clusters = graph.clusters
         self.edges: List[_Edge] = []
         self._collect_edges()
@@ -140,6 +142,13 @@ class SpmdSolver:
                     comm[i, j] = resharding_cost(size, pu, pd, self.axis)
                     mem[i, j] = (placement_bytes(size, pu, self.axis.size)
                                  + placement_bytes(size, pd, self.axis.size))
+            if self.reachability is not None and edconfig.predict_comm_overlap:
+                # overlap-capable collectives cost less (reference
+                # adjust_resharding_cost, solver.py:79-84)
+                peer = self.reachability.independent_peer_flops(
+                    e.up_node.name, e.down_node.name)
+                if peer > 0:
+                    comm = comm * (1.0 - edconfig.comm_overlap_ratio)
             e.comm, e.mem = comm, mem
 
     # ----------------------------------------------------------------- solve

@@ -61,6 +61,10 @@ solver_time_limit = _env_float("EASYDIST_SOLVER_TIME_LIMIT", 60.0)
 all_to_all_punish_factor = _env_float("EASYDIST_ALL_TO_ALL_PUNISH", 3.0)
 # allow re-picking a strategy already chosen on a previous mesh axis
 allow_repeated_axis_strategy = _env_bool("EASYDIST_ALLOW_REPEATED_AXIS_STRATEGY", False)
+# discount resharding cost when independent compute can hide the collective
+# (reference predict_comm_overlap + comm_overlap_ratio, solver.py:74-84)
+predict_comm_overlap = _env_bool("EASYDIST_PREDICT_COMM_OVERLAP", False)
+comm_overlap_ratio = _env_float("EASYDIST_COMM_OVERLAP_RATIO", 0.5)
 # memory-aware solving: weight on per-device memory in the objective
 mem_cost_weight = _env_float("EASYDIST_MEM_COST_WEIGHT", 1e-8)
 # hard per-device memory cap in bytes (0 = unconstrained); v5e has 16 GiB HBM

@@ -278,7 +278,12 @@ def compile_step(func, args, kwargs, mesh=None, state_io="auto",
 
         graph.coarsen(axis.size, level=edconfig.coarsen_level,
                       exclude_map=exclude_map)
-        solver = SpmdSolver(graph, axis)
+        reach = None
+        if edconfig.predict_comm_overlap:
+            from easydist_tpu.autoflow.reachability import ReachabilityMap
+
+            reach = ReachabilityMap(graph)
+        solver = SpmdSolver(graph, axis, reachability=reach)
         chosen = solver.solve()
         per_axis[axis_idx] = chosen
         prev_chosen.append(chosen)

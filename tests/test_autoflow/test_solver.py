@@ -125,3 +125,40 @@ def test_memory_cap_forces_sharding():
                    if s.out_placements and s.out_placements[0] is not None)
     finally:
         edconfig.per_device_memory_cap = 0
+
+
+def test_reachability_overlap():
+    from easydist_tpu.autoflow.reachability import ReachabilityMap
+
+    # two independent matmul chains joined at the end: an edge inside one
+    # chain has the other chain's matmuls as independent peer flops
+    g = MetaGraph("two_chains")
+    nx1, vx1 = placeholder("x1", (64, 32))
+    nx2, vx2 = placeholder("x2", (64, 32))
+    nw, vw = placeholder("w", (32, 32))
+    for n in (nx1, nx2, nw):
+        g.add_input(n)
+    a1, va1 = matmul_node("a1", vx1, vw, (64, 32))
+    a2, va2 = matmul_node("a2", va1, vw, (64, 32))
+    b1, vb1 = matmul_node("b1", vx2, vw, (64, 32))
+    join, vj = matmul_node("join", va2, vb1, None or (64, 64))
+    for n in (a1, b1, a2, join):
+        g.add_op(n)
+    g.outputs.append(vj)
+
+    rm = ReachabilityMap(g)
+    # a1 -> a2 edge: b1 is independent (parallel chain)
+    assert rm.independent_peer_flops("a1", "a2") > 0
+    # a1 -> join: everything else is an ancestor of join; nothing independent
+    assert rm.independent_peer_flops("a2", "join") == 0
+
+    import easydist_tpu.config as edconfig
+
+    edconfig.predict_comm_overlap = True
+    try:
+        g.coarsen(AXIS.size, level=0)
+        solver = SpmdSolver(g, AXIS, reachability=rm)
+        chosen = solver.solve()
+        assert chosen  # solves fine with the discount active
+    finally:
+        edconfig.predict_comm_overlap = False
