@@ -7,6 +7,6 @@ Everything is expressed as compiled collective programs (`shard_map` +
 """
 
 from .pipeline import spmd_pipeline, PipelineConfig  # noqa: F401
-from .dp import ddp_step, zero_shard_params, zero2_step  # noqa: F401
+from .dp import ddp_step, zero_shard_params, zero2_step, zero3_step  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401
 from .ulysses import ulysses_attention  # noqa: F401

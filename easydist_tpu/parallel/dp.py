@@ -8,9 +8,8 @@ surgery over NCCL ops:
   ddp    — batch sharded, params replicated, grads pmean'd
   zero2  — + optimizer state sharded over dp: reduce_scatter grads, update
            the local shard, all_gather updated params
-  zero3  — fully sharded params too: handled by running zero2 with params
-           stored sharded and gathered inside the step (XLA does the
-           gather/free scheduling)
+  zero3  — fully sharded params AND moments: per-step all_gather of
+           params for fw/bw, reduce_scatter grads, shard-local Adam
 """
 
 from __future__ import annotations
@@ -60,6 +59,102 @@ def zero_shard_params(params, mesh, axis: str = "dp"):
         return jax.device_put(p, NamedSharding(mesh, P()))
 
     return jax.tree_util.tree_map(place, params)
+
+
+def zero3_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2,
+               b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8):
+    """Adam ZeRO-3: parameters AND optimizer moments sharded over dp.
+
+    Params live sharded on dim 0; each step all_gathers them for the
+    forward/backward (XLA schedules gather/free per layer), reduce_scatters
+    grads, and updates only the local shard (reference transform_fsdp
+    shard_param=True, compile_dp.py:93-123).  Leaves that do not divide the
+    axis stay replicated with pmean'd grads.
+
+    Returns (step, init_state): state = (sharded_params, opt, count);
+    step(state, *batch) -> (state, loss).
+    """
+    n = mesh.shape[axis]
+
+    def shardable(p):
+        return p.ndim > 0 and p.shape[0] % n == 0
+
+    def init_state(params):
+        def shard(p):
+            if shardable(p):
+                return jax.device_put(p, NamedSharding(mesh, P(axis)))
+            return jax.device_put(p, NamedSharding(mesh, P()))
+
+        sharded = jax.tree_util.tree_map(shard, params)
+        def moment(p):
+            return jnp.zeros_like(p)
+
+        opt = {"mu": jax.tree_util.tree_map(moment, sharded),
+               "nu": jax.tree_util.tree_map(moment, sharded)}
+        return (sharded, opt, jnp.zeros((), jnp.int32))
+
+    # local_step needs static knowledge of which leaves are sharded; build
+    # it per params structure via a factory
+    def make_step(shard_flags, tdef):
+        def local_step(flat_ps, flat_mu, flat_nu, count, *batch):
+            full = [jax.lax.all_gather(p, axis, axis=0, tiled=True)
+                    if flag else p
+                    for p, flag in zip(flat_ps, shard_flags)]
+            params = jax.tree_util.tree_unflatten(tdef, full)
+            loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+            loss = jax.lax.pmean(loss, axis)
+            count = count + 1
+            c1 = 1 - b1 ** count.astype(jnp.float32)
+            c2 = 1 - b2 ** count.astype(jnp.float32)
+            flat_g = jax.tree_util.tree_flatten(grads)[0]
+            new_p, new_m, new_v = [], [], []
+            for p_shard, g, m, v, flag in zip(flat_ps, flat_g, flat_mu,
+                                              flat_nu, shard_flags):
+                if flag:
+                    g = jax.lax.psum_scatter(g, axis, scatter_dimension=0,
+                                             tiled=True) / n
+                else:
+                    g = jax.lax.pmean(g, axis)
+                m = b1 * m + (1 - b1) * g
+                v = b2 * v + (1 - b2) * g * g
+                new_p.append(p_shard - lr * (m / c1) / (jnp.sqrt(v / c2) + eps))
+                new_m.append(m)
+                new_v.append(v)
+            return tuple(new_p), tuple(new_m), tuple(new_v), count, loss
+
+        return local_step
+
+    def step(state, *batch):
+        params_shards, opt, count = state
+        flat_p, tdef = jax.tree_util.tree_flatten(params_shards)
+        # a leaf is sharded iff its global dim0 divides the axis; after
+        # init_state the leaf still has GLOBAL shape (sharded array), so
+        # shardable() applies directly
+        shard_flags = tuple(shardable(p) for p in flat_p)
+        local = make_step(shard_flags, tdef)
+
+        def spec_for(p, flag):
+            return P(axis) if flag else P()
+
+        p_specs = [spec_for(p, f) for p, f in zip(flat_p, shard_flags)]
+        b_spec = tuple(P(axis) for _ in batch)
+        fn = shard_map(
+            local, mesh=mesh,
+            in_specs=(tuple(p_specs), tuple(p_specs), tuple(p_specs), P())
+            + b_spec,
+            out_specs=(tuple(p_specs), tuple(p_specs), tuple(p_specs), P(),
+                       P()),
+            check_rep=False)
+        flat_mu = jax.tree_util.tree_flatten(opt["mu"])[0]
+        flat_nu = jax.tree_util.tree_flatten(opt["nu"])[0]
+        new_p, new_m, new_v, count, loss = fn(tuple(flat_p), tuple(flat_mu),
+                                              tuple(flat_nu), count, *batch)
+        params = jax.tree_util.tree_unflatten(tdef, list(new_p))
+        opt = {"mu": jax.tree_util.tree_unflatten(tdef, list(new_m)),
+               "nu": jax.tree_util.tree_unflatten(tdef, list(new_v))}
+        return (params, opt, count), loss
+
+    return jax.jit(step), init_state
 
 
 def zero2_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2,
