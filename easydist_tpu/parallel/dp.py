@@ -19,7 +19,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 
@@ -42,7 +42,7 @@ def ddp_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2):
         fn = shard_map(local_step, mesh=mesh,
                        in_specs=(p_spec,) + b_spec,
                        out_specs=(p_spec, P()),
-                       check_rep=False)
+                       check_vma=False)
         return fn(params, *batch)
 
     return jax.jit(step)
@@ -144,7 +144,7 @@ def zero3_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2,
             + b_spec,
             out_specs=(tuple(p_specs), tuple(p_specs), tuple(p_specs), P(),
                        P()),
-            check_rep=False)
+            check_vma=False)
         flat_mu = jax.tree_util.tree_flatten(opt["mu"])[0]
         flat_nu = jax.tree_util.tree_flatten(opt["nu"])[0]
         new_p, new_m, new_v, count, loss = fn(tuple(flat_p), tuple(flat_mu),
@@ -230,7 +230,7 @@ def zero2_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2,
         fn = shard_map(local_step, mesh=mesh,
                        in_specs=(p_spec, m_spec, m_spec, P()) + b_spec,
                        out_specs=(p_spec, m_spec, m_spec, P(), P()),
-                       check_rep=False)
+                       check_vma=False)
         new_params, mu, nu, count, loss = fn(params, opt["mu"], opt["nu"],
                                              count, *batch)
         return (new_params, {"mu": mu, "nu": nu}, count), loss

@@ -15,7 +15,7 @@ from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 
@@ -104,7 +104,7 @@ def moe_layer(params: Dict, x, mesh, cfg: MoEConfig,
         mesh=mesh,
         in_specs=(P(axis), P(), P(axis), P(axis)),
         out_specs=(P(axis), P()),
-        check_rep=False)
+        check_vma=False)
     return fn(x, params["router"], params["w_in"], params["w_out"])
 
 

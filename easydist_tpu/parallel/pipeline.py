@@ -25,7 +25,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 
@@ -88,7 +88,7 @@ def spmd_pipeline(stage_fn: Callable, mesh, config: PipelineConfig):
         @functools.partial(shard_map, mesh=mesh,
                            in_specs=(param_specs, data_spec),
                            out_specs=data_spec,
-                           check_rep=False)
+                           check_vma=False)
         def run(params, x_mb):
             stage_id = jax.lax.axis_index(axis)
             local = jax.tree_util.tree_map(lambda p: p[0], params)
