@@ -1,0 +1,116 @@
+"""Benchmark case definitions (reference: benchmark/bench_case.py:5-25 —
+GPT bs4 seq1024 d12288 h48 L1; wide-ResNet bs128; GAT 4096x12288).
+
+Each case builds (step_fn_or_factory, init_args) at a size scaled for the
+available hardware; `run_benchmarks.py` times easydist-compiled vs hand-jit
+and emits one JSON line per case."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class BenchCase:
+    name: str
+    make: Callable  # () -> (step, state, batch_args, tokens_per_step)
+
+
+def _gpt_case(tpu: bool):
+    from easydist_tpu.models import GPTConfig, make_gpt_train_step
+
+    cfg = (GPTConfig(vocab=50304, seq=512, dim=768, heads=12, layers=12,
+                     dtype="bfloat16") if tpu else GPTConfig.tiny())
+    batch = 8
+
+    def make():
+        step, init_state = make_gpt_train_step(cfg)
+        state = init_state(jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, cfg.seq),
+                                    0, cfg.vocab)
+        return step, state, (tokens, tokens), batch * cfg.seq
+
+    return BenchCase("gpt2_train", make)
+
+
+def _llama_case(tpu: bool):
+    from easydist_tpu.models import LlamaConfig, make_llama_train_step
+
+    cfg = (LlamaConfig(vocab=32000, seq=512, dim=1024, heads=16, kv_heads=8,
+                       layers=8, ffn_dim=2816, dtype="bfloat16")
+           if tpu else LlamaConfig.tiny())
+    batch = 4
+
+    def make():
+        step, init_state = make_llama_train_step(cfg)
+        state = init_state(jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, cfg.seq),
+                                    0, cfg.vocab)
+        return step, state, (tokens, tokens), batch * cfg.seq
+
+    return BenchCase("llama_train", make)
+
+
+def _vit_case(tpu: bool):
+    from easydist_tpu.models import ViTConfig, make_vit_train_step
+
+    cfg = ViTConfig.b16(image=224) if tpu else ViTConfig.tiny()
+    batch = 32 if tpu else 8
+
+    def make():
+        step, init_state = make_vit_train_step(cfg)
+        state = init_state(jax.random.PRNGKey(0))
+        images = jax.random.normal(jax.random.PRNGKey(1),
+                                   (batch, cfg.image, cfg.image, 3))
+        labels = jax.random.randint(jax.random.PRNGKey(2), (batch,), 0,
+                                    cfg.classes)
+        return step, state, (images, labels), batch
+
+    return BenchCase("vit_train", make)
+
+
+def _resnet_case(tpu: bool):
+    from easydist_tpu.models import make_resnet_train_step, resnet_init
+
+    widths = (64, 128, 256, 512) if tpu else (8, 16)
+    batch = 128 if tpu else 8
+    image = 64 if tpu else 8
+
+    def make():
+        params, arch = resnet_init(jax.random.PRNGKey(0), widths=widths,
+                                   blocks_per_stage=2)
+        step = make_resnet_train_step(arch)
+        x = jax.random.normal(jax.random.PRNGKey(1), (batch, image, image, 3))
+        labels = jax.random.randint(jax.random.PRNGKey(2), (batch,), 0, 10)
+        return step, params, (x, labels), batch
+
+    return BenchCase("resnet_train", make)
+
+
+def _gat_case(tpu: bool):
+    from easydist_tpu.models import GATConfig, gat_init, make_gat_train_step
+
+    cfg = GATConfig.bench(nodes=4096, features=4096, hidden=512) if tpu \
+        else GATConfig.tiny()
+
+    def make():
+        params = gat_init(cfg, jax.random.PRNGKey(0))
+        step = make_gat_train_step(cfg)
+        key = jax.random.PRNGKey(1)
+        adj = (jax.random.uniform(key, (cfg.nodes, cfg.nodes)) < 0.01)
+        adj = jnp.maximum(adj.astype(jnp.float32), jnp.eye(cfg.nodes))
+        x = jax.random.normal(jax.random.PRNGKey(2), (cfg.nodes, cfg.features))
+        labels = jax.random.randint(jax.random.PRNGKey(3), (cfg.nodes,), 0,
+                                    cfg.classes)
+        return step, params, (adj, x, labels), cfg.nodes
+
+    return BenchCase("gat_train", make)
+
+
+def all_cases(tpu: bool):
+    return [_gpt_case(tpu), _llama_case(tpu), _vit_case(tpu),
+            _resnet_case(tpu), _gat_case(tpu)]

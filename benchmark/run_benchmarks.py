@@ -1,0 +1,83 @@
+"""Sweep runner: easydist auto-parallel vs hand-jit per benchmark case
+(reference: benchmark/torch/bench_torch.py:50-100 measuring easydist vs
+DDP vs FSDP; here the baseline is XLA-native hand-jit).
+
+python benchmark/run_benchmarks.py [--cases gpt2_train,vit_train]
+Prints one JSON line per case.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+
+def bench_case(case, iters=10):
+    from easydist_tpu.jaxfront import easydist_compile, make_device_mesh
+
+    step, state0, batch, tokens_per_step = case.make()
+    mesh = make_device_mesh()
+
+    def timed(fn, state):
+        out = None
+        for _ in range(3):
+            out = fn(state, *batch)
+            state = out[0]
+        jax.block_until_ready(out[1])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(state, *batch)
+            state = out[0]
+        jax.block_until_ready(out[1])
+        return (time.perf_counter() - t0) / iters
+
+    base = jax.jit(step, donate_argnums=(0,))
+    compiled = easydist_compile(step)
+    ratios, times = [], []
+    for _ in range(3):
+        _, s0a = case.make()[0], case.make()[1]
+        t_base = timed(base, case.make()[1])
+        t_ed = timed(compiled, case.make()[1])
+        ratios.append(t_base / t_ed)
+        times.append(t_ed)
+    ratio = sorted(ratios)[1]
+    t_ed = sorted(times)[1]
+    return {
+        "metric": f"{case.name}_items_per_sec",
+        "value": round(tokens_per_step / t_ed, 1),
+        "unit": "items/s",
+        "vs_baseline": round(ratio, 4),
+    }
+
+
+def main():
+    from benchmark.bench_cases import all_cases
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cases", default=None,
+                    help="comma-separated case names (default: all)")
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    tpu = jax.default_backend() == "tpu"
+    cases = all_cases(tpu)
+    if args.cases:
+        wanted = set(args.cases.split(","))
+        cases = [c for c in cases if c.name in wanted]
+    for case in cases:
+        try:
+            print(json.dumps(bench_case(case, iters=args.iters)), flush=True)
+        except Exception as e:  # keep sweeping
+            print(json.dumps({"metric": case.name, "error": str(e)[:200]}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()
