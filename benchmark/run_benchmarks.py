@@ -42,7 +42,6 @@ def bench_case(case, iters=10):
     compiled = easydist_compile(step)
     ratios, times = [], []
     for _ in range(3):
-        _, s0a = case.make()[0], case.make()[1]
         t_base = timed(base, case.make()[1])
         t_ed = timed(compiled, case.make()[1])
         ratios.append(t_base / t_ed)

@@ -34,7 +34,6 @@ struct Loader {
 
   // prefetch ring
   std::vector<std::vector<int32_t>> ring;
-  std::vector<bool> ready;
   size_t head = 0, tail = 0, count = 0;
   std::mutex mu;
   std::condition_variable cv_produce, cv_consume;
@@ -65,7 +64,6 @@ struct Loader {
       cv_produce.wait(lock, [&] { return stop.load() || count < ring.size(); });
       if (stop.load()) return;
       ring[head].swap(buf);
-      ready[head] = true;
       head = (head + 1) % ring.size();
       ++count;
       cv_consume.notify_one();
@@ -103,7 +101,6 @@ void* ed_loader_open(const char* path, int token_bytes, int64_t batch,
   L->batch = batch;
   L->window = window;
   L->ring.resize(static_cast<size_t>(n_prefetch));
-  L->ready.assign(static_cast<size_t>(n_prefetch), false);
   L->rng.seed(seed);
   L->worker = std::thread([L] { L->produce_loop(); });
   return L;
@@ -117,7 +114,6 @@ int ed_loader_next(void* handle, int32_t* out) {
   L->cv_consume.wait(lock, [&] { return L->count > 0; });
   std::memcpy(out, L->ring[L->tail].data(),
               sizeof(int32_t) * static_cast<size_t>(L->batch) * L->window);
-  L->ready[L->tail] = false;
   L->tail = (L->tail + 1) % L->ring.size();
   --L->count;
   L->cv_produce.notify_one();

@@ -46,17 +46,23 @@ def _eqn_flops(eqn) -> float:
 
 
 def _balanced_splits(flops: Sequence[float], n: int) -> List[int]:
-    """Greedy contiguous split into n groups; returns end indices."""
-    total = sum(flops)
-    target = total / n
-    ends, acc, need = [], 0.0, target
-    for i, f in enumerate(flops):
-        acc += f
-        if acc >= need and len(ends) < n - 1 and i < len(flops) - 1:
-            ends.append(i + 1)
-            need += target
-    while len(ends) < n - 1:
-        ends.append(len(flops) - (n - 1 - len(ends)))
+    """Contiguous split into n non-empty groups at cumulative-FLOP quantiles;
+    returns strictly increasing end indices."""
+    import numpy as np
+
+    if n > len(flops):
+        raise ValueError(f"n_stages={n} exceeds the {len(flops)} traced "
+                         f"equations")
+    cum = np.cumsum(np.asarray(flops, dtype=np.float64))
+    total = float(cum[-1])
+    ends: List[int] = []
+    prev = 0
+    for k in range(1, n):
+        i = int(np.searchsorted(cum, total * k / n)) + 1
+        i = max(i, prev + 1)  # every stage keeps >= 1 equation
+        i = min(i, len(flops) - (n - k))
+        ends.append(i)
+        prev = i
     ends.append(len(flops))
     return ends
 
@@ -106,6 +112,13 @@ class _StagePlan:
             self.boundaries.append(live)
 
         self.out_vars = [v for v in jaxpr.outvars]
+        for v in self.out_vars:
+            aval = getattr(v, "aval", None)
+            if aval is not None and not jnp.issubdtype(aval.dtype,
+                                                      jnp.floating):
+                raise NotImplementedError(
+                    f"non-float output {aval} cannot ride the f32 output "
+                    f"transport (would lose precision)")
         self.buf_elems = max(
             [sum(math.prod(v.aval.shape) for v in b)
              for b in self.boundaries] + [1])
@@ -184,10 +197,17 @@ def pipeline_forward(fn: Callable, example_params, example_mb, mesh,
 
     def pipelined(params, microbatches):
         param_leaves = jax.tree_util.tree_leaves(params)
+        mb_leaves = jax.tree_util.tree_leaves(microbatches)
+        if len(mb_leaves) != len(data_vars):
+            raise ValueError(
+                f"microbatches pytree has {len(mb_leaves)} leaves; the traced "
+                f"function expects {len(data_vars)}")
 
-        @lambda f: shard_map(f, mesh=mesh, in_specs=(P(), P()),
-                             out_specs=P(), check_vma=False)
-        def run(param_vals, x_mb):
+        @lambda f: shard_map(
+            f, mesh=mesh,
+            in_specs=(P(), tuple(P() for _ in mb_leaves)),
+            out_specs=P(), check_vma=False)
+        def run(param_vals, x_mb_leaves):
             stage_id = jax.lax.axis_index(axis)
             T = M + S - 1
 
@@ -195,7 +215,7 @@ def pipeline_forward(fn: Callable, example_params, example_mb, mesh,
                 buf, outputs = carry
                 # stage s consumes microbatch t - s
                 mb_idx = jnp.clip(t - stage_id, 0, M - 1)
-                data_vals = [x[mb_idx] if x.ndim > 0 else x for x in [x_mb]]
+                data_vals = [x[mb_idx] for x in x_mb_leaves]
                 buf_out, out_pack = jax.lax.switch(
                     stage_id, branches, buf, list(param_vals), data_vals)
                 out_idx = jnp.clip(t - (S - 1), 0, M - 1)
@@ -214,7 +234,7 @@ def pipeline_forward(fn: Callable, example_params, example_mb, mesh,
                 axis)
             return outputs
 
-        packed = run(tuple(param_leaves), microbatches)  # [M, out_elems]
+        packed = run(tuple(param_leaves), tuple(mb_leaves))  # [M, out_elems]
         # unpack each microbatch row back to the fn's output structure
         results = []
         off = 0

@@ -82,3 +82,37 @@ def test_auto_pipeline_gradients(mesh_pp):
                     jax.tree_util.tree_leaves(g2)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.world_8
+def test_auto_pipeline_validation(mesh_pp):
+    d = 8
+    params = make_model(jax.random.PRNGKey(6), d, n_layers=2)
+    x = jax.random.normal(jax.random.PRNGKey(7), (4, 2, d))
+    # too many stages for the equation count -> clear error
+    with pytest.raises(ValueError, match="n_stages"):
+        pipeline_forward(lambda p, xb: xb @ p[0]["w"], params, x[0], mesh_pp,
+                         n_stages=4, n_microbatches=4)
+    # non-float output -> clear error
+    with pytest.raises(NotImplementedError, match="non-float"):
+        pipeline_forward(lambda p, xb: jnp.argmax(model_fn(p, xb), -1),
+                         params, x[0], mesh_pp, n_stages=4, n_microbatches=4)
+
+
+@pytest.mark.world_8
+def test_auto_pipeline_multi_leaf_microbatch(mesh_pp):
+    d, M, mb = 8, 4, 2
+    params = make_model(jax.random.PRNGKey(8), d, n_layers=4)
+
+    def fn(p, batch):
+        return model_fn(p, batch["x"]) * batch["scale"]
+
+    x = jax.random.normal(jax.random.PRNGKey(9), (M, mb, d))
+    scale = jnp.ones((M, mb, 1)) * 2.0
+    pipe = pipeline_forward(fn, params, {"x": x[0], "scale": scale[0]},
+                            mesh_pp, n_stages=4, n_microbatches=M)
+    got = pipe(params, {"x": x, "scale": scale})
+    want = jnp.stack([fn(params, {"x": x[i], "scale": scale[i]})
+                      for i in range(M)])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
