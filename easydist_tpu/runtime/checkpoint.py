@@ -59,6 +59,12 @@ def load_checkpoint(path: str, like: Any, step: Optional[int] = None) -> Any:
     def as_abstract(x):
         if hasattr(x, "shape") and hasattr(x, "dtype"):
             sharding = getattr(x, "sharding", None)
+            # a single-device sharding in the template usually just means
+            # "freshly initialized host arrays"; restoring committed to one
+            # device would then clash with any multi-device jit. Restore as
+            # host (uncommitted) arrays instead, so jit places them freely.
+            if sharding is not None and getattr(sharding, "num_devices", 1) <= 1:
+                sharding = None
             return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
         return x
 

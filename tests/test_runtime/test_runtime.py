@@ -116,3 +116,28 @@ def test_cost_analysis_on_compile_result(cpu_devices):
     cost = op_cost_analysis(res)
     assert cost.get("flops", 0) > 0
     assert memory_analysis(res)
+
+
+def test_restore_host_template_enters_multidevice_jit(cpu_devices):
+    """A checkpoint restored with a fresh host-array template must be
+    consumable by a multi-device compiled step (regression: restore used to
+    commit to device 0 and clash with the mesh constraint)."""
+    from easydist_tpu.jaxfront import easydist_compile, make_device_mesh
+
+    mesh = make_device_mesh((8,), ("d",))
+    compiled = easydist_compile(
+        lambda s, x: (jax.tree_util.tree_map(lambda w: w + x.sum(), s),
+                      x.sum()),
+        mesh=mesh, donate_state=False)
+    state = {"w": jnp.arange(16.0)}
+    x = jnp.ones((8, 4))
+    state2, _ = compiled(state, x)
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        save_checkpoint(d, state2, step=0)
+        restored = load_checkpoint(d, {"w": jnp.zeros(16)})
+        out, _ = compiled(restored, x)  # must not raise
+        np.testing.assert_allclose(np.asarray(out["w"]),
+                                   np.asarray(state2["w"]) + 8.0)
