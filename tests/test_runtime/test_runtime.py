@@ -140,4 +140,4 @@ def test_restore_host_template_enters_multidevice_jit(cpu_devices):
         restored = load_checkpoint(d, {"w": jnp.zeros(16)})
         out, _ = compiled(restored, x)  # must not raise
         np.testing.assert_allclose(np.asarray(out["w"]),
-                                   np.asarray(state2["w"]) + 8.0)
+                                   np.asarray(state2["w"]) + 32.0)
