@@ -152,3 +152,80 @@ def make_gpt_train_step(cfg: GPTConfig, lr=1e-4):
         return (new_params, new_opt), loss
 
     return train_step, init_state
+
+
+def make_gpt_pipeline_step(cfg: GPTConfig, mesh, n_microbatches: int,
+                           lr: float = 1e-4, axis: str = "pp",
+                           data_axis=None, schedule: str = "gpipe"):
+    """Pipeline-parallel GPT training: transformer blocks pipelined over the
+    `pp` mesh axis (stage-stacked params), embedding/positional/head outside
+    the pipelined middle (reference scenario: benchmark/torch/pp/gpt).
+
+    Requires cfg.layers % mesh.shape[axis] == 0.  Returns
+    (train_step, init_state): state = (params, opt); train_step(state,
+    tokens, targets) -> (state, loss); tokens [n_microbatches, mb, seq].
+    """
+    from easydist_tpu.parallel import PipelineConfig, spmd_pipeline
+
+    n_stages = mesh.shape[axis]
+    if cfg.layers % n_stages != 0:
+        raise ValueError(f"layers {cfg.layers} not divisible by "
+                         f"{n_stages} pipeline stages")
+    per_stage = cfg.layers // n_stages
+    dtype = jnp.dtype(cfg.dtype)
+
+    def stage_fn(stage_blocks, x):
+        # stage_blocks: block pytree with leading dim per_stage
+        for i in range(per_stage):
+            blk = jax.tree_util.tree_map(lambda p: p[i], stage_blocks)
+            x = x + _attention(
+                _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"]).astype(dtype),
+                blk["attn"], cfg, dtype)
+            h = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"]).astype(dtype)
+            h = jax.nn.gelu(h @ blk["mlp"]["fc"]["w"].astype(dtype)
+                            + blk["mlp"]["fc"]["b"].astype(dtype))
+            x = x + (h @ blk["mlp"]["proj"]["w"].astype(dtype)
+                     + blk["mlp"]["proj"]["b"].astype(dtype))
+        return x
+
+    pipe_cfg = PipelineConfig(n_stages, n_microbatches, axis_name=axis,
+                              schedule=schedule, data_axis=data_axis)
+    pipe = spmd_pipeline(stage_fn, mesh, pipe_cfg)
+
+    def stack_blocks(params):
+        # list of layer pytrees -> [n_stages, per_stage, ...] leading dims
+        blocks = params["blocks"]
+        stages = []
+        for s in range(n_stages):
+            chunk = blocks[s * per_stage:(s + 1) * per_stage]
+            stages.append(jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *chunk))
+        return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *stages)
+
+    def forward(params, tokens_mb):
+        # tokens_mb: [M, mb, seq]
+        M, mb, seq = tokens_mb.shape
+        x = params["wte"][tokens_mb].astype(dtype) \
+            + params["wpe"].astype(dtype)[None, None, :seq]
+        x = pipe(stack_blocks(params), x)
+        x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
+        return x.astype(jnp.float32) @ params["wte"].T
+
+    def loss_fn(params, tokens_mb, targets_mb):
+        logits = forward(params, tokens_mb)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, targets_mb[..., None],
+                                    axis=-1).mean()
+
+    def init_state(key):
+        params = gpt_init(cfg, key)
+        return (params, adam_init(params))
+
+    def train_step(state, tokens_mb, targets_mb):
+        params, opt = state
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens_mb,
+                                                  targets_mb)
+        new_params, new_opt = adam_update(params, grads, opt, lr=lr)
+        return (new_params, new_opt), loss
+
+    return train_step, init_state

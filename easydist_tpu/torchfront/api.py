@@ -33,15 +33,43 @@ def easydist_compile_torch(module, example_args, mesh=None, **kwargs):
 
 def make_torch_train_step(module, example_args, loss_fn: Callable,
                           optimizer: str = "adam", lr: float = 1e-3,
-                          mesh=None, **kwargs):
+                          mesh=None, parallel_mode: str = "auto", **kwargs):
     """Build an auto-parallelized train step from a torch module.
 
     loss_fn(outputs, *targets) -> scalar jax loss.
+    parallel_mode: "auto" (solver-chosen SPMD, the default) or the manual
+    modes "ddp" / "zero2" / "zero3" (reference torch/api.py parallel_mode
+    kwarg, compile_dp.py) — manual modes shard the batch over the mesh's
+    first axis explicitly.
     Returns (compiled_step, init_state):
       state = (params, opt_state) for adam, params for sgd
       compiled_step(state, inputs, *targets) -> (new_state, loss)
     """
     fwd, params0 = torch_module_to_jax(module, example_args)
+
+    if parallel_mode != "auto":
+        from easydist_tpu.jaxfront.mesh import get_device_mesh
+        from easydist_tpu.parallel import ddp_step, zero2_step, zero3_step
+
+        mesh = mesh or get_device_mesh()
+        axis = mesh.axis_names[0]
+
+        def objective(p, inputs, *targets):
+            return loss_fn(fwd(p, inputs), *targets)
+
+        if parallel_mode == "ddp":
+            step = ddp_step(objective, mesh, axis=axis, lr=lr)
+            return step, lambda: params0
+        if parallel_mode == "zero2":
+            step, init_opt = zero2_step(objective, mesh, axis=axis, lr=lr)
+            import jax.numpy as _jnp
+
+            return step, lambda: (params0, init_opt(params0),
+                                  _jnp.zeros((), _jnp.int32))
+        if parallel_mode == "zero3":
+            step, init_state3 = zero3_step(objective, mesh, axis=axis, lr=lr)
+            return step, lambda: init_state3(params0)
+        raise ValueError(f"unknown parallel_mode {parallel_mode!r}")
 
     if optimizer == "adam":
         def init_state():

@@ -140,3 +140,32 @@ def test_gpt_flash_attention_matches_einsum(mesh):
     out_f = gpt_apply(params, cfg_f, tokens)
     np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_e),
                                rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.world_8
+@pytest.mark.long_duration
+def test_gpt_pipeline_step_matches_plain(cpu_devices):
+    """Pipelined GPT training step (blocks over pp) must match the plain
+    train step on merged microbatches."""
+    from easydist_tpu.jaxfront import make_device_mesh
+    from easydist_tpu.models.gpt import make_gpt_pipeline_step
+
+    mesh_pp = make_device_mesh((4,), ("pp",), devices=cpu_devices[:4])
+    cfg = GPTConfig.tiny(layers=4)
+    M, mb = 4, 2
+    pipe_step, pipe_init = make_gpt_pipeline_step(cfg, mesh_pp,
+                                                  n_microbatches=M)
+    state = pipe_init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (M, mb, cfg.seq), 0,
+                                cfg.vocab)
+    (new_params, _), loss = jax.jit(pipe_step)(state, tokens, tokens)
+
+    # plain step over the same merged batch (loss is mean over all tokens
+    # either way)
+    plain_step, plain_init = make_gpt_train_step(cfg, lr=1e-4)
+    plain_state = plain_init(jax.random.PRNGKey(0))
+    merged = tokens.reshape(M * mb, cfg.seq)
+    (ref_params, _), ref_loss = plain_step(plain_state, merged, merged)
+    np.testing.assert_allclose(float(loss), float(ref_loss),
+                               rtol=1e-4, atol=1e-6)
+    _tree_allclose(new_params, ref_params, rtol=1e-3, atol=1e-5)

@@ -149,3 +149,23 @@ def test_transposed_conv_raises():
     fn, params = torch_module_to_jax(TConv(), (torch.randn(1, 3, 4, 4),))
     with pytest.raises((UnsupportedAtenOp, NotImplementedError)):
         fn(params, jnp.zeros((1, 3, 4, 4)))
+
+
+@pytest.mark.world_8
+@pytest.mark.parametrize("mode", ["ddp", "zero2", "zero3"])
+def test_torch_manual_parallel_modes(mesh, mode):
+    module = SmallMLP()
+    x = torch.randn(32, 16)
+    y = torch.randn(32, 8)
+
+    def mse(pred, target):
+        return jnp.mean((pred - target) ** 2)
+
+    step, init_state = make_torch_train_step(
+        module, (x,), mse, optimizer="adam", lr=1e-3, mesh=mesh,
+        parallel_mode=mode)
+    state = init_state()
+    jx, jy = jnp.asarray(x.numpy()), jnp.asarray(y.numpy())
+    state, loss = step(state, jx, jy)
+    state, loss2 = step(state, jx, jy)
+    assert np.isfinite(float(loss)) and float(loss2) < float(loss)
