@@ -444,3 +444,10 @@ def easydist_compile(func=None, mesh=None, state_io="auto",
                                 compile_only=compile_only)
 
     return wrap(func) if func is not None else wrap
+
+
+def get_opt_strategy(func, *args, mesh=None, **kwargs):
+    """Solve and return the per-axis strategy dict without building the
+    executable (reference public API: jax/api.py:173 get_opt_strategy)."""
+    result = compile_step(func, args, kwargs, mesh=mesh)
+    return result.strategies

@@ -501,3 +501,128 @@ def _flatten(x, start_dim=0, end_dim=-1):
 @register_aten("aten.unbind.int")
 def _unbind(x, dim=0):
     return tuple(jnp.take(x, i, axis=dim) for i in range(x.shape[dim]))
+
+
+@register_aten("aten.rsub.Scalar")
+def _rsub(a, b, alpha=1):
+    return b - alpha * a
+
+
+@register_aten("aten.clamp.default")
+def _clamp(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+@register_aten("aten.pow.Tensor_Tensor")
+def _pow_tt(a, b):
+    return a ** b
+
+
+@register_aten("aten.div.Scalar")
+def _div_scalar(a, b):
+    return a / b
+
+
+@register_aten("aten.add.Scalar")
+def _add_scalar(a, b, alpha=1):
+    return a + alpha * b
+
+
+@register_aten("aten.mul.Scalar")
+def _mul_scalar(a, b):
+    return a * b
+
+
+@register_aten("aten.erf.default")
+def _erf(x):
+    return jax.scipy.special.erf(x)
+
+
+@register_aten("aten.hardtanh.default")
+def _hardtanh(x, min_val=-1.0, max_val=1.0):
+    return jnp.clip(x, min_val, max_val)
+
+
+@register_aten("aten.leaky_relu.default")
+def _leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+@register_aten("aten.elu.default")
+def _elu(x, alpha=1.0, scale=1.0, input_scale=1.0):
+    return scale * jax.nn.elu(x * input_scale, alpha)
+
+
+@register_aten("aten.avg_pool2d.default")
+def _avg_pool2d(x, kernel, stride=None, padding=(0, 0), ceil_mode=False,
+                count_include_pad=True, divisor_override=None):
+    if isinstance(kernel, int):
+        kernel = (kernel, kernel)
+    stride = stride or kernel
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = (padding, padding)
+    summed = jax.lax.reduce_window(
+        x, 0.0, jax.lax.add, (1, 1) + tuple(kernel), (1, 1) + tuple(stride),
+        [(0, 0), (0, 0)] + [(p, p) for p in padding])
+    return summed / (kernel[0] * kernel[1])
+
+
+@register_aten("aten.amax.default")
+def _amax(x, dims=None, keepdim=False):
+    return x.max(axis=tuple(dims) if dims else None, keepdims=keepdim)
+
+
+@register_aten("aten.amin.default")
+def _amin(x, dims=None, keepdim=False):
+    return x.min(axis=tuple(dims) if dims else None, keepdims=keepdim)
+
+
+@register_aten("aten.minimum.default")
+def _minimum(a, b):
+    return jnp.minimum(a, b)
+
+
+@register_aten("aten.maximum.default")
+def _maximum(a, b):
+    return jnp.maximum(a, b)
+
+
+@register_aten("aten.abs.default")
+def _abs(x):
+    return jnp.abs(x)
+
+
+@register_aten("aten.cumsum.default")
+def _cumsum(x, dim, dtype=None):
+    return jnp.cumsum(x, axis=dim)
+
+
+@register_aten("aten.flip.default")
+def _flip(x, dims):
+    return jnp.flip(x, axis=tuple(dims))
+
+
+@register_aten("aten.repeat.default")
+def _repeat(x, repeats):
+    offset = len(repeats) - x.ndim
+    if offset > 0:
+        x = x.reshape((1,) * offset + x.shape)
+    return jnp.tile(x, tuple(repeats))
+
+
+@register_aten("aten.full.default")
+def _full(size, fill_value, dtype=None, layout=None, device=None,
+          pin_memory=None):
+    return jnp.full(tuple(size), fill_value)
+
+
+@register_aten("aten.zeros.default")
+def _zeros(size, dtype=None, layout=None, device=None, pin_memory=None):
+    return jnp.zeros(tuple(size))
+
+
+@register_aten("aten.ones.default")
+def _ones(size, dtype=None, layout=None, device=None, pin_memory=None):
+    return jnp.ones(tuple(size))

@@ -1,0 +1,37 @@
+"""Expert-parallel MoE layer over an ep mesh axis.
+
+python examples/jax/moe_layer.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+if not os.environ.get("EASYDIST_REAL_DEVICES"):
+    from easydist_tpu.utils.testing import force_cpu_devices
+
+    force_cpu_devices(8)
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def main():
+    from easydist_tpu.jaxfront import make_device_mesh
+    from easydist_tpu.parallel.moe import MoEConfig, moe_init, moe_layer
+
+    n = len(jax.devices())
+    mesh = make_device_mesh((n,), ("ep",))
+    cfg = MoEConfig(n_experts=2 * n, d_model=64, d_ff=256,
+                    capacity_factor=1.5)
+    params = moe_init(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.normal(jax.random.PRNGKey(1), (64 * n, cfg.d_model))
+
+    y, aux = jax.jit(lambda p, x: moe_layer(p, x, mesh, cfg))(params, tokens)
+    print(f"MoE over {n} devices, {cfg.n_experts} experts: "
+          f"out {y.shape}, load-balance aux {float(aux):.4f}")
+
+
+if __name__ == "__main__":
+    main()
