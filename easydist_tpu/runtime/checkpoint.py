@@ -56,15 +56,28 @@ def load_checkpoint(path: str, like: Any, step: Optional[int] = None) -> Any:
             raise FileNotFoundError(f"no checkpoints under {path}")
     ckpt_dir = os.path.join(os.path.abspath(path), f"step_{step}")
 
+    def replicated_sharding():
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        import numpy as np
+
+        devs = np.array(jax.devices())
+        return NamedSharding(Mesh(devs, ("restore",)), PartitionSpec())
+
+    rep = replicated_sharding()
+
     def as_abstract(x):
         if hasattr(x, "shape") and hasattr(x, "dtype"):
             sharding = getattr(x, "sharding", None)
-            # a single-device sharding in the template usually just means
-            # "freshly initialized host arrays"; restoring committed to one
-            # device would then clash with any multi-device jit. Restore as
-            # host (uncommitted) arrays instead, so jit places them freely.
-            if sharding is not None and getattr(sharding, "num_devices", 1) <= 1:
-                sharding = None
+            # A single-device sharding in the template usually means "freshly
+            # initialized host arrays".  Restoring committed to device 0
+            # clashes with multi-device jits, and sharding=None makes orbax
+            # fall back to the SAVED topology (which may no longer exist on
+            # an elastic restart).  Restore replicated over the CURRENT
+            # devices instead — valid on any topology, and jit reshards from
+            # there per its constraints.
+            if sharding is None or getattr(sharding, "num_devices", 1) <= 1:
+                sharding = rep
             return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
         return x
 

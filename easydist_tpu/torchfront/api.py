@@ -57,6 +57,15 @@ def make_torch_train_step(module, example_args, loss_fn: Callable,
         def objective(p, inputs, *targets):
             return loss_fn(fwd(p, inputs), *targets)
 
+        # manual modes carry their own optimizer: ddp is SGD, zero2/3 are
+        # Adam — reject a contradictory `optimizer` rather than silently
+        # training with a different one
+        if parallel_mode == "ddp" and optimizer != "sgd":
+            raise ValueError("parallel_mode='ddp' trains with SGD; pass "
+                             "optimizer='sgd' (or use parallel_mode='auto')")
+        if parallel_mode in ("zero2", "zero3") and optimizer != "adam":
+            raise ValueError(f"parallel_mode={parallel_mode!r} trains with "
+                             "Adam; pass optimizer='adam'")
         if parallel_mode == "ddp":
             step = ddp_step(objective, mesh, axis=axis, lr=lr)
             return step, lambda: params0

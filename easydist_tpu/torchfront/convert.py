@@ -550,12 +550,16 @@ def _leaky_relu(x, negative_slope=0.01):
 
 @register_aten("aten.elu.default")
 def _elu(x, alpha=1.0, scale=1.0, input_scale=1.0):
-    return scale * jax.nn.elu(x * input_scale, alpha)
+    # torch: scale * (x if x > 0 else alpha * expm1(input_scale * x))
+    return jnp.where(x > 0, scale * x,
+                     scale * alpha * jnp.expm1(input_scale * x))
 
 
 @register_aten("aten.avg_pool2d.default")
 def _avg_pool2d(x, kernel, stride=None, padding=(0, 0), ceil_mode=False,
                 count_include_pad=True, divisor_override=None):
+    if ceil_mode or divisor_override is not None:
+        raise UnsupportedAtenOp("avg_pool2d with ceil_mode/divisor_override")
     if isinstance(kernel, int):
         kernel = (kernel, kernel)
     stride = stride or kernel
@@ -563,10 +567,15 @@ def _avg_pool2d(x, kernel, stride=None, padding=(0, 0), ceil_mode=False,
         stride = (stride, stride)
     if isinstance(padding, int):
         padding = (padding, padding)
-    summed = jax.lax.reduce_window(
-        x, 0.0, jax.lax.add, (1, 1) + tuple(kernel), (1, 1) + tuple(stride),
-        [(0, 0), (0, 0)] + [(p, p) for p in padding])
-    return summed / (kernel[0] * kernel[1])
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    pads = [(0, 0), (0, 0)] + [(p, p) for p in padding]
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
+    if count_include_pad:
+        return summed / (kernel[0] * kernel[1])
+    counts = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                   window, strides, pads)
+    return summed / counts
 
 
 @register_aten("aten.amax.default")
@@ -612,17 +621,28 @@ def _repeat(x, repeats):
     return jnp.tile(x, tuple(repeats))
 
 
+def _torch_dtype_to_jnp(dtype):
+    if dtype is None:
+        return None
+    name = str(dtype).replace("torch.", "")
+    return {"float32": jnp.float32, "float64": jnp.float64,
+            "float16": jnp.float16, "bfloat16": jnp.bfloat16,
+            "int64": jnp.int64, "int32": jnp.int32, "int16": jnp.int16,
+            "int8": jnp.int8, "uint8": jnp.uint8, "bool": jnp.bool_}.get(
+                name, jnp.float32)
+
+
 @register_aten("aten.full.default")
 def _full(size, fill_value, dtype=None, layout=None, device=None,
           pin_memory=None):
-    return jnp.full(tuple(size), fill_value)
+    return jnp.full(tuple(size), fill_value, dtype=_torch_dtype_to_jnp(dtype))
 
 
 @register_aten("aten.zeros.default")
 def _zeros(size, dtype=None, layout=None, device=None, pin_memory=None):
-    return jnp.zeros(tuple(size))
+    return jnp.zeros(tuple(size), dtype=_torch_dtype_to_jnp(dtype))
 
 
 @register_aten("aten.ones.default")
 def _ones(size, dtype=None, layout=None, device=None, pin_memory=None):
-    return jnp.ones(tuple(size))
+    return jnp.ones(tuple(size), dtype=_torch_dtype_to_jnp(dtype))

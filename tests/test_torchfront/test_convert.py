@@ -162,10 +162,41 @@ def test_torch_manual_parallel_modes(mesh, mode):
         return jnp.mean((pred - target) ** 2)
 
     step, init_state = make_torch_train_step(
-        module, (x,), mse, optimizer="adam", lr=1e-3, mesh=mesh,
-        parallel_mode=mode)
+        module, (x,), mse, optimizer="sgd" if mode == "ddp" else "adam",
+        lr=1e-3, mesh=mesh, parallel_mode=mode)
     state = init_state()
     jx, jy = jnp.asarray(x.numpy()), jnp.asarray(y.numpy())
     state, loss = step(state, jx, jy)
     state, loss2 = step(state, jx, jy)
     assert np.isfinite(float(loss)) and float(loss2) < float(loss)
+
+
+def test_avg_pool_and_elu_and_dtype_semantics():
+    # count_include_pad=False and elu input_scale, verified against torch
+    class Net(nn.Module):
+        def forward(self, x):
+            p = torch.nn.functional.avg_pool2d(
+                x, 2, stride=2, padding=1, count_include_pad=False)
+            return torch.nn.functional.elu(p)
+
+    assert_matches_torch(Net(), (torch.randn(1, 1, 4, 4),))
+
+    class MaskNet(nn.Module):
+        def forward(self, x):
+            mask = torch.zeros(x.shape, dtype=torch.bool)
+            return torch.where(mask, x, x * 2)
+
+    assert_matches_torch(MaskNet(), (torch.randn(3, 3),))
+
+
+def test_manual_mode_optimizer_mismatch_raises():
+    module = SmallMLP()
+    x = torch.randn(8, 16)
+
+    def mse(pred, target):
+        return jnp.mean((pred - target) ** 2)
+
+    with pytest.raises(ValueError, match="SGD"):
+        make_torch_train_step(module, (x,), mse, optimizer="adam",
+                              parallel_mode="ddp",
+                              mesh=make_device_mesh((8,), ("d",)))
