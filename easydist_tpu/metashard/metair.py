@@ -296,8 +296,10 @@ class MetaNodeCluster:
 
     def finalize(self, axis_size: int, exclude_map) -> None:
         # output node: the unique node with a var consumed outside the cluster
-        # (or a graph output)
-        out_node = None
+        # (or a graph output).  Root selection keeps this unique for cones;
+        # if it still isn't (defensive), take the topologically-last external
+        # node — back_build then falls back to all-replicate if needed.
+        external_nodes = []
         for node in self.nodes.values():
             for v in node.outvars:
                 if v is None:
@@ -305,12 +307,15 @@ class MetaNodeCluster:
                 external = not v.consumers or any(
                     c.uid not in self.nodes for c, _ in v.consumers)
                 if external:
-                    if out_node is not None and out_node is not node:
-                        raise RuntimeError(
-                            f"cluster {self.cid} has multiple output nodes")
-                    out_node = node
-        if out_node is None:
+                    external_nodes.append(node)
+                    break
+        if not external_nodes:
             out_node = next(iter(self.nodes.values()))
+        else:
+            if len(external_nodes) > 1:
+                logger.debug("cluster %d has %d external nodes; using the "
+                             "last one", self.cid, len(external_nodes))
+            out_node = max(external_nodes, key=lambda n: n.uid)
         self.output_node = out_node
 
         self.strategies = []
@@ -384,9 +389,16 @@ class MetaGraph:
         find_cone_roots, metair.py:852-892)."""
         roots = []
         for node in self.ops:
-            consumers = [c for v in node.outvars if v is not None
-                         for c, _ in v.consumers]
-            if len(consumers) != 1:
+            # externally-visible edges: every consumer, plus each dangling /
+            # graph-output var (no consumers).  A cone interior node must
+            # have exactly one — multi-output prims like scan whose extra
+            # outputs dangle would otherwise give a cone two output nodes.
+            external = 0
+            for v in node.outvars:
+                if v is None:
+                    continue
+                external += len(v.consumers) if v.consumers else 1
+            if external != 1:
                 roots.append(node)
                 continue
             produced_ins = [v for v in node.invars

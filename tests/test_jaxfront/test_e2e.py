@@ -169,3 +169,46 @@ def test_fix_sharding_scope(mesh_1d):
     got = compiled(w, x)
     np.testing.assert_allclose(np.asarray(got), np.asarray(jnp.tanh(x @ w)),
                                rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.world_8
+def test_control_flow_primitives(mesh_1d):
+    """scan/cond/while_loop must pass through the whole pipeline (regression:
+    scan's dangling outputs broke the cone-cluster single-output invariant)."""
+
+    def scan_step(params, xs):
+        def cell(h, x):
+            h = jnp.tanh(h @ params["w"] + x)
+            return h, h
+
+        h0 = jnp.zeros((xs.shape[1], params["w"].shape[0]))
+        _, hs = jax.lax.scan(cell, h0, xs)
+        return hs.mean()
+
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (16, 16))}
+    xs = jax.random.normal(jax.random.PRNGKey(1), (8, 32, 16))
+    c = easydist_compile(scan_step, mesh=mesh_1d)
+    np.testing.assert_allclose(float(c(params, xs)),
+                               float(scan_step(params, xs)), rtol=1e-5)
+
+    def cond_step(w, x, flag):
+        return jax.lax.cond(flag > 0, lambda: (x @ w).sum(),
+                            lambda: (x * 2).sum())
+
+    w = jax.random.normal(jax.random.PRNGKey(2), (16, 16))
+    x = jax.random.normal(jax.random.PRNGKey(3), (8, 16))
+    c2 = easydist_compile(cond_step, mesh=mesh_1d)
+    np.testing.assert_allclose(float(c2(w, x, jnp.array(1))),
+                               float(cond_step(w, x, jnp.array(1))),
+                               rtol=1e-5)
+
+    def while_step(x):
+        def body(c):
+            i, v = c
+            return i + 1, v * 1.1
+
+        _, out = jax.lax.while_loop(lambda c: c[0] < 5, body, (0, x))
+        return out.sum()
+
+    c3 = easydist_compile(while_step, mesh=mesh_1d)
+    np.testing.assert_allclose(float(c3(x)), float(while_step(x)), rtol=1e-5)
