@@ -146,6 +146,50 @@ def emit_sharded_fn(closed_jaxpr, names: VarNames,
     return sharded_fn
 
 
+def _compile_cache_key(closed_jaxpr, axis_specs) -> str:
+    """Stable key over the traced program + mesh layout (reference compile
+    cache, torch/compile_auto.py:97-106)."""
+    import hashlib
+
+    from .interpreter import eqn_signature
+
+    h = hashlib.sha256()
+    for eqn in closed_jaxpr.jaxpr.eqns:
+        h.update(eqn_signature(eqn, None).encode())
+    for v in closed_jaxpr.jaxpr.invars:
+        h.update(f"{v.aval.shape}{v.aval.dtype}".encode())
+    for s in axis_specs:
+        h.update(f"{s.name}:{s.size}:{s.kind}".encode())
+    return h.hexdigest()[:32]
+
+
+def _strategy_cache_load(key: str):
+    import os
+    import pickle
+
+    path = os.path.join(edconfig.compile_cache_dir, f"strategies_{key}.pkl")
+    if os.path.exists(path):
+        try:
+            with open(path, "rb") as f:
+                return pickle.load(f)
+        except Exception:
+            logger.warning("compile cache read failed for %s", path)
+    return None
+
+
+def _strategy_cache_store(key: str, per_axis) -> None:
+    import os
+    import pickle
+
+    os.makedirs(edconfig.compile_cache_dir, exist_ok=True)
+    path = os.path.join(edconfig.compile_cache_dir, f"strategies_{key}.pkl")
+    try:
+        with open(path, "wb") as f:
+            pickle.dump(per_axis, f)
+    except Exception:
+        logger.warning("compile cache write failed for %s", path)
+
+
 def _dump_strategies(graph, per_axis, axis_names):
     """Write MetaIR + solved strategies into edconfig.dump_dir (reference
     DUMP_STRATEGY/DUMP_CLUSTER flags, config.py and metair.py:933-939)."""
@@ -223,6 +267,37 @@ def compile_step(func, args, kwargs, mesh=None, state_io="auto",
     logger.info("[trace] %d eqns in %.2fs", len(jaxpr.eqns),
                 time.perf_counter() - t0)
 
+    # ---- persistent compile cache: a hit skips discovery AND solving
+    cache_key = cached = None
+    if edconfig.enable_compile_cache:
+        cache_key = _compile_cache_key(closed_jaxpr, axis_specs)
+        cached = _strategy_cache_load(cache_key)
+        if cached is not None:
+            logger.info("[compile cache] hit %s", cache_key)
+
+    # ---- state threading: map output var names to input var names
+    flat_args, in_tree = jax.tree_util.tree_flatten((args, kwargs))
+    state_pairs: Dict[int, int] = {}
+    if state_io == "auto":
+        state_pairs = infer_state_io(args, out_shape)
+    elif isinstance(state_io, dict):
+        state_pairs = state_io
+    out_leaves, out_tree = jax.tree_util.tree_flatten(out_shape)
+
+    if cached is not None:
+        # names must match the analyzer's assignment order exactly
+        names = VarNames()
+        for var in jaxpr.invars + jaxpr.constvars:
+            names.name(var)
+        for eqn in jaxpr.eqns:
+            for v in eqn.outvars:
+                names.name(v)
+        per_axis = list(cached)
+        graph = None
+        return _finish_compile(closed_jaxpr, jaxpr, names, per_axis, graph,
+                               axis_specs, mesh, args, kwargs, flat_args,
+                               in_tree, out_tree, state_pairs, donate_state)
+
     # gate shardability on the SMALLEST axis: per-axis pools re-check
     # divisibility, so a dim only shardable on a small axis must not be
     # filtered out by a larger one
@@ -234,14 +309,6 @@ def compile_step(func, args, kwargs, mesh=None, state_io="auto",
     logger.info("[discovery] %d unique op signatures in %.2fs", len(rules),
                 time.perf_counter() - t0)
 
-    # ---- state threading: map output var names to input var names
-    flat_args, in_tree = jax.tree_util.tree_flatten((args, kwargs))
-    state_pairs: Dict[int, int] = {}
-    if state_io == "auto":
-        state_pairs = infer_state_io(args, out_shape)
-    elif isinstance(state_io, dict):
-        state_pairs = state_io
-    out_leaves, out_tree = jax.tree_util.tree_flatten(out_shape)
     state_io_names = {}
     for out_idx, in_idx in state_pairs.items():
         if out_idx < len(jaxpr.outvars) and in_idx < len(jaxpr.invars):
@@ -302,11 +369,25 @@ def compile_step(func, args, kwargs, mesh=None, state_io="auto",
                         shape[p.dim] //= axis.size
                         var_shapes[v.name] = tuple(shape)
 
+    if edconfig.dump_dir:
+        _dump_strategies(graph, [c if c is not None else {} for c in per_axis],
+                         [s.name for s in axis_specs])
+    if cache_key is not None:
+        _strategy_cache_store(cache_key,
+                              [c if c is not None else {} for c in per_axis])
+
+    return _finish_compile(closed_jaxpr, jaxpr, names, per_axis, graph,
+                           axis_specs, mesh, args, kwargs, flat_args,
+                           in_tree, out_tree, state_pairs, donate_state)
+
+
+def _finish_compile(closed_jaxpr, jaxpr, names, per_axis, graph, axis_specs,
+                    mesh, args, kwargs, flat_args, in_tree, out_tree,
+                    state_pairs, donate_state):
+    """Emission + jit from solved strategies (shared by the fresh-solve and
+    compile-cache paths)."""
     axis_names = [s.name for s in axis_specs]
     per_axis_final = [c if c is not None else {} for c in per_axis]
-
-    if edconfig.dump_dir:
-        _dump_strategies(graph, per_axis_final, axis_names)
 
     # ---- input shardings from placeholder strategies
     in_shardings = []

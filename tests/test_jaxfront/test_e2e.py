@@ -212,3 +212,30 @@ def test_control_flow_primitives(mesh_1d):
 
     c3 = easydist_compile(while_step, mesh=mesh_1d)
     np.testing.assert_allclose(float(c3(x)), float(while_step(x)), rtol=1e-5)
+
+
+@pytest.mark.world_8
+def test_compile_cache_roundtrip(mesh_1d, tmp_path):
+    """With EASYDIST_COMPILE_CACHE on, a second compile of the same program
+    skips discovery+solve but produces identical strategies and results."""
+    import easydist_tpu.config as edconfig
+
+    edconfig.enable_compile_cache = True
+    edconfig.compile_cache_dir = str(tmp_path)
+    try:
+        params, x, y = _mlp_init()
+        c1 = easydist_compile(_mlp_step, mesh=mesh_1d, donate_state=False)
+        r1 = c1.get_compiled(params, x, y)
+        import os
+
+        assert any(f.startswith("strategies_") for f in os.listdir(tmp_path))
+
+        c2 = easydist_compile(_mlp_step, mesh=mesh_1d, donate_state=False)
+        r2 = c2.get_compiled(params, x, y)
+        assert [str(s) for s in r2.in_shardings] == \
+            [str(s) for s in r1.in_shardings]
+        got, ref = c2(params, x, y), _mlp_step(params, x, y)
+        np.testing.assert_allclose(float(got[1]), float(ref[1]),
+                                   rtol=1e-4, atol=1e-6)
+    finally:
+        edconfig.enable_compile_cache = False
