@@ -25,6 +25,7 @@ import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
+import numpy as np
 
 from jax.extend import core as jex_core
 from jax.sharding import NamedSharding, PartitionSpec
@@ -151,13 +152,29 @@ def _compile_cache_key(closed_jaxpr, axis_specs) -> str:
     cache, torch/compile_auto.py:97-106)."""
     import hashlib
 
-    from .interpreter import eqn_signature
+    from .interpreter import VarNames, eqn_signature, hash_array_bytes
 
     h = hashlib.sha256()
+    names = VarNames()
+    for v in closed_jaxpr.jaxpr.invars:
+        names.name(v)
     for eqn in closed_jaxpr.jaxpr.eqns:
         h.update(eqn_signature(eqn, None).encode())
+        # dataflow wiring: two programs with the same op/shape sequence but
+        # different operand routing must not collide
+        wiring = ",".join(
+            "lit" if isinstance(v, jex_core.Literal) else names.name(v)
+            for v in eqn.invars)
+        wiring += "->" + ",".join(names.name(v) for v in eqn.outvars)
+        h.update(wiring.encode())
     for v in closed_jaxpr.jaxpr.invars:
         h.update(f"{v.aval.shape}{v.aval.dtype}".encode())
+    for v, c in zip(closed_jaxpr.jaxpr.constvars, closed_jaxpr.consts):
+        h.update(f"c{v.aval.shape}{v.aval.dtype}".encode())
+        try:
+            h.update(hash_array_bytes(np.asarray(c)).encode())
+        except Exception:
+            pass
     for s in axis_specs:
         h.update(f"{s.name}:{s.size}:{s.kind}".encode())
     return h.hexdigest()[:32]

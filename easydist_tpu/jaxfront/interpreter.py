@@ -56,13 +56,31 @@ def _materialize(aval, key):
     return jnp.zeros(aval.shape, dtype=aval.dtype)
 
 
+def hash_array_bytes(arr) -> str:
+    """Content digest of an array's full bytes — used wherever constant
+    VALUES (not just shapes) must feed a cache key; repr() truncates."""
+    import hashlib
+
+    import numpy as np
+
+    arr = np.ascontiguousarray(arr)
+    return hashlib.sha256(arr.tobytes()).hexdigest()[:16]
+
+
 def eqn_signature(eqn, names: VarNames) -> str:
     """Cache key for an equation: primitive + params + input shapes/dtypes."""
+    import numpy as np
+
     prim = eqn.primitive.name
     parts = []
     for v in eqn.invars:
         if isinstance(v, jex_core.Literal):
-            parts.append(f"lit:{v.val!r}")
+            val = v.val
+            if isinstance(val, np.ndarray) and val.size > 1:
+                parts.append(f"lit:{val.dtype.name}{list(val.shape)}:"
+                             f"{hash_array_bytes(val)}")
+            else:
+                parts.append(f"lit:{val!r}")
         else:
             parts.append(f"{v.aval.dtype.name}{list(v.aval.shape)}")
     try:

@@ -141,7 +141,8 @@ def _layer_norm(x, normalized_shape, weight=None, bias=None, eps=1e-5,
 
 
 @register_aten("aten.group_norm.default")
-def _group_norm(x, groups, weight=None, bias=None, eps=1e-5):
+def _group_norm(x, groups, weight=None, bias=None, eps=1e-5,
+                cudnn_enabled=True):
     n, c = x.shape[0], x.shape[1]
     spatial = x.shape[2:]
     xg = x.reshape(n, groups, c // groups, *spatial)
@@ -150,11 +151,11 @@ def _group_norm(x, groups, weight=None, bias=None, eps=1e-5):
     var = xg.var(axis=axes, keepdims=True)
     xg = (xg - mu) * jax.lax.rsqrt(var + eps)
     out = xg.reshape(x.shape)
+    shape = (1, c) + (1,) * len(spatial)
     if weight is not None:
-        shape = (1, c) + (1,) * len(spatial)
         out = out * weight.reshape(shape)
-        if bias is not None:
-            out = out + bias.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
     return out
 
 
@@ -210,6 +211,8 @@ def _conv2d(x, w, bias=None, stride=(1, 1), padding=(0, 0), dilation=(1, 1),
 @register_aten("aten.max_pool2d.default")
 def _max_pool2d(x, kernel, stride=None, padding=(0, 0), dilation=(1, 1),
                 ceil_mode=False):
+    if ceil_mode:
+        raise UnsupportedAtenOp("max_pool2d with ceil_mode=True")
     if isinstance(kernel, int):
         kernel = (kernel, kernel)
     stride = stride or kernel
@@ -217,10 +220,13 @@ def _max_pool2d(x, kernel, stride=None, padding=(0, 0), dilation=(1, 1),
         stride = (stride, stride)
     if isinstance(padding, int):
         padding = (padding, padding)
+    if isinstance(dilation, int):
+        dilation = (dilation, dilation)
     return jax.lax.reduce_window(
         x, -jnp.inf, jax.lax.max,
         (1, 1) + tuple(kernel), (1, 1) + tuple(stride),
-        [(0, 0), (0, 0)] + [(p, p) for p in padding])
+        [(0, 0), (0, 0)] + [(p, p) for p in padding],
+        window_dilation=(1, 1) + tuple(dilation))
 
 
 @register_aten("aten.adaptive_avg_pool2d.default")
@@ -248,6 +254,38 @@ def _sum_dim(x, dims, keepdim=False, dtype=None):
 @register_aten("aten.sum.default")
 def _sum(x, dtype=None):
     return x.sum()
+
+
+@register_aten("aten.prod.default")
+def _prod(x, dtype=None):
+    return x.prod()
+
+
+@register_aten("aten.max.default")
+def _max_full(x):
+    return x.max()
+
+
+@register_aten("aten.min.default")
+def _min_full(x):
+    return x.min()
+
+
+@register_aten("aten.max.dim")
+def _max_dim(x, dim, keepdim=False):
+    return (x.max(axis=dim, keepdims=keepdim),
+            x.argmax(axis=dim, keepdims=keepdim))
+
+
+@register_aten("aten.min.dim")
+def _min_dim(x, dim, keepdim=False):
+    return (x.min(axis=dim, keepdims=keepdim),
+            x.argmin(axis=dim, keepdims=keepdim))
+
+
+@register_aten("aten.prod.dim_int")
+def _prod_dim(x, dim, keepdim=False, dtype=None):
+    return x.prod(axis=dim, keepdims=keepdim)
 
 
 @register_aten("aten.var.correction")
@@ -314,7 +352,14 @@ def _split(x, size, dim=0):
 
 @register_aten("aten.chunk.default")
 def _chunk(x, chunks, dim=0):
-    return jnp.array_split(x, chunks, axis=dim)
+    # torch.chunk: chunk size = ceil(n/chunks), possibly FEWER chunks than
+    # asked (chunk(6, 4) -> [2, 2, 2]); jnp.array_split would give
+    # [2, 2, 1, 1] and break the traced getitem shapes.
+    n = x.shape[dim]
+    if n == 0:
+        return [x] * chunks
+    size = -(-n // chunks)
+    return jnp.split(x, list(range(size, n, size)), axis=dim)
 
 
 @register_aten("aten.slice.Tensor")

@@ -1,0 +1,52 @@
+"""Compile-cache key robustness (ADVICE round-1 findings): dataflow wiring
+and large-literal contents must be part of the key."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from easydist_tpu.jaxfront.api import _compile_cache_key
+
+
+def _key(fn, *args):
+    closed = jax.make_jaxpr(fn)(*args)
+    return _compile_cache_key(closed, axis_specs=())
+
+
+def test_wiring_distinguishes_programs():
+    # same op/shape sequence, different operand routing
+    def f(a, b):
+        c = a * b
+        d = a + b
+        return c * d
+
+    def g(a, b):
+        c = a * b
+        d = a + b
+        return d * d
+
+    x = jnp.ones((4, 4))
+    assert _key(f, x, x) != _key(g, x, x)
+
+
+def test_large_literal_contents_distinguish_programs():
+    big0 = np.zeros((100, 100), np.float32)
+    big1 = np.zeros((100, 100), np.float32)
+    big1[50, 50] = 1.0  # repr() of both truncates identically
+
+    def f(a):
+        return a + big0
+
+    def g(a):
+        return a + big1
+
+    x = jnp.ones((100, 100))
+    assert _key(f, x) != _key(g, x)
+
+
+def test_identical_programs_share_key():
+    def f(a, b):
+        return a @ b + a
+
+    x = jnp.ones((4, 4))
+    assert _key(f, x, x) == _key(f, x, x)

@@ -200,3 +200,60 @@ def test_manual_mode_optimizer_mismatch_raises():
         make_torch_train_step(module, (x,), mse, optimizer="adam",
                               parallel_mode="ddp",
                               mesh=make_device_mesh((8,), ("d",)))
+
+
+class ChunkNet(nn.Module):
+    """chunk where dim is not divisible: torch.chunk(10, 4) -> [3, 3, 3, 1]."""
+
+    def forward(self, x):
+        a, b, c, d = torch.chunk(x, 4, dim=1)
+        return a.sum() + b.prod() + c.mean() + d.max()
+
+
+class DilatedPoolNet(nn.Module):
+    def forward(self, x):
+        return torch.nn.functional.max_pool2d(x, 2, stride=1, dilation=2)
+
+
+class GNBiasOnly(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.bias = nn.Parameter(torch.randn(8))
+
+    def forward(self, x):
+        return torch.nn.functional.group_norm(x, 2, weight=None,
+                                              bias=self.bias)
+
+
+def test_chunk_torch_semantics():
+    assert_matches_torch(ChunkNet(), (torch.randn(2, 10),))
+
+
+def test_max_pool2d_dilation():
+    assert_matches_torch(DilatedPoolNet(), (torch.randn(2, 3, 8, 8),))
+
+
+def test_max_pool2d_ceil_mode_raises():
+    from easydist_tpu.torchfront.convert import UnsupportedAtenOp
+
+    class CeilPool(nn.Module):
+        def forward(self, x):
+            return torch.nn.functional.max_pool2d(x, 2, ceil_mode=True)
+
+    x = torch.randn(2, 3, 7, 7)
+    fn, params = torch_module_to_jax(CeilPool(), (x,))
+    with pytest.raises(UnsupportedAtenOp):
+        fn(params, jnp.asarray(x.numpy()))
+
+
+def test_group_norm_bias_without_weight():
+    assert_matches_torch(GNBiasOnly(), (torch.randn(2, 8, 4),))
+
+
+def test_chunk_zero_size_dim():
+    class ZeroChunk(nn.Module):
+        def forward(self, x):
+            chunks = torch.chunk(x, 4, dim=1)
+            return sum(c.sum() for c in chunks)
+
+    assert_matches_torch(ZeroChunk(), (torch.zeros(2, 0),))
