@@ -79,6 +79,15 @@ class SpmdSolver:
         self.edges: List[_Edge] = []
         self._collect_edges()
         self._build_matrices()
+        # isomorphic-cluster tying: identical transformer layers share one
+        # set of ILP variables (reference pain point: per-cluster binaries,
+        # autoflow/solver.py:266-273 — an L-layer stack solved L times over)
+        self.tie_rep: Dict[int, int] = {c.cid: c.cid for c in self.clusters}
+        # under a hard memory cap, only non-uniform per-instance assignments
+        # may be feasible and refinement is disabled — solve untied
+        if edconfig.solver_cluster_dedup \
+                and edconfig.per_device_memory_cap <= 0:
+            self._compute_tie_groups()
 
     # ------------------------------------------------------------ model build
 
@@ -151,6 +160,55 @@ class SpmdSolver:
                     comm = comm * (1.0 - edconfig.comm_overlap_ratio)
             e.comm, e.mem = comm, mem
 
+    def _compute_tie_groups(self):
+        """Weisfeiler-Lehman style refinement: clusters with identical
+        strategy tables AND isomorphic cost environments collapse to one
+        representative.  Tying restricts the solution space to uniform
+        per-type strategies — exactly the repeated-layer optimum."""
+        import hashlib
+
+        def sig(c):
+            parts = [str(c.strategy_count())]
+            for uid, node in c.nodes.items():
+                parts.append(str([None if v is None else v.size_bytes()
+                                  for v in node.invars]))
+                parts.append(str([None if v is None else v.size_bytes()
+                                  for v in node.outvars]))
+            for s in range(c.strategy_count()):
+                for uid, (_, st) in c.strategies[s].items():
+                    parts.append(f"{st.in_placements}>{st.out_placements}")
+            yc = self.output_y_cost.get(c.cid)
+            parts.append("-" if yc is None else yc.tobytes().hex())
+            return hashlib.sha256("|".join(parts).encode()).hexdigest()
+
+        h = {c.cid: sig(c) for c in self.clusters}
+        # ONE refinement round: content + immediate cost environment.  More
+        # rounds would progressively split a repeated-layer chain from both
+        # ends (layer 2's depth-2 environment sees the distinct embedding),
+        # reverting the dedup; one round keeps boundary layers separate
+        # (where tying is actually risky) and ties the middle.
+        for _ in range(1):
+            env: Dict[int, list] = {c.cid: [] for c in self.clusters}
+            for e in self.edges:
+                ekey = hashlib.sha256(
+                    e.comm.tobytes() + e.mem.tobytes()
+                    + f"{e.out_idx}:{e.in_idx}".encode()).hexdigest()
+                env[e.up_cluster.cid].append(
+                    f"out:{ekey}:{h[e.down_cluster.cid]}")
+                env[e.down_cluster.cid].append(
+                    f"in:{ekey}:{h[e.up_cluster.cid]}")
+            h = {c.cid: hashlib.sha256(
+                    (h[c.cid] + "|".join(sorted(env[c.cid]))).encode()
+                 ).hexdigest() for c in self.clusters}
+
+        first: Dict[str, int] = {}
+        for c in self.clusters:
+            self.tie_rep[c.cid] = first.setdefault(h[c.cid], c.cid)
+        n_rep = len(set(self.tie_rep.values()))
+        if n_rep < len(self.clusters):
+            logger.info("[SpmdSolver] tied %d clusters into %d groups",
+                        len(self.clusters), n_rep)
+
     # ----------------------------------------------------------------- solve
 
     def solve(self) -> Dict[str, NodeStrategy]:
@@ -164,13 +222,28 @@ class SpmdSolver:
 
     def _ilp_solve(self) -> Dict[str, NodeStrategy]:
         start = time.perf_counter()
+        rep = self.tie_rep
+        rep_clusters = [c for c in self.clusters if rep[c.cid] == c.cid]
+
         y_offset: Dict[int, int] = {}
         nvar = 0
-        for c in self.clusters:
+        for c in rep_clusters:
             y_offset[c.cid] = nvar
             nvar += c.strategy_count()
         n_y = nvar
+
+        # tied edges with identical cost matrices collapse into one z block
+        # with a multiplicity weight
+        groups: Dict[tuple, list] = {}
         for e in self.edges:
+            key = (rep[e.up_cluster.cid], rep[e.down_cluster.cid],
+                   e.comm.tobytes(), e.mem.tobytes())
+            if key in groups:
+                groups[key][0] += 1
+            else:
+                groups[key] = [1, e]
+        edge_groups = list(groups.values())
+        for _, e in edge_groups:
             e.z_offset = nvar
             nvar += e.up_cluster.strategy_count() * e.down_cluster.strategy_count()
 
@@ -183,11 +256,11 @@ class SpmdSolver:
         # real comm decision.
         comm = np.zeros(nvar)
         mem = np.zeros(nvar)
-        for e in self.edges:
-            comm[e.z_offset:e.z_offset + e.comm.size] = e.comm.ravel()
-            mem[e.z_offset:e.z_offset + e.mem.size] = e.mem.ravel()
+        for count, e in edge_groups:
+            comm[e.z_offset:e.z_offset + e.comm.size] = count * e.comm.ravel()
+            mem[e.z_offset:e.z_offset + e.mem.size] = count * e.mem.ravel()
         for cid, costs in self.output_y_cost.items():
-            off = y_offset[cid]
+            off = y_offset[rep[cid]]
             comm[off:off + costs.size] += costs
         cost_scale = float(comm.max())
         if cost_scale > 0:
@@ -196,28 +269,30 @@ class SpmdSolver:
         min_comm_step = positive.min() if positive.size else 1.0
         mem_max = float(mem.max())
         if mem_max > 0:
-            n_active = max(len(self.edges), 1)
+            n_active = max(len(edge_groups), 1)
             mem = mem * (min_comm_step / (10.0 * n_active * mem_max))
         cost = comm + mem
 
         rows, cols, vals, lbs, ubs = [], [], [], [], []
         row = 0
         # one-hot cluster choice
-        for c in self.clusters:
+        for c in rep_clusters:
             for s in range(c.strategy_count()):
                 rows.append(row); cols.append(y_offset[c.cid] + s); vals.append(1.0)
             lbs.append(1.0); ubs.append(1.0)
             row += 1
         # z >= y_up + y_down - 1  <=>  z - y_up - y_down >= -1
-        for e in self.edges:
+        # (duplicate (row, col) entries sum in the sparse build, so a
+        # self-type edge yields z - 2 y_i >= -1 on the diagonal — correct)
+        for _, e in edge_groups:
             n_up = e.up_cluster.strategy_count()
             n_down = e.down_cluster.strategy_count()
             for i in range(n_up):
                 for j in range(n_down):
                     z = e.z_offset + i * n_down + j
                     rows += [row, row, row]
-                    cols += [z, y_offset[e.up_cluster.cid] + i,
-                             y_offset[e.down_cluster.cid] + j]
+                    cols += [z, y_offset[rep[e.up_cluster.cid]] + i,
+                             y_offset[rep[e.down_cluster.cid]] + j]
                     vals += [1.0, -1.0, -1.0]
                     lbs.append(-1.0); ubs.append(np.inf)
                     row += 1
@@ -243,7 +318,8 @@ class SpmdSolver:
                         p = c.strategies[s][n.uid][1].out_placements[out_idx]
                         if p is None:
                             continue
-                        rows.append(row); cols.append(y_offset[c.cid] + s)
+                        rows.append(row)
+                        cols.append(y_offset[rep[c.cid]] + s)
                         vals.append(placement_bytes(v.size_bytes(), p,
                                                     self.axis.size))
                         any_entry = True
@@ -262,17 +338,158 @@ class SpmdSolver:
         # status 1 = iteration/time limit: keep the incumbent if HiGHS found one
         if res.x is None or res.status not in (0, 1):
             raise RuntimeError(f"MILP failed: status={res.status} {res.message}")
-        logger.info("[SpmdSolver] axis=%s clusters=%d edges=%d vars=%d "
-                    "cost=%.3e time=%.2fs", self.axis.name, len(self.clusters),
-                    len(self.edges), nvar, res.fun, time.perf_counter() - start)
+        logger.info("[SpmdSolver] axis=%s clusters=%d (%d tied) edges=%d "
+                    "(%d grouped) vars=%d cost=%.3e time=%.2fs",
+                    self.axis.name, len(self.clusters), len(rep_clusters),
+                    len(self.edges), len(edge_groups), nvar, res.fun,
+                    time.perf_counter() - start)
+
+        picks: Dict[int, int] = {}
+        for c in self.clusters:
+            off = y_offset[rep[c.cid]]
+            ys = res.x[off:off + c.strategy_count()]
+            picks[c.cid] = int(np.argmax(ys))
+        if len(rep_clusters) < len(self.clusters):
+            # tying forces uniform per-group choices; a local refinement
+            # sweep recovers per-instance deviations the quotient model
+            # cannot express (e.g. boundary layers preferring a different
+            # shard dim).  Strictly monotone in the untied objective.
+            picks = self._refine(picks)
 
         chosen: Dict[str, NodeStrategy] = {}
         for c in self.clusters:
-            ys = res.x[y_offset[c.cid]:y_offset[c.cid] + c.strategy_count()]
-            s_idx = int(np.argmax(ys))
-            for uid, (_, strat) in c.strategies[s_idx].items():
+            for uid, (_, strat) in c.strategies[picks[c.cid]].items():
                 chosen[c.nodes[uid].name] = strat
         return chosen
+
+    def _refine(self, picks: Dict[int, int],
+                max_sweeps: int = 10) -> Dict[int, int]:
+        """Coordinate descent on the full (untied) model: re-pick each
+        cluster's strategy given its neighbors until a fixed point."""
+        if edconfig.per_device_memory_cap > 0:
+            # a local move could break the per-liveness-step cap the ILP
+            # enforced; keep the capped solution as-is
+            return picks
+        in_edges: Dict[int, List[_Edge]] = {}
+        out_edges: Dict[int, List[_Edge]] = {}
+        for e in self.edges:
+            in_edges.setdefault(e.down_cluster.cid, []).append(e)
+            out_edges.setdefault(e.up_cluster.cid, []).append(e)
+        all_comm = [c for e in self.edges for c in e.comm.ravel() if c > 0]
+        min_comm = min(all_comm) if all_comm else 1.0
+        max_mem = max((float(e.mem.max()) for e in self.edges), default=0.0)
+        w_mem = (min_comm / (10.0 * max(len(self.edges), 1) * max_mem)
+                 if max_mem > 0 else 0.0)
+        eps = 1e-12
+
+        def local_cost(c, s):
+            cost = 0.0
+            yc = self.output_y_cost.get(c.cid)
+            if yc is not None:
+                cost += float(yc[s])
+            for e in in_edges.get(c.cid, []):
+                i = picks[e.up_cluster.cid]
+                cost += e.comm[i, s] + w_mem * e.mem[i, s]
+            for e in out_edges.get(c.cid, []):
+                j = picks[e.down_cluster.cid]
+                cost += e.comm[s, j] + w_mem * e.mem[s, j]
+            return cost
+
+        by_cid = {c.cid: c for c in self.clusters}
+
+        def local_cost_overlay(c, s, overlay):
+            # edges into the moving region get a hair more weight so that a
+            # locally-indifferent node follows the chain instead of stalling
+            # the propagation at a tie (acceptance still uses true cost)
+            cost = 0.0
+            yc = self.output_y_cost.get(c.cid)
+            if yc is not None:
+                cost += float(yc[s])
+            for e in in_edges.get(c.cid, []):
+                up = e.up_cluster.cid
+                i = overlay.get(up, picks[up])
+                w = 1.0 + 1e-6 if up in overlay else 1.0
+                cost += w * (e.comm[i, s] + w_mem * e.mem[i, s])
+            for e in out_edges.get(c.cid, []):
+                dn = e.down_cluster.cid
+                j = overlay.get(dn, picks[dn])
+                w = 1.0 + 1e-6 if dn in overlay else 1.0
+                cost += w * (e.comm[s, j] + w_mem * e.mem[s, j])
+            return cost
+
+        def region_cost(cids, overlay):
+            total = 0.0
+            seen = set()
+            for cid in cids:
+                c = by_cid[cid]
+                s = overlay.get(cid, picks[cid])
+                yc = self.output_y_cost.get(cid)
+                if yc is not None:
+                    total += float(yc[s])
+                for e in in_edges.get(cid, []) + out_edges.get(cid, []):
+                    if id(e) in seen:
+                        continue
+                    seen.add(id(e))
+                    i = overlay.get(e.up_cluster.cid,
+                                    picks[e.up_cluster.cid])
+                    j = overlay.get(e.down_cluster.cid,
+                                    picks[e.down_cluster.cid])
+                    total += e.comm[i, j] + w_mem * e.mem[i, j]
+            return total
+
+        def try_flip(root, s_root, cap=64):
+            """Ejection chain: flip `root` to `s_root`, propagate each
+            neighbor's best response (tied optimizer chains are coupled
+            through zero-cost-when-consistent edges, so a profitable flip
+            only shows up when the whole chain moves), accept if the
+            affected region got cheaper."""
+            overlay = {root.cid: s_root}
+            frontier = [root]
+            while frontier and len(overlay) < cap:
+                c = frontier.pop()
+                peers = [e.up_cluster for e in in_edges.get(c.cid, [])] + \
+                        [e.down_cluster for e in out_edges.get(c.cid, [])]
+                for q in peers:
+                    if q.cid in overlay:
+                        continue
+                    costs = [local_cost_overlay(q, s, overlay)
+                             for s in range(q.strategy_count())]
+                    s_q = int(np.argmin(costs))
+                    if s_q != picks[q.cid] \
+                            and costs[s_q] < costs[picks[q.cid]] - 1e-18:
+                        overlay[q.cid] = s_q
+                        frontier.append(q)
+            cids = list(overlay)
+            if region_cost(cids, overlay) < region_cost(cids, {}) - eps:
+                picks.update(overlay)
+                return True
+            return False
+
+        moves = 0
+        for _ in range(max_sweeps):
+            changed = False
+            for c in self.clusters:
+                # cheap single move first, ejection chain if it is blocked
+                cur = picks[c.cid]
+                cur_cost = local_cost(c, cur)
+                for s in range(c.strategy_count()):
+                    if s == cur:
+                        continue
+                    if local_cost(c, s) < cur_cost - eps:
+                        picks[c.cid] = s
+                        cur, cur_cost = s, local_cost(c, s)
+                        changed = True
+                        moves += 1
+                    elif try_flip(c, s):
+                        cur, cur_cost = picks[c.cid], local_cost(
+                            c, picks[c.cid])
+                        changed = True
+                        moves += 1
+            if not changed:
+                break
+        if moves:
+            logger.info("[SpmdSolver] refinement applied %d moves", moves)
+        return picks
 
     # ----------------------------------------------------------- beam search
 

@@ -73,6 +73,10 @@ memory_ratio = _env_float("EASYDIST_MEMORY_RATIO", 0.9)
 liveness_only_input = _env_bool("EASYDIST_LIVENESS_ONLY_INPUT", False)
 solver_backend = os.environ.get("EASYDIST_SOLVER", "milp")  # milp | beam
 beam_width = _env_int("EASYDIST_BEAM_WIDTH", 100)
+# tie ILP variables of isomorphic clusters (identical transformer layers
+# collapse to one set of decision variables; solve time for an L-layer stack
+# approaches the 1-layer solve)
+solver_cluster_dedup = _env_bool("EASYDIST_SOLVER_CLUSTER_DEDUP", True)
 
 # ---------------- mesh / comm cost model ----------------
 # per-axis link bandwidth in bytes/s used to weight collective cost between

@@ -156,22 +156,34 @@ def make_gpt_train_step(cfg: GPTConfig, lr=1e-4):
 
 def make_gpt_pipeline_step(cfg: GPTConfig, mesh, n_microbatches: int,
                            lr: float = 1e-4, axis: str = "pp",
-                           data_axis=None, schedule: str = "gpipe"):
+                           data_axis=None, schedule: str = "gpipe",
+                           n_virtual: int = 1):
     """Pipeline-parallel GPT training: transformer blocks pipelined over the
     `pp` mesh axis (stage-stacked params), embedding/positional/head outside
     the pipelined middle (reference scenario: benchmark/torch/pp/gpt).
 
-    Requires cfg.layers % mesh.shape[axis] == 0.  Returns
+    schedule="gpipe"/"remat" differentiates through the forward pipeline;
+    schedule="1f1b" (optionally with n_virtual>1 interleaved chunks) runs
+    the DAPPLE-class supertick schedule with O(n_stages) live microbatches,
+    backpropagating into the embedding and head via the pipeline's aux
+    input/head gradients.
+
+    Requires cfg.layers % (n_stages * n_virtual) == 0.  Returns
     (train_step, init_state): state = (params, opt); train_step(state,
     tokens, targets) -> (state, loss); tokens [n_microbatches, mb, seq].
     """
-    from easydist_tpu.parallel import PipelineConfig, spmd_pipeline
+    from easydist_tpu.parallel import (PipelineConfig, spmd_pipeline,
+                                       spmd_pipeline_grad)
 
     n_stages = mesh.shape[axis]
-    if cfg.layers % n_stages != 0:
+    if n_virtual > 1 and schedule != "1f1b":
+        raise ValueError("n_virtual > 1 requires schedule='1f1b' "
+                         "(interleaving is a 1F1B schedule property)")
+    n_chunks = n_stages * max(1, n_virtual)
+    if cfg.layers % n_chunks != 0:
         raise ValueError(f"layers {cfg.layers} not divisible by "
-                         f"{n_stages} pipeline stages")
-    per_stage = cfg.layers // n_stages
+                         f"{n_chunks} pipeline stages x virtual chunks")
+    per_stage = cfg.layers // n_chunks
     dtype = jnp.dtype(cfg.dtype)
 
     def stage_fn(stage_blocks, x):
@@ -189,33 +201,67 @@ def make_gpt_pipeline_step(cfg: GPTConfig, mesh, n_microbatches: int,
         return x
 
     pipe_cfg = PipelineConfig(n_stages, n_microbatches, axis_name=axis,
-                              schedule=schedule, data_axis=data_axis)
-    pipe = spmd_pipeline(stage_fn, mesh, pipe_cfg)
+                              schedule=schedule, data_axis=data_axis,
+                              n_virtual=max(1, n_virtual))
 
     def stack_blocks(params):
-        # list of layer pytrees -> [n_stages, per_stage, ...] leading dims
+        # list of layer pytrees -> [n_chunks, per_stage, ...] leading dims
         blocks = params["blocks"]
         stages = []
-        for s in range(n_stages):
+        for s in range(n_chunks):
             chunk = blocks[s * per_stage:(s + 1) * per_stage]
             stages.append(jax.tree_util.tree_map(
                 lambda *xs: jnp.stack(xs), *chunk))
         return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *stages)
 
-    def forward(params, tokens_mb):
-        # tokens_mb: [M, mb, seq]
-        M, mb, seq = tokens_mb.shape
-        x = params["wte"][tokens_mb].astype(dtype) \
-            + params["wpe"].astype(dtype)[None, None, :seq]
-        x = pipe(stack_blocks(params), x)
-        x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
-        return x.astype(jnp.float32) @ params["wte"].T
+    def embed(wte, wpe, tokens_mb):
+        seq = tokens_mb.shape[-1]
+        return wte[tokens_mb].astype(dtype) \
+            + wpe.astype(dtype)[None, None, :seq]
 
-    def loss_fn(params, tokens_mb, targets_mb):
-        logits = forward(params, tokens_mb)
+    def head_loss(x_mb, targets_mb, hp):
+        x = _layernorm(x_mb, hp["ln_f"]["g"], hp["ln_f"]["b"])
+        logits = x.astype(jnp.float32) @ hp["wte"].T
         logp = jax.nn.log_softmax(logits, axis=-1)
         return -jnp.take_along_axis(logp, targets_mb[..., None],
                                     axis=-1).mean()
+
+    if schedule == "1f1b":
+        pipe_grad = spmd_pipeline_grad(stage_fn, head_loss, mesh, pipe_cfg,
+                                       aux=True)
+
+        def loss_and_grads(params, tokens_mb, targets_mb):
+            x_mb, emb_vjp = jax.vjp(
+                lambda wte, wpe: embed(wte, wpe, tokens_mb),
+                params["wte"], params["wpe"])
+            hp = {"ln_f": params["ln_f"], "wte": params["wte"]}
+            loss, sgrads, dx_mb, dhp = pipe_grad(
+                stack_blocks(params), x_mb, targets_mb, hp)
+            dwte_emb, dwpe = emb_vjp(dx_mb)
+            dblocks = [
+                jax.tree_util.tree_map(lambda l: l[s][i], sgrads)
+                for s in range(n_chunks) for i in range(per_stage)]
+            grads = {"wte": dwte_emb + dhp["wte"], "wpe": dwpe,
+                     "ln_f": dhp["ln_f"], "blocks": dblocks}
+            return loss, grads
+    else:
+        pipe = spmd_pipeline(stage_fn, mesh, pipe_cfg)
+
+        def forward(params, tokens_mb):
+            # tokens_mb: [M, mb, seq]
+            x = embed(params["wte"], params["wpe"], tokens_mb)
+            x = pipe(stack_blocks(params), x)
+            x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
+            return x.astype(jnp.float32) @ params["wte"].T
+
+        def loss_fn(params, tokens_mb, targets_mb):
+            logits = forward(params, tokens_mb)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.take_along_axis(logp, targets_mb[..., None],
+                                        axis=-1).mean()
+
+        def loss_and_grads(params, tokens_mb, targets_mb):
+            return jax.value_and_grad(loss_fn)(params, tokens_mb, targets_mb)
 
     def init_state(key):
         params = gpt_init(cfg, key)
@@ -223,8 +269,7 @@ def make_gpt_pipeline_step(cfg: GPTConfig, mesh, n_microbatches: int,
 
     def train_step(state, tokens_mb, targets_mb):
         params, opt = state
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens_mb,
-                                                  targets_mb)
+        loss, grads = loss_and_grads(params, tokens_mb, targets_mb)
         new_params, new_opt = adam_update(params, grads, opt, lr=lr)
         return (new_params, new_opt), loss
 

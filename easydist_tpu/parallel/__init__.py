@@ -6,7 +6,8 @@ Everything is expressed as compiled collective programs (`shard_map` +
 `ppermute`/`all_to_all`/`psum`) inside one XLA program — no eager P2P.
 """
 
-from .pipeline import spmd_pipeline, PipelineConfig  # noqa: F401
+from .pipeline import (spmd_pipeline, spmd_pipeline_grad,  # noqa: F401
+                       PipelineConfig)
 from .dp import ddp_step, zero_shard_params, zero2_step, zero3_step  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401
 from .ulysses import ulysses_attention  # noqa: F401

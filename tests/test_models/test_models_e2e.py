@@ -169,3 +169,70 @@ def test_gpt_pipeline_step_matches_plain(cpu_devices):
     np.testing.assert_allclose(float(loss), float(ref_loss),
                                rtol=1e-4, atol=1e-6)
     _tree_allclose(new_params, ref_params, rtol=1e-3, atol=1e-5)
+
+
+@pytest.mark.world_8
+@pytest.mark.long_duration
+@pytest.mark.parametrize("n_virtual", [1, 2])
+def test_gpt_1f1b_pipeline_step_matches_plain(cpu_devices, n_virtual):
+    """1F1B (and interleaved) pipelined GPT training step must match the
+    plain train step: embedding/head grads flow through the pipeline aux."""
+    from easydist_tpu.jaxfront import make_device_mesh
+    from easydist_tpu.models.gpt import make_gpt_pipeline_step
+
+    mesh_pp = make_device_mesh((4,), ("pp",), devices=cpu_devices[:4])
+    cfg = GPTConfig.tiny(layers=4 * n_virtual)
+    M, mb = 6, 2
+    pipe_step, pipe_init = make_gpt_pipeline_step(
+        cfg, mesh_pp, n_microbatches=M, schedule="1f1b",
+        n_virtual=n_virtual)
+    state = pipe_init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (M, mb, cfg.seq), 0,
+                                cfg.vocab)
+    (new_params, _), loss = jax.jit(pipe_step)(state, tokens, tokens)
+
+    plain_step, plain_init = make_gpt_train_step(cfg, lr=1e-4)
+    plain_state = plain_init(jax.random.PRNGKey(0))
+    merged = tokens.reshape(M * mb, cfg.seq)
+    (ref_params, _), ref_loss = plain_step(plain_state, merged, merged)
+    np.testing.assert_allclose(float(loss), float(ref_loss),
+                               rtol=1e-4, atol=1e-6)
+    _tree_allclose(new_params, ref_params, rtol=1e-3, atol=1e-5)
+
+
+@pytest.mark.world_8
+@pytest.mark.long_duration
+def test_gpt_1f1b_hybrid_pp_dp_matches_plain(cpu_devices):
+    """Hybrid pp x dp 1F1B: embedding/head grads must reflect the GLOBAL
+    mean loss (aux dxs 1/dp scaling)."""
+    from easydist_tpu.jaxfront import make_device_mesh
+    from easydist_tpu.models.gpt import make_gpt_pipeline_step
+
+    mesh = make_device_mesh((4, 2), ("pp", "dp"), devices=cpu_devices)
+    cfg = GPTConfig.tiny(layers=4)
+    M, mb = 4, 4
+    pipe_step, pipe_init = make_gpt_pipeline_step(
+        cfg, mesh, n_microbatches=M, schedule="1f1b", data_axis="dp")
+    state = pipe_init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (M, mb, cfg.seq), 0,
+                                cfg.vocab)
+    (new_params, _), loss = jax.jit(pipe_step)(state, tokens, tokens)
+
+    plain_step, plain_init = make_gpt_train_step(cfg, lr=1e-4)
+    plain_state = plain_init(jax.random.PRNGKey(0))
+    merged = tokens.reshape(M * mb, cfg.seq)
+    (ref_params, _), ref_loss = plain_step(plain_state, merged, merged)
+    np.testing.assert_allclose(float(loss), float(ref_loss),
+                               rtol=1e-4, atol=1e-6)
+    _tree_allclose(new_params, ref_params, rtol=1e-3, atol=1e-5)
+
+
+def test_gpt_pipeline_rejects_virtual_without_1f1b(cpu_devices):
+    from easydist_tpu.jaxfront import make_device_mesh
+    from easydist_tpu.models.gpt import make_gpt_pipeline_step
+
+    mesh = make_device_mesh((4,), ("pp",), devices=cpu_devices[:4])
+    with pytest.raises(ValueError, match="n_virtual"):
+        make_gpt_pipeline_step(GPTConfig.tiny(layers=8), mesh,
+                               n_microbatches=4, schedule="gpipe",
+                               n_virtual=2)

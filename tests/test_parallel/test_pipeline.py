@@ -129,3 +129,149 @@ def test_hybrid_pp_dp_tp(mesh_3d):
     want = jnp.stack(want)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B (DAPPLE-class) schedule
+
+
+def _loss_fn(out_mb, tgt_mb):
+    return jnp.mean((out_mb - tgt_mb) ** 2)
+
+
+def _seq_loss_and_grads(stacked, x, tgt, S):
+    def total(params):
+        stages = [jax.tree_util.tree_map(lambda q: q[i], params)
+                  for i in range(S)]
+        out = sequential(stages, x)
+        return jnp.mean(jax.vmap(_loss_fn)(out, tgt))
+
+    return jax.value_and_grad(total)(stacked)
+
+
+@pytest.mark.world_8
+@pytest.mark.parametrize("M", [4, 8, 11])
+def test_1f1b_matches_sequential(mesh_pp, M):
+    from easydist_tpu.parallel import spmd_pipeline_grad
+
+    S, mb, d = 4, 2, 8
+    stages = make_stages(jax.random.PRNGKey(6), S, d)
+    x = jax.random.normal(jax.random.PRNGKey(7), (M, mb, d))
+    tgt = jax.random.normal(jax.random.PRNGKey(8), (M, mb, d))
+    stacked = stack_stage_params(stages)
+
+    pipe = jax.jit(spmd_pipeline_grad(
+        stage_fn, _loss_fn, mesh_pp, PipelineConfig(S, M, schedule="1f1b")))
+    loss, grads = pipe(stacked, x, tgt)
+    want_loss, want_grads = _seq_loss_and_grads(stacked, x, tgt, S)
+
+    np.testing.assert_allclose(float(loss), float(want_loss),
+                               rtol=1e-5, atol=1e-7)
+    for a, b in zip(jax.tree_util.tree_leaves(grads),
+                    jax.tree_util.tree_leaves(want_grads)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.world_8
+def test_1f1b_gpipe_grad_paths_agree(mesh_pp):
+    from easydist_tpu.parallel import spmd_pipeline_grad
+
+    S, M, mb, d = 4, 8, 2, 8
+    stages = make_stages(jax.random.PRNGKey(9), S, d)
+    x = jax.random.normal(jax.random.PRNGKey(10), (M, mb, d))
+    tgt = jax.random.normal(jax.random.PRNGKey(11), (M, mb, d))
+    stacked = stack_stage_params(stages)
+
+    out = {}
+    for sched in ("gpipe", "1f1b"):
+        pipe = jax.jit(spmd_pipeline_grad(
+            stage_fn, _loss_fn, mesh_pp,
+            PipelineConfig(S, M, schedule=sched)))
+        out[sched] = pipe(stacked, x, tgt)
+    np.testing.assert_allclose(float(out["gpipe"][0]), float(out["1f1b"][0]),
+                               rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(out["gpipe"][1]),
+                    jax.tree_util.tree_leaves(out["1f1b"][1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
+
+
+@pytest.mark.world_8
+def test_1f1b_hybrid_pp_dp(cpu_devices):
+    from easydist_tpu.parallel import spmd_pipeline_grad
+
+    mesh = make_device_mesh((4, 2), ("pp", "dp"), devices=cpu_devices)
+    S, M, mb, d = 4, 4, 4, 8
+    stages = make_stages(jax.random.PRNGKey(12), S, d)
+    x = jax.random.normal(jax.random.PRNGKey(13), (M, mb, d))
+    tgt = jax.random.normal(jax.random.PRNGKey(14), (M, mb, d))
+    stacked = stack_stage_params(stages)
+
+    pipe = jax.jit(spmd_pipeline_grad(
+        stage_fn, _loss_fn, mesh,
+        PipelineConfig(S, M, schedule="1f1b", data_axis="dp")))
+    loss, grads = pipe(stacked, x, tgt)
+    want_loss, want_grads = _seq_loss_and_grads(stacked, x, tgt, S)
+    np.testing.assert_allclose(float(loss), float(want_loss), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(grads),
+                    jax.tree_util.tree_leaves(want_grads)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.world_8
+def test_1f1b_memory_is_o_stages_not_o_microbatches(mesh_pp):
+    """The point of 1F1B: peak live residual memory stays flat as M grows,
+    while gpipe's grows linearly (VERDICT r1 #2; reference ScheduleDAPPLE,
+    pp/runtime.py:658-700).  Measured via XLA memory_analysis."""
+    from easydist_tpu.parallel import spmd_pipeline_grad
+
+    S, mb, d = 4, 8, 64
+
+    def temp_bytes(sched, M):
+        stages = make_stages(jax.random.PRNGKey(15), S, d)
+        x = jnp.zeros((M, mb, d))
+        tgt = jnp.zeros((M, mb, d))
+        stacked = stack_stage_params(stages)
+        pipe = spmd_pipeline_grad(stage_fn, _loss_fn, mesh_pp,
+                                  PipelineConfig(S, M, schedule=sched))
+        compiled = jax.jit(pipe).lower(stacked, x, tgt).compile()
+        ma = compiled.memory_analysis()
+        assert ma is not None
+        return ma.temp_size_in_bytes
+
+    m_small, m_big = 8, 32
+    growth_1f1b = temp_bytes("1f1b", m_big) / temp_bytes("1f1b", m_small)
+    growth_gpipe = temp_bytes("gpipe", m_big) / temp_bytes("gpipe", m_small)
+    # gpipe live set grows ~4x with 4x microbatches; 1f1b stays ~flat
+    assert growth_1f1b < 2.0, growth_1f1b
+    assert growth_gpipe > 2.5, growth_gpipe
+    assert temp_bytes("1f1b", m_big) < temp_bytes("gpipe", m_big)
+
+
+@pytest.mark.world_8
+@pytest.mark.parametrize("M", [8, 10])
+def test_interleaved_1f1b_matches_sequential(mesh_pp, M):
+    """Interleaved virtual stages: 8 chunks on 4 devices (chunk j on device
+    j % 4), Megatron-style grouped microbatches."""
+    from easydist_tpu.parallel import spmd_pipeline_grad
+
+    S, V, mb, d = 4, 2, 2, 8
+    J = S * V
+    stages = make_stages(jax.random.PRNGKey(20), J, d)
+    x = jax.random.normal(jax.random.PRNGKey(21), (M, mb, d))
+    tgt = jax.random.normal(jax.random.PRNGKey(22), (M, mb, d))
+    stacked = stack_stage_params(stages)
+
+    pipe = jax.jit(spmd_pipeline_grad(
+        stage_fn, _loss_fn, mesh_pp,
+        PipelineConfig(S, M, schedule="1f1b", n_virtual=V)))
+    loss, grads = pipe(stacked, x, tgt)
+    want_loss, want_grads = _seq_loss_and_grads(stacked, x, tgt, J)
+    np.testing.assert_allclose(float(loss), float(want_loss),
+                               rtol=1e-5, atol=1e-7)
+    for a, b in zip(jax.tree_util.tree_leaves(grads),
+                    jax.tree_util.tree_leaves(want_grads)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
