@@ -24,11 +24,15 @@ class MeshAxisSpec:
     size: int
     bandwidth: float = 0.0  # bytes/s; 0 -> ICI default
     kind: str = "ici"  # "ici" | "dcn"
+    latency: float = -1.0  # seconds per collective launch; <0 -> default
 
     def __post_init__(self):
         if self.bandwidth == 0.0:
             self.bandwidth = (edconfig.dcn_bandwidth if self.kind == "dcn"
                               else edconfig.ici_bandwidth)
+        if self.latency < 0.0:
+            self.latency = (edconfig.dcn_latency if self.kind == "dcn"
+                            else edconfig.ici_latency)
 
 
 def _all_gather(x: float, n: int) -> float:
@@ -75,7 +79,14 @@ def resharding_cost(var_bytes: float, up: Placement, down: Placement,
     else:  # R -> anything is a local slice / no-op
         bytes_wire = 0.0
 
-    return bytes_wire / axis.bandwidth
+    if bytes_wire == 0.0:
+        return 0.0
+    # alpha-beta model: a collective pays a fixed launch/synchronization
+    # latency on top of wire time.  Without the alpha term, sharding a tiny
+    # bias is bytes-equal to replicating it (reduce_scatter + all_gather ==
+    # all_reduce) and the memory tie-break scatters small params across the
+    # mesh, emitting dozens of sub-KB collectives that cost pure latency.
+    return axis.latency + bytes_wire / axis.bandwidth
 
 
 def placement_bytes(var_bytes: float, p: Placement, axis_size: int) -> float:

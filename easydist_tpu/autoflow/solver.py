@@ -119,8 +119,33 @@ class SpmdSolver:
         # non-state graph outputs are handed back to the user replicated, so a
         # PARTIAL or SHARD producer pays the final collective here (reference
         # forces returns to REPLICATE, torch/passes/sharding.py:920-949).
-        # Linear cost on the producer cluster's y variables.
+        # Linear cost on the producer cluster's y variables.  The same
+        # vector carries the compute-redundancy cost: a strategy that
+        # replicates an op's outputs runs the op full-size on every device,
+        # while sharded/partial outputs split the work 1/n — without this
+        # term, replicate-everything is a free zero-communication optimum.
         self.output_y_cost: Dict[int, np.ndarray] = {}
+        inv_hbm = 1.0 / edconfig.hbm_bandwidth
+        for c in self.clusters:
+            costs = None
+            for s in range(c.strategy_count()):
+                t = 0.0
+                for uid, (_, strat) in c.strategies[s].items():
+                    node = c.nodes[uid]
+                    if node.is_input:
+                        continue
+                    out_bytes = sum(v.size_bytes() for v in node.outvars
+                                    if v is not None)
+                    sharded = any(p is not None and not p.is_replicate()
+                                  for p in strat.out_placements)
+                    factor = (1.0 / self.axis.size) if sharded else 1.0
+                    t += factor * out_bytes * inv_hbm
+                if t > 0.0:
+                    if costs is None:
+                        costs = np.zeros(c.strategy_count())
+                    costs[s] = t
+            if costs is not None:
+                self.output_y_cost[c.cid] = costs
         state_outs = set(self.graph.state_io)
         for var in self.graph.outputs:
             if var.name in state_outs or var.producer is None:
@@ -281,21 +306,34 @@ class SpmdSolver:
                 rows.append(row); cols.append(y_offset[c.cid] + s); vals.append(1.0)
             lbs.append(1.0); ubs.append(1.0)
             row += 1
-        # z >= y_up + y_down - 1  <=>  z - y_up - y_down >= -1
-        # (duplicate (row, col) entries sum in the sparse build, so a
-        # self-type edge yields z - 2 y_i >= -1 on the diagonal — correct)
+        # marginal (transportation) formulation — tighter LP relaxation than
+        # z >= y_up + y_down - 1 and fewer rows (n_up + n_down per edge):
+        #   sum_j z[i, j] == y_up[i],  sum_i z[i, j] == y_down[j]
+        # with integral y the z become exactly the indicator of the chosen
+        # pair; the LP picks the cheapest joint consistent with the
+        # marginals.  (A self-type edge's rows stay valid: both marginal
+        # systems constrain the same tied y vector.)
         for _, e in edge_groups:
             n_up = e.up_cluster.strategy_count()
             n_down = e.down_cluster.strategy_count()
+            up_off = y_offset[rep[e.up_cluster.cid]]
+            down_off = y_offset[rep[e.down_cluster.cid]]
             for i in range(n_up):
                 for j in range(n_down):
-                    z = e.z_offset + i * n_down + j
-                    rows += [row, row, row]
-                    cols += [z, y_offset[rep[e.up_cluster.cid]] + i,
-                             y_offset[rep[e.down_cluster.cid]] + j]
-                    vals += [1.0, -1.0, -1.0]
-                    lbs.append(-1.0); ubs.append(np.inf)
-                    row += 1
+                    rows.append(row)
+                    cols.append(e.z_offset + i * n_down + j)
+                    vals.append(1.0)
+                rows.append(row); cols.append(up_off + i); vals.append(-1.0)
+                lbs.append(0.0); ubs.append(0.0)
+                row += 1
+            for j in range(n_down):
+                for i in range(n_up):
+                    rows.append(row)
+                    cols.append(e.z_offset + i * n_down + j)
+                    vals.append(1.0)
+                rows.append(row); cols.append(down_off + j); vals.append(-1.0)
+                lbs.append(0.0); ubs.append(0.0)
+                row += 1
 
         # optional hard memory cap per liveness step
         cap = edconfig.per_device_memory_cap
@@ -304,6 +342,11 @@ class SpmdSolver:
             producer_cluster = {}
             for c in self.clusters:
                 for n in c.nodes.values():
+                    # liveness_only_input: cap only placeholder tensors
+                    # (params/state dominate; activations churn fast —
+                    # reference config.liveness_only_input)
+                    if edconfig.liveness_only_input and not n.is_input:
+                        continue
                     for v in n.outvars:
                         if v is not None:
                             producer_cluster[v.name] = (c, n, v.producer_idx)
@@ -334,7 +377,11 @@ class SpmdSolver:
                    constraints=LinearConstraint(A, np.array(lbs), np.array(ubs)),
                    integrality=integrality,
                    bounds=Bounds(0, 1),
-                   options={"time_limit": edconfig.solver_time_limit})
+                   options={"time_limit": edconfig.solver_time_limit,
+                            # plateaus of equal-cost optima (latency and
+                            # compute terms quantize) make optimality proofs
+                            # explode; a small gap ends the search early
+                            "mip_rel_gap": edconfig.solver_mip_rel_gap})
         # status 1 = iteration/time limit: keep the incumbent if HiGHS found one
         if res.x is None or res.status not in (0, 1):
             raise RuntimeError(f"MILP failed: status={res.status} {res.message}")
@@ -349,12 +396,13 @@ class SpmdSolver:
             off = y_offset[rep[c.cid]]
             ys = res.x[off:off + c.strategy_count()]
             picks[c.cid] = int(np.argmax(ys))
-        if len(rep_clusters) < len(self.clusters):
-            # tying forces uniform per-group choices; a local refinement
-            # sweep recovers per-instance deviations the quotient model
-            # cannot express (e.g. boundary layers preferring a different
-            # shard dim).  Strictly monotone in the untied objective.
-            picks = self._refine(picks)
+        # Local refinement always runs: it recovers per-instance deviations
+        # the tied quotient model cannot express AND deterministically
+        # enforces the memory tie-break that mip_rel_gap's early stop may
+        # leave on the table (the gap tolerance is orders of magnitude
+        # larger than the scaled memory term).  Strictly monotone in the
+        # untied objective.
+        picks = self._refine(picks)
 
         chosen: Dict[str, NodeStrategy] = {}
         for c in self.clusters:

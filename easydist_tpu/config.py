@@ -26,7 +26,7 @@ def _env_float(name: str, default: float) -> float:
 # ---------------- logging / dumps ----------------
 log_level = getattr(logging, os.environ.get("EASYDIST_LOGLEVEL", "INFO").upper())
 dump_dir = os.environ.get("EASYDIST_DUMP_DIR", None)
-dump_strategy = _env_bool("EASYDIST_DUMP_STRATEGY", False)
+dump_strategy = _env_bool("EASYDIST_DUMP_STRATEGY", True)
 dump_cluster = _env_bool("EASYDIST_DUMP_CLUSTER", False)
 
 # ---------------- compile cache ----------------
@@ -58,6 +58,7 @@ discovery_max_candidates = _env_int("EASYDIST_DISCOVERY_MAX_CANDIDATES", 4096)
 enable_graph_coarsen = _env_bool("EASYDIST_ENABLE_GRAPH_COARSEN", True)
 coarsen_level = _env_int("EASYDIST_COARSEN_LEVEL", 1)
 solver_time_limit = _env_float("EASYDIST_SOLVER_TIME_LIMIT", 60.0)
+solver_mip_rel_gap = _env_float("EASYDIST_SOLVER_MIP_REL_GAP", 1e-3)
 all_to_all_punish_factor = _env_float("EASYDIST_ALL_TO_ALL_PUNISH", 3.0)
 # allow re-picking a strategy already chosen on a previous mesh axis
 allow_repeated_axis_strategy = _env_bool("EASYDIST_ALLOW_REPEATED_AXIS_STRATEGY", False)
@@ -65,8 +66,9 @@ allow_repeated_axis_strategy = _env_bool("EASYDIST_ALLOW_REPEATED_AXIS_STRATEGY"
 # (reference predict_comm_overlap + comm_overlap_ratio, solver.py:74-84)
 predict_comm_overlap = _env_bool("EASYDIST_PREDICT_COMM_OVERLAP", False)
 comm_overlap_ratio = _env_float("EASYDIST_COMM_OVERLAP_RATIO", 0.5)
-# memory-aware solving: weight on per-device memory in the objective
-mem_cost_weight = _env_float("EASYDIST_MEM_COST_WEIGHT", 1e-8)
+# (mem_cost_weight was removed: the solver derives the memory tie-break
+# weight from the comm-cost scale so it can order comm-equal solutions but
+# never flip a comm decision — a fixed weight could do either)
 # hard per-device memory cap in bytes (0 = unconstrained); v5e has 16 GiB HBM
 per_device_memory_cap = _env_int("EASYDIST_MEMORY_CAP", 0)
 memory_ratio = _env_float("EASYDIST_MEMORY_RATIO", 0.9)
@@ -84,6 +86,13 @@ solver_cluster_dedup = _env_bool("EASYDIST_SOLVER_CLUSTER_DEDUP", True)
 # links/chip ≈ 200 GB/s; DCN ≈ 25 GB/s per host.
 ici_bandwidth = _env_float("EASYDIST_ICI_BANDWIDTH", 2.0e11)
 dcn_bandwidth = _env_float("EASYDIST_DCN_BANDWIDTH", 2.5e10)
+# alpha term: fixed seconds per collective launch (ring setup + sync); makes
+# the solver stop scattering tiny tensors whose collectives are pure latency
+ici_latency = _env_float("EASYDIST_ICI_LATENCY", 1.0e-6)
+dcn_latency = _env_float("EASYDIST_DCN_LATENCY", 2.0e-5)
+# HBM bandwidth (bytes/s): prices the compute-redundancy of replicated ops
+# (elementwise ops are memory-bound; v5e ~ 810 GB/s)
+hbm_bandwidth = _env_float("EASYDIST_HBM_BANDWIDTH", 8.1e11)
 multihost = _env_bool("EASYDIST_MULTIHOST", False)
 
 # ---------------- runtime ----------------

@@ -155,6 +155,14 @@ def _compile_cache_key(closed_jaxpr, axis_specs) -> str:
     from .interpreter import VarNames, eqn_signature, hash_array_bytes
 
     h = hashlib.sha256()
+    # schema + cost-model salt: cached strategies are only valid for the
+    # solver/cost-model that produced them; a version bump or a tuned
+    # bandwidth/latency knob must miss, not silently serve stale plans
+    h.update(("v2|" + "|".join(
+        f"{k}={getattr(edconfig, k)}" for k in
+        ("ici_bandwidth", "dcn_bandwidth", "ici_latency", "dcn_latency",
+         "hbm_bandwidth", "all_to_all_punish_factor",
+         "solver_cluster_dedup", "per_device_memory_cap"))).encode())
     names = VarNames()
     for v in closed_jaxpr.jaxpr.invars:
         names.name(v)
@@ -213,15 +221,22 @@ def _dump_strategies(graph, per_axis, axis_names):
     import os
 
     os.makedirs(edconfig.dump_dir, exist_ok=True)
-    if graph is not None:
+    if graph is not None and edconfig.dump_cluster:
         with open(os.path.join(edconfig.dump_dir, "metair.txt"), "w") as f:
             f.write(repr(graph))
-    with open(os.path.join(edconfig.dump_dir, "strategies.txt"), "w") as f:
-        names = sorted({n for chosen in per_axis for n in chosen})
-        for name in names:
-            parts = [f"{ax}: {chosen.get(name)}"
-                     for ax, chosen in zip(axis_names, per_axis)]
-            f.write(f"{name}\n  " + "\n  ".join(parts) + "\n")
+        with open(os.path.join(edconfig.dump_dir, "clusters.txt"), "w") as f:
+            for c in graph.clusters:
+                node_names = [n.name for n in c.nodes.values()]
+                f.write(f"cluster {c.cid}: {len(c.strategies)} strategies; "
+                        f"nodes {node_names}\n")
+    if edconfig.dump_strategy:
+        with open(os.path.join(edconfig.dump_dir, "strategies.txt"),
+                  "w") as f:
+            names = sorted({n for chosen in per_axis for n in chosen})
+            for name in names:
+                parts = [f"{ax}: {chosen.get(name)}"
+                         for ax, chosen in zip(axis_names, per_axis)]
+                f.write(f"{name}\n  " + "\n  ".join(parts) + "\n")
     logger.info("strategies dumped to %s", edconfig.dump_dir)
 
 
@@ -353,6 +368,8 @@ def compile_step(func, args, kwargs, mesh=None, state_io="auto",
                                    state_io=state_io_names)
 
         def exclude_map(node, _prev=tuple(prev_chosen)):
+            if edconfig.allow_repeated_axis_strategy:
+                return []
             out = []
             for chosen in _prev:
                 s = chosen.get(node.name)
@@ -360,7 +377,9 @@ def compile_step(func, args, kwargs, mesh=None, state_io="auto",
                     out.append(s)
             return out
 
-        graph.coarsen(axis.size, level=edconfig.coarsen_level,
+        coarsen_level = (edconfig.coarsen_level
+                         if edconfig.enable_graph_coarsen else 0)
+        graph.coarsen(axis.size, level=coarsen_level,
                       exclude_map=exclude_map)
         reach = None
         if edconfig.predict_comm_overlap:
@@ -419,6 +438,19 @@ def _finish_compile(closed_jaxpr, jaxpr, names, per_axis, graph, axis_specs,
     # ---- emit + jit
     sharded_fn = emit_sharded_fn(closed_jaxpr, names, per_axis_final,
                                  axis_names, mesh)
+    if edconfig.remat_policy != "none":
+        # rematerialization policy for callers who differentiate THROUGH the
+        # compiled function (a compiled train step already contains its own
+        # autodiff and is unaffected): "dots" saves matmul outputs only,
+        # "all" recomputes everything
+        policies = {"dots": jax.checkpoint_policies.checkpoint_dots,
+                    "all": jax.checkpoint_policies.nothing_saveable}
+        policy = policies.get(edconfig.remat_policy)
+        if policy is None:
+            raise ValueError(
+                f"unknown remat_policy {edconfig.remat_policy!r}; "
+                f"expected none|dots|all")
+        sharded_fn = jax.checkpoint(sharded_fn, policy=policy)
     if donate_state is None:
         donate_state = edconfig.enable_donation
     donate = tuple(sorted(set(state_pairs.values()))) if donate_state else ()
@@ -485,6 +517,8 @@ class CompiledFunction:
         self.compile_only = compile_only
         self._cache: Dict[object, CompileResult] = {}
         self._last: Optional[CompileResult] = None
+        self._perfdb = None
+        self._warmed: set = set()
         functools.update_wrapper(self, func)
 
     @staticmethod
@@ -514,6 +548,8 @@ class CompiledFunction:
             # hot path: zero Python beyond jit dispatch; a shape/tree change
             # raises SignatureMismatch during retrace and falls through
             try:
+                if edconfig.enable_runtime_prof:
+                    return self._profiled_call(args, kwargs)
                 return self._last.tree_jitted(*args, **kwargs)
             except SignatureMismatch:
                 pass
@@ -522,7 +558,35 @@ class CompiledFunction:
         self._last = result
         if self.compile_only:
             return result
+        if edconfig.enable_runtime_prof:
+            return self._profiled_call(args, kwargs)
         return result.tree_jitted(*args, **kwargs)
+
+    def _profiled_call(self, args, kwargs):
+        """Fenced per-step timing recorded into the persistent PerfDB
+        (EASYDIST_RUNTIME_PROF; reference graph_profile_db)."""
+        from easydist_tpu.runtime.perfdb import PerfDB
+
+        t0 = time.perf_counter()
+        out = self._last.tree_jitted(*args, **kwargs)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        if id(self._last) not in self._warmed:
+            # first call pays trace + XLA compile; recording it would put a
+            # 100-1000x outlier into the persistent step-time history
+            self._warmed.add(id(self._last))
+            return out
+        if self._perfdb is None:
+            self._perfdb = PerfDB()
+        key = getattr(self.func, "__name__", "step")
+        hist = self._perfdb.get_op_perf("step_times", key) or []
+        hist = (hist + [dt])[-64:]
+        self._perfdb.record_op_perf("step_times", key, hist)
+        try:
+            self._perfdb.persist()
+        except Exception:
+            pass
+        return out
 
 
 def easydist_compile(func=None, mesh=None, state_io="auto",

@@ -19,6 +19,7 @@ from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.extend import core as jex_core
 
 from easydist_tpu import config as edconfig
@@ -171,12 +172,31 @@ class ShardingAnalyzer:
                 pass  # unalignable view: fall through to execution discovery
 
         subfuns, bind_params = eqn.primitive.get_bind_params(eqn.params)
-        invals = [read_concrete(v) for v in eqn.invars]
 
         def bind_fn(*tensors, **params):
             with jax.disable_jit():
                 return eqn.primitive.bind(*subfuns, *tensors, **params)
 
+        # hint shrink (reference get_hint_size, sharding_interpreter.py:
+        # 256-313): execution discovery on a huge unpreset op would run it
+        # eagerly nshards x candidates times — discover on a proportionally
+        # shrunk instance instead.  Equal dim sizes shrink together (keeps
+        # contraction/broadcast consistency); rules are dim-indexed so they
+        # transfer to the original shapes.  Ops whose params encode shapes
+        # fail the shrunk bind and fall through to full-size discovery.
+        total = sum(int(np.prod(v.aval.shape)) for v in eqn.invars
+                    if not isinstance(v, jex_core.Literal)
+                    and hasattr(v.aval, "shape"))
+        total += sum(int(np.prod(v.aval.shape)) for v in eqn.outvars
+                     if hasattr(v.aval, "shape"))
+        if total > edconfig.discovery_hint_numel:
+            rule = self._discover_shrunk(eqn, bind_fn, bind_params, prim_name)
+            if rule is not None:
+                logger.info("discovery hint-shrink applied to %s (%d elems)",
+                            prim_name, total)
+                return rule
+
+        invals = [read_concrete(v) for v in eqn.invars]
         op = MetaOp(bind_fn, tuple(invals), kwargs=bind_params,
                     name=prim_name)
         prompt = self.prompts.get(prim_name)
@@ -186,6 +206,74 @@ class ShardingAnalyzer:
             logger.warning("discovery failed for %s (%s): %s — replicating",
                            prim_name, sig, e)
             space, recombines = ShardSpace.for_args(op.flat_args), {}
+        if prim_name not in self.prompts and space.max_group() > 0:
+            self.prompts[prim_name] = space
+        return {"space": space, "recombines": recombines}
+
+    def _discover_shrunk(self, eqn, bind_fn, bind_params, prim_name):
+        """Discovery on a size-reduced instance of the eqn, or None if the
+        primitive rejects the shrunk shapes (shape-dependent params)."""
+        import types
+
+        unit = max(self.world_size * edconfig.discovery_nshards, 8)
+        sizes = sorted({d for v in list(eqn.invars) + list(eqn.outvars)
+                        if hasattr(getattr(v, "aval", None), "shape")
+                        for d in v.aval.shape if d > unit}, reverse=True)
+
+        def shrunk_total(size_map):
+            # inputs AND outputs: an output-dominated op (big matmul result)
+            # must shrink too, or discovery materializes it full-size
+            t = 0
+            for v in list(eqn.invars) + list(eqn.outvars):
+                if isinstance(v, jex_core.Literal) \
+                        or not hasattr(getattr(v, "aval", None), "shape"):
+                    continue
+                t += int(np.prod([size_map.get(d, d) for d in v.aval.shape]))
+            return t
+
+        size_map = {}
+        # halve the largest mapped sizes (to a multiple of `unit`) until the
+        # inputs fit the hint budget
+        for _ in range(64):
+            if shrunk_total(size_map) <= edconfig.discovery_hint_numel:
+                break
+            grew = False
+            for d in sizes:
+                cur = size_map.get(d, d)
+                nxt = max((cur // 2) // unit * unit, unit)
+                if nxt < cur:
+                    size_map[d] = nxt
+                    grew = True
+                    break
+            if not grew:
+                return None
+        if not size_map:
+            return None
+
+        with jax.default_device(
+                jax.local_devices(backend="cpu")[0]
+                if edconfig.discovery_on_cpu else jax.devices()[0]):
+            invals = []
+            for v in eqn.invars:
+                if isinstance(v, jex_core.Literal):
+                    invals.append(v.val)
+                    continue
+                aval = v.aval
+                shape = tuple(size_map.get(d, d) for d in aval.shape)
+                invals.append(_materialize(
+                    types.SimpleNamespace(shape=shape, dtype=aval.dtype),
+                    self._next_key()))
+            try:
+                bind_fn(*invals, **bind_params)  # params consistent?
+            except Exception:
+                return None
+            op = MetaOp(bind_fn, tuple(invals), kwargs=bind_params,
+                        name=prim_name)
+            try:
+                space, recombines = op.discover(
+                    prompt=self.prompts.get(prim_name))
+            except Exception:
+                return None
         if prim_name not in self.prompts and space.max_group() > 0:
             self.prompts[prim_name] = space
         return {"space": space, "recombines": recombines}

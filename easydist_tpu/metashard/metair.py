@@ -236,15 +236,15 @@ class MetaNode:
 
         pool = [s for s in self._pool_cache
                 if divisible(s) and all(s != e for e in exclude)]
-        # Placeholders (weights/inputs) may always be replicated — the
-        # reference forces them to shard (its replicate branch is commented
-        # out, metair.py:441-443), which mis-prices DP weight replication.
-        # Compute ops deliberately do NOT get a replicate choice: with a
-        # comm-only objective, replicating all compute is a degenerate
-        # "zero-communication" optimum with no parallelism.
+        # Every op (placeholders AND compute) may replicate — the reference
+        # forces shards (its replicate branch is commented out,
+        # metair.py:441-443), which mis-prices DP weight replication.  The
+        # zero-communication all-replicate degeneracy this would create
+        # under a comm-only objective is priced away by the solver's
+        # compute-redundancy cost (replicated compute runs full-size on
+        # every device; sharded runs 1/n — see SpmdSolver._collect_edges).
         rep = self.replicate_strategy()
-        if self.is_input and all(s != rep for s in pool) \
-                and all(rep != e for e in exclude):
+        if all(s != rep for s in pool) and all(rep != e for e in exclude):
             pool.append(rep)
         if not pool:
             pool = [rep]

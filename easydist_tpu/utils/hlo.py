@@ -1,0 +1,56 @@
+"""Optimized-HLO introspection: collective op census.
+
+The single-chip benchmark cannot see plan quality (solving is skipped on a
+1-device mesh), so the quality gate compares the collectives the compiled
+program actually contains against a hand-written GSPMD sharding of the same
+step (reference measurement discipline: benchmark/torch/bench_torch.py:50-100).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Tuple
+
+_DTYPE_BYTES = {"f64": 8, "s64": 8, "u64": 8, "f32": 4, "s32": 4, "u32": 4,
+                "bf16": 2, "f16": 2, "s16": 2, "u16": 2, "s8": 1, "u8": 1,
+                "pred": 1}
+
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+                "collective-permute")
+
+
+def collective_summary(hlo_text: str) -> Dict[str, Tuple[int, int]]:
+    """{collective op name: (count, result bytes)} for an optimized HLO dump.
+
+    Counts each op once (async -start/-done pairs count as one, on the
+    -start line) and sums the result tuple's element bytes.
+    """
+    out: Dict[str, Tuple[int, int]] = {}
+    pat = re.compile(r"\s(" + "|".join(_COLLECTIVES) + r")(-start)?\(")
+    for line in hlo_text.splitlines():
+        m = pat.search(line)
+        if m is None:
+            continue
+        op = m.group(1)
+        rhs = line.split("=", 1)
+        seg = ""
+        if len(rhs) > 1 and op in rhs[1]:
+            seg = rhs[1][:rhs[1].index(op)]
+        total = 0
+        for dt, shape in re.findall(r"(\w+)\[([\d,]*)\]", seg):
+            n = 1
+            for d in shape.split(","):
+                if d:
+                    n *= int(d)
+            total += n * _DTYPE_BYTES.get(dt, 4)
+        cnt, byts = out.get(op, (0, 0))
+        out[op] = (cnt + 1, byts + total)
+    return out
+
+
+def total_collective_bytes(summary: Dict[str, Tuple[int, int]]) -> int:
+    return sum(b for _, b in summary.values())
+
+
+def total_collective_count(summary: Dict[str, Tuple[int, int]]) -> int:
+    return sum(c for c, _ in summary.values())

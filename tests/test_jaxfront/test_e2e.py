@@ -37,10 +37,13 @@ def _mlp_step(params, x, y):
 def _mlp_init():
     key = jax.random.PRNGKey(0)
     k1, k2, kx, ky = jax.random.split(key, 4)
-    params = (jax.random.normal(k1, (16, 32)), jnp.zeros((32,)),
-              jax.random.normal(k2, (32, 8)), jnp.zeros((8,)))
-    x = jax.random.normal(kx, (16, 16))
-    y = jax.random.normal(ky, (16, 8))
+    # sized so data parallelism genuinely wins under the alpha-beta cost
+    # model (activation compute savings > grad all-reduce latency+bytes);
+    # at toy sizes the solver now correctly prefers full replication
+    params = (jax.random.normal(k1, (256, 512)) / 16, jnp.zeros((512,)),
+              jax.random.normal(k2, (512, 256)) / 16, jnp.zeros((256,)))
+    x = jax.random.normal(kx, (2048, 256))
+    y = jax.random.normal(ky, (2048, 256))
     return params, x, y
 
 

@@ -1,0 +1,142 @@
+"""Sharded-path quality gate (VERDICT r1 #3): on the virtual 8-device mesh,
+the auto-parallelized GPT step's emitted collectives must match (dp) or beat
+(dp x tp) a hand-written GSPMD sharding of the same step, and the solver must
+stay fast.  The single-chip bench cannot see any of this — a solver
+regression that inserts extra collectives fails HERE."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from easydist_tpu.jaxfront import easydist_compile, make_device_mesh
+from easydist_tpu.models import GPTConfig, make_gpt_train_step
+from easydist_tpu.utils.hlo import (collective_summary,
+                                    total_collective_bytes,
+                                    total_collective_count)
+
+
+def _gpt_case():
+    cfg = GPTConfig.tiny(seq=64, dim=64, heads=4, layers=2, vocab=256)
+    step, init_state = make_gpt_train_step(cfg)
+    state = init_state(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (16, cfg.seq), 0,
+                                cfg.vocab)
+    return step, state, tokens
+
+
+def _hand_dp(step, state, tokens, mesh):
+    rep = NamedSharding(mesh, P())
+    dp = NamedSharding(mesh, P("dp"))
+    state_sh = jax.tree_util.tree_map(lambda _: rep, state)
+    return jax.jit(step, in_shardings=(state_sh, dp, dp)) \
+        .lower(state, tokens, tokens).compile()
+
+
+@pytest.mark.world_8
+@pytest.mark.long_duration
+def test_dp_collectives_match_hand_gspmd(cpu_devices):
+    step, state, tokens = _gpt_case()
+    mesh = make_device_mesh((8,), ("dp",), devices=cpu_devices)
+    hand = collective_summary(
+        _hand_dp(step, state, tokens, mesh).as_text())
+
+    t0 = time.perf_counter()
+    res = easydist_compile(step, mesh=mesh).get_compiled(
+        state, tokens, tokens)
+    solve_s = time.perf_counter() - t0
+    ours = collective_summary(res.executable().as_text())
+
+    # pure DP is unambiguous: identical collective census, to the byte
+    assert ours == hand, (ours, hand)
+    # solver + emission must stay fast (this config solved in <1s; the
+    # bound leaves 20x headroom before flagging a blowup)
+    assert solve_s < 30, f"auto-parallel compile took {solve_s:.1f}s"
+
+
+@pytest.mark.world_8
+@pytest.mark.long_duration
+def test_dp_tp_collectives_not_worse_than_hand(cpu_devices):
+    """On (4,2) dp x tp the solver may pick a different layout than the
+    hand megatron sharding — but never a more expensive one."""
+    step, state, tokens = _gpt_case()
+    mesh = make_device_mesh((4, 2), ("dp", "tp"), devices=cpu_devices)
+
+    rep = NamedSharding(mesh, P())
+    dp = NamedSharding(mesh, P("dp"))
+
+    def spec(path, leaf):
+        name = "/".join(str(getattr(p, "key", p)) for p in path)
+        if leaf.ndim == 2 and ("qkv" in name or "fc" in name):
+            return NamedSharding(mesh, P(None, "tp"))
+        if leaf.ndim == 2 and "proj" in name:
+            return NamedSharding(mesh, P("tp", None))
+        return rep
+
+    params, opt = state
+    psh = jax.tree_util.tree_map_with_path(spec, params)
+    osh = jax.tree_util.tree_map_with_path(lambda p, l: spec(p[1:], l), opt)
+    hand = collective_summary(
+        jax.jit(step, in_shardings=((psh, osh), dp, dp))
+        .lower(state, tokens, tokens).compile().as_text())
+
+    res = easydist_compile(step, mesh=mesh).get_compiled(
+        state, tokens, tokens)
+    ours = collective_summary(res.executable().as_text())
+
+    assert total_collective_bytes(ours) <= total_collective_bytes(hand), \
+        (ours, hand)
+    assert total_collective_count(ours) <= total_collective_count(hand), \
+        (ours, hand)
+
+
+@pytest.mark.world_8
+@pytest.mark.long_duration
+def test_solver_chooses_sequence_parallelism_for_long_seq(cpu_devices):
+    """VERDICT r1 #6: on a long-seq batch-1 GPT over (8,)("sp") the ILP must
+    choose sequence sharding on its own (batch is indivisible), emitting the
+    gather-KV sequence-parallel plan (bytes-equivalent of a ring; the
+    explicit ring_attention API is the O(T/n)-memory manual variant), and
+    the compiled step must match dense attention."""
+    import numpy as np
+
+    from easydist_tpu.models import gpt_init
+    from easydist_tpu.models.gpt import gpt_apply
+
+    cfg = GPTConfig.tiny(seq=1024, dim=64, heads=4, layers=2, vocab=256)
+    mesh = make_device_mesh((8,), ("sp",), devices=cpu_devices)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, cfg.seq), 0,
+                                cfg.vocab)
+    params = gpt_init(cfg, jax.random.PRNGKey(0))
+
+    def fwd(params, tokens):
+        return gpt_apply(params, cfg, tokens)
+
+    res = easydist_compile(fwd, mesh=mesh, donate_state=False).get_compiled(
+        params, tokens)
+
+    # activations must be sequence-sharded: the embedding-sum output
+    # ([1, seq, dim]) sharded on dim 1, and more seq-sharded interior
+    # tensors than replicated ones among large activations
+    n_seq_sharded = sum(
+        1 for ns in res.strategies[0].values()
+        for p in ns.out_placements
+        if p is not None and p.is_shard() and p.dim in (1, 2))
+    n_repl = sum(
+        1 for ns in res.strategies[0].values()
+        for p in ns.out_placements if p is not None and p.is_replicate())
+    assert n_seq_sharded > n_repl, (n_seq_sharded, n_repl)
+
+    # the plan must NOT fall back to replicated attention: total collective
+    # traffic stays within a few gathered K/V blocks per layer
+    summary = collective_summary(res.executable().as_text())
+    kv_bytes_per_layer = 2 * cfg.seq * cfg.dim * 4
+    assert total_collective_bytes(summary) <= \
+        3 * cfg.layers * kv_bytes_per_layer, summary
+
+    out = res.tree_jitted(params, tokens)
+    ref = jax.jit(fwd)(params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=1e-4)
