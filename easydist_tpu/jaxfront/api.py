@@ -162,7 +162,10 @@ def _compile_cache_key(closed_jaxpr, axis_specs) -> str:
         f"{k}={getattr(edconfig, k)}" for k in
         ("ici_bandwidth", "dcn_bandwidth", "ici_latency", "dcn_latency",
          "hbm_bandwidth", "all_to_all_punish_factor",
-         "solver_cluster_dedup", "per_device_memory_cap"))).encode())
+         "solver_cluster_dedup", "per_device_memory_cap",
+         "coarsen_level", "enable_graph_coarsen", "predict_comm_overlap",
+         "comm_overlap_ratio", "allow_repeated_axis_strategy",
+         "solver_backend", "liveness_only_input"))).encode())
     names = VarNames()
     for v in closed_jaxpr.jaxpr.invars:
         names.name(v)

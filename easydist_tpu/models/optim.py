@@ -18,7 +18,12 @@ def adam_init(params):
             "count": jnp.zeros((), jnp.int32)}
 
 
-def adam_update(params, grads, state, lr=1e-3, b1=0.9, b2=0.999, eps=1e-8):
+def adam_update(params, grads, state, lr=1e-3, b1=0.9, b2=0.999, eps=1e-8,
+                weight_decay=0.0):
+    if weight_decay:
+        # torch.optim.Adam semantics: L2 folded into the gradient
+        grads = jax.tree_util.tree_map(lambda g, p: g + weight_decay * p,
+                                       grads, params)
     count = state["count"] + 1
     mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g,
                                 state["mu"], grads)

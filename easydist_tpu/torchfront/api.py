@@ -31,21 +31,109 @@ def easydist_compile_torch(module, example_args, mesh=None, **kwargs):
     return compiled, params
 
 
+def _translate_torch_optimizer(optimizer, module):
+    """torch.optim instance -> ("adam"/"sgd", hyperparams, state translator)
+    (reference: the user's own torch optimizer captured by fx tracing,
+    torch/compile.py:25-95; here translated into the equivalent jax update).
+    """
+    import torch
+
+    name_of = {id(p): n for n, p in module.named_parameters()}
+    group = optimizer.param_groups[0]
+    if len(optimizer.param_groups) != 1:
+        raise NotImplementedError("multiple param groups not supported")
+
+    kind = type(optimizer).__name__.lower()
+    if kind == "adam":
+        if group.get("amsgrad", False) or group.get("maximize", False):
+            raise NotImplementedError("Adam amsgrad/maximize not supported")
+        hyper = {"lr": group["lr"], "b1": group["betas"][0],
+                 "b2": group["betas"][1], "eps": group["eps"],
+                 "weight_decay": group.get("weight_decay", 0.0)}
+    elif kind == "sgd":
+        if group.get("momentum", 0) or group.get("nesterov", False) \
+                or group.get("weight_decay", 0):
+            raise NotImplementedError(
+                "SGD momentum/nesterov/weight_decay not supported")
+        hyper = {"lr": group["lr"]}
+    else:
+        raise NotImplementedError(
+            f"torch optimizer {type(optimizer).__name__} not supported "
+            f"(Adam and plain SGD are)")
+
+    def translate_state(params0):
+        """Carry over a warm optimizer's exp_avg/exp_avg_sq/step."""
+        if kind != "adam":
+            return None
+        import jax.numpy as jnp
+        import numpy as np
+
+        opt = adam_init({k: v for k, v in params0.items()})
+        step_count = 0
+        for p, st in optimizer.state.items():
+            qual = name_of.get(id(p))
+            if qual is None or "exp_avg" not in st:
+                continue
+            opt["mu"][qual] = jnp.asarray(st["exp_avg"].detach().numpy())
+            opt["nu"][qual] = jnp.asarray(st["exp_avg_sq"].detach().numpy())
+            step_count = int(st["step"])
+        opt["count"] = jnp.asarray(np.int32(step_count))
+        return opt
+
+    return kind, hyper, translate_state
+
+
 def make_torch_train_step(module, example_args, loss_fn: Callable,
-                          optimizer: str = "adam", lr: float = 1e-3,
-                          mesh=None, parallel_mode: str = "auto", **kwargs):
+                          optimizer="adam", lr: float = 1e-3,
+                          mesh=None, parallel_mode: str = "auto",
+                          train: Optional[bool] = None, **kwargs):
     """Build an auto-parallelized train step from a torch module.
 
     loss_fn(outputs, *targets) -> scalar jax loss.
+    optimizer: "adam" / "sgd", or a torch.optim.Adam/SGD INSTANCE built on
+    this module — its hyperparameters and (for a warm Adam) its
+    exp_avg/exp_avg_sq/step state are translated into the jax update.
     parallel_mode: "auto" (solver-chosen SPMD, the default) or the manual
     modes "ddp" / "zero2" / "zero3" (reference torch/api.py parallel_mode
     kwarg, compile_dp.py) — manual modes shard the batch over the mesh's
     first axis explicitly.
-    Returns (compiled_step, init_state):
-      state = (params, opt_state) for adam, params for sgd
+    train: False (default) exports eval-mode semantics regardless of the
+    module's mode flag (torch modules are constructed in training mode, so
+    inferring from module.training would silently change every caller).
+    train=True exports training-mode semantics (dropout active, batch-norm
+    batch stats + running stat updates) and the step takes an rng:
+      compiled_step(state, rng, inputs, *targets) -> (new_state, loss)
+      state = ((trainable, buffers), opt_state)
+    In eval-export mode (train=False):
       compiled_step(state, inputs, *targets) -> (new_state, loss)
+      state = (params, opt_state) for adam, params for sgd
     """
+    train = bool(train)
+
+    torch_opt = None
+    if not isinstance(optimizer, str):
+        torch_opt = optimizer
+        optimizer, hyper, translate_state = _translate_torch_optimizer(
+            torch_opt, module)
+        lr = hyper.pop("lr")
+    else:
+        hyper, translate_state = {}, None
+
+    if train:
+        if parallel_mode != "auto":
+            raise NotImplementedError(
+                "training-mode export supports parallel_mode='auto'")
+        return _make_train_mode_step(module, example_args, loss_fn,
+                                     optimizer, lr, hyper, translate_state,
+                                     mesh, **kwargs)
+
     fwd, params0 = torch_module_to_jax(module, example_args)
+    # buffers (batch-norm running stats etc.) are not weights: keep them out
+    # of autodiff and the optimizer update — eval-mode BN differentiates
+    # through its running stats, and "training" them corrupts inference
+    buffer_names = fwd.buffer_names
+    trainable0 = {k: v for k, v in params0.items() if k not in buffer_names}
+    buffers0 = {k: v for k, v in params0.items() if k in buffer_names}
 
     if parallel_mode != "auto":
         from easydist_tpu.jaxfront.mesh import get_device_mesh
@@ -82,27 +170,87 @@ def make_torch_train_step(module, example_args, loss_fn: Callable,
 
     if optimizer == "adam":
         def init_state():
-            return (params0, adam_init(params0))
+            opt = translate_state(trainable0) if translate_state else None
+            return (params0,
+                    opt if opt is not None else adam_init(trainable0))
 
         def step(state, inputs, *targets):
             params, opt = state
+            trainable = {k: v for k, v in params.items()
+                         if k not in buffer_names}
+            buffers = {k: v for k, v in params.items() if k in buffer_names}
 
-            def objective(p):
-                return loss_fn(fwd(p, inputs), *targets)
+            def objective(tp):
+                return loss_fn(fwd({**tp, **buffers}, inputs), *targets)
 
-            loss, grads = jax.value_and_grad(objective)(params)
-            new_params, new_opt = adam_update(params, grads, opt, lr=lr)
-            return (new_params, new_opt), loss
+            loss, grads = jax.value_and_grad(objective)(trainable)
+            new_tp, new_opt = adam_update(trainable, grads, opt, lr=lr,
+                                          **hyper)
+            return ({**new_tp, **buffers}, new_opt), loss
     elif optimizer == "sgd":
         def init_state():
             return params0
 
         def step(params, inputs, *targets):
-            def objective(p):
-                return loss_fn(fwd(p, inputs), *targets)
+            trainable = {k: v for k, v in params.items()
+                         if k not in buffer_names}
+            buffers = {k: v for k, v in params.items() if k in buffer_names}
 
-            loss, grads = jax.value_and_grad(objective)(params)
-            return sgd_update(params, grads, lr=lr), loss
+            def objective(tp):
+                return loss_fn(fwd({**tp, **buffers}, inputs), *targets)
+
+            loss, grads = jax.value_and_grad(objective)(trainable)
+            return {**sgd_update(trainable, grads, lr=lr), **buffers}, loss
+    else:
+        raise ValueError(f"unknown optimizer {optimizer!r}")
+
+    return easydist_compile(step, mesh=mesh, **kwargs), init_state
+
+
+def _make_train_mode_step(module, example_args, loss_fn, optimizer, lr,
+                          hyper, translate_state, mesh, **kwargs):
+    """Training-mode export: dropout rng threading + batch-norm running
+    stats in the state.  state = ((trainable, buffers), opt_state);
+    step(state, rng, inputs, *targets) -> (state, loss)."""
+    fwd, params0 = torch_module_to_jax(module, example_args, train=True)
+    buffer_names = fwd.buffer_names
+    trainable0 = {k: v for k, v in params0.items()
+                  if k not in buffer_names}
+    buffers0 = {k: v for k, v in params0.items() if k in buffer_names}
+
+    if optimizer == "adam":
+        def init_state():
+            opt = translate_state(trainable0) if translate_state else None
+            return ((trainable0, buffers0),
+                    opt if opt is not None else adam_init(trainable0))
+
+        def step(state, rng, inputs, *targets):
+            (trainable, buffers), opt = state
+
+            def objective(tp):
+                out, new_buf = fwd({**tp, **buffers}, rng, inputs)
+                return loss_fn(out, *targets), new_buf
+
+            (loss, new_buf), grads = jax.value_and_grad(
+                objective, has_aux=True)(trainable)
+            new_tp, new_opt = adam_update(trainable, grads, opt, lr=lr,
+                                          **hyper)
+            return ((new_tp, {**buffers, **new_buf}), new_opt), loss
+    elif optimizer == "sgd":
+        def init_state():
+            return ((trainable0, buffers0), None)
+
+        def step(state, rng, inputs, *targets):
+            (trainable, buffers), _ = state
+
+            def objective(tp):
+                out, new_buf = fwd({**tp, **buffers}, rng, inputs)
+                return loss_fn(out, *targets), new_buf
+
+            (loss, new_buf), grads = jax.value_and_grad(
+                objective, has_aux=True)(trainable)
+            new_tp = sgd_update(trainable, grads, lr=lr)
+            return ((new_tp, {**buffers, **new_buf}), None), loss
     else:
         raise ValueError(f"unknown optimizer {optimizer!r}")
 

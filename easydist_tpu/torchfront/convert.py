@@ -33,6 +33,29 @@ class UnsupportedAtenOp(NotImplementedError):
     pass
 
 
+# Per-conversion PRNG context for training-mode stochastic ops (dropout).
+# Set by run_graph for the duration of one forward; each stochastic op
+# folds a fresh per-site counter into the step key, so masks are
+# deterministic per (rng, op position) and differ across ops.
+_RNG_STATE: List = [None, 0]
+
+
+def _set_rng(key):
+    _RNG_STATE[0] = key
+    _RNG_STATE[1] = 0
+
+
+def _next_rng():
+    if _RNG_STATE[0] is None:
+        raise UnsupportedAtenOp(
+            "training-mode dropout needs an rng: convert with "
+            "torch_module_to_jax(..., train=True) and call fn(params, rng, "
+            "*inputs)")
+    key = jax.random.fold_in(_RNG_STATE[0], _RNG_STATE[1])
+    _RNG_STATE[1] += 1
+    return key
+
+
 # ------------------------------------------------------------ conversions
 
 @register_aten("aten.linear.default")
@@ -176,7 +199,68 @@ def _embedding(weight, indices, padding_idx=-1, scale_grad=False, sparse=False):
 
 @register_aten("aten.dropout.default")
 def _dropout(x, p, train):
-    return x  # inference semantics; training dropout needs an rng plumb-in
+    if not train or p == 0.0:
+        return x
+    keep = jax.random.bernoulli(_next_rng(), 1.0 - p, x.shape)
+    return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+
+
+@register_aten("aten.native_dropout.default")
+def _native_dropout(x, p, train):
+    if not train or p == 0.0:
+        return x, jnp.ones(x.shape, jnp.bool_)
+    keep = jax.random.bernoulli(_next_rng(), 1.0 - p, x.shape)
+    out = jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    return out, keep
+
+
+@register_aten("aten._native_batch_norm_legit_functional.default")
+def _batch_norm_functional(x, w, b, running_mean, running_var, training,
+                           momentum, eps):
+    """Training batch norm with running-stat threading (torch semantics:
+    normalize with biased batch var, update running stats with unbiased
+    var, running = (1-momentum)*running + momentum*batch)."""
+    axes = (0,) + tuple(range(2, x.ndim))
+    n = 1
+    for a in axes:
+        n *= x.shape[a]
+    mean = x.mean(axis=axes)
+    var = x.var(axis=axes)
+    shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    invstd = jax.lax.rsqrt(var + eps)
+    out = (x - mean.reshape(shape)) * invstd.reshape(shape)
+    if w is not None:
+        out = out * w.reshape(shape)
+    if b is not None:
+        out = out + b.reshape(shape)
+    unbiased = var * (n / max(n - 1, 1))
+    new_rm = (1 - momentum) * running_mean + momentum * mean
+    new_rv = (1 - momentum) * running_var + momentum * unbiased
+    return out, mean, invstd, new_rm, new_rv
+
+
+@register_aten("aten._native_batch_norm_legit_no_training.default")
+def _batch_norm_eval(x, w, b, running_mean, running_var, momentum, eps):
+    shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    invstd = jax.lax.rsqrt(running_var + eps)
+    out = (x - running_mean.reshape(shape)) * invstd.reshape(shape)
+    if w is not None:
+        out = out * w.reshape(shape)
+    if b is not None:
+        out = out + b.reshape(shape)
+    return out, jnp.zeros_like(running_mean), jnp.zeros_like(running_var)
+
+
+@register_aten("aten.batch_norm.default")
+def _batch_norm(x, w, b, running_mean, running_var, training, momentum,
+                eps, cudnn_enabled=False):
+    if training:
+        out, _, _, _, _ = _batch_norm_functional(
+            x, w, b, running_mean, running_var, True, momentum, eps)
+        return out  # running-stat mutation needs the functionalized export
+    out, _, _ = _batch_norm_eval(x, w, b, running_mean, running_var,
+                                 momentum, eps)
+    return out
 
 
 @register_aten("aten.conv2d.default", "aten.convolution.default")
@@ -459,18 +543,35 @@ def _to_jax_value(val):
     return val
 
 
-def torch_module_to_jax(module, example_args):
+def torch_module_to_jax(module, example_args, train: bool = False):
     """Export a torch nn.Module and convert to (jax_fn, params).
 
     Returns (fn, params) where params is a {qualified_name: jax array} dict
-    of parameters AND buffers, and fn(params, *inputs) reproduces the torch
-    forward in jax (single tensor or tuple output, matching torch).
+    of parameters AND buffers.
+
+    train=False: fn(params, *inputs) reproduces the eval-mode torch forward
+    (single tensor or tuple output, matching torch).
+
+    train=True: the module is exported in training mode and functionalized
+    (reference torch/compile.py:25-95 traces the training graph through
+    fx; here torch.export.run_decompositions surfaces buffer mutations as
+    outputs).  fn(params, rng, *inputs) -> (outputs, new_buffers) where
+    `rng` drives dropout masks and `new_buffers` is a {qualified_name:
+    value} dict of mutated buffers (batch-norm running stats) to merge back
+    into params for the next step.
     """
     import torch
 
-    ep = torch.export.export(module.eval(), tuple(example_args))
+    if train:
+        ep = torch.export.export(module.train(),
+                                 tuple(example_args)).run_decompositions({})
+    else:
+        ep = torch.export.export(module.eval(), tuple(example_args))
     gm = ep.graph_module
     sig = ep.graph_signature
+    mutated = {}  # output arg name -> buffer qualname
+    if train:
+        mutated = dict(getattr(sig, "buffers_to_mutate", {}) or {})
     state = {**ep.state_dict, **getattr(ep, "constants", {})}
 
     placeholder_specs: List = []  # ("state", qualname) | ("input", pos)
@@ -494,9 +595,11 @@ def torch_module_to_jax(module, example_args):
 
     node_list = list(gm.graph.nodes)
 
-    def fn(params, *inputs):
+    def run_graph(params, inputs, rng=None):
         env: Dict[Any, Any] = {}
         ph_iter = iter(placeholder_specs)
+        if rng is not None:
+            _set_rng(rng)
 
         def lookup(arg):
             if isinstance(arg, (list, tuple)):
@@ -528,11 +631,39 @@ def torch_module_to_jax(module, example_args):
             elif node.op == "get_attr":
                 env[node] = _to_jax_value(getattr(gm, node.target))
             elif node.op == "output":
-                out = lookup(node.args[0])
-                return out[0] if isinstance(out, (list, tuple)) \
+                _set_rng(None)
+                raw = node.args[0]
+                if mutated:
+                    # leading outputs are functionalized buffer mutations
+                    new_buffers = {}
+                    user_out = []
+                    for arg in raw:
+                        name = getattr(arg, "name", None)
+                        if name in mutated:
+                            new_buffers[mutated[name]] = lookup(arg)
+                        else:
+                            user_out.append(lookup(arg))
+                    out = user_out[0] if len(user_out) == 1 \
+                        else tuple(user_out)
+                    return out, new_buffers
+                out = lookup(raw)
+                out = out[0] if isinstance(out, (list, tuple)) \
                     and len(out) == 1 else out
+                return (out, {}) if train else out
         raise RuntimeError("graph had no output node")
 
+    if train:
+        def fn(params, rng, *inputs):
+            return run_graph(params, inputs, rng=rng)
+    else:
+        def fn(params, *inputs):
+            return run_graph(params, inputs)
+
+    # which param-dict entries are buffers (running stats etc.) — training
+    # steps must exclude them from autodiff and thread their updates
+    fn.buffer_names = frozenset(
+        (sig.inputs_to_buffers or {}).values()) | frozenset(
+        (getattr(sig, "inputs_to_lifted_tensor_constants", {}) or {}).values())
     return fn, params
 
 

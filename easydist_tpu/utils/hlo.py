@@ -36,8 +36,11 @@ def collective_summary(hlo_text: str) -> Dict[str, Tuple[int, int]]:
         seg = ""
         if len(rhs) > 1 and op in rhs[1]:
             seg = rhs[1][:rhs[1].index(op)]
+        shapes = re.findall(r"(\w+)\[([\d,]*)\]", seg)
+        if m.group(2):  # async -start: tuple aliases (operand, result);
+            shapes = shapes[-1:]  # count the result once, like the sync form
         total = 0
-        for dt, shape in re.findall(r"(\w+)\[([\d,]*)\]", seg):
+        for dt, shape in shapes:
             n = 1
             for d in shape.split(","):
                 if d:

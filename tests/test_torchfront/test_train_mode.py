@@ -1,0 +1,160 @@
+"""Training-mode torch fidelity (VERDICT r1 #7): dropout rng threading,
+batch-norm running stats, and torch.optim translation, verified against
+torch-CPU training (reference torch/compile.py:25-95)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from easydist_tpu.jaxfront import make_device_mesh  # noqa: E402
+from easydist_tpu.torchfront import (make_torch_train_step,  # noqa: E402
+                                     torch_module_to_jax)
+
+
+class BNNet(nn.Module):
+    def __init__(self, p_drop=0.0):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.bn = nn.BatchNorm1d(32)
+        self.drop = nn.Dropout(p_drop)
+        self.fc2 = nn.Linear(32, 8)
+
+    def forward(self, x):
+        return self.fc2(self.drop(torch.relu(self.bn(self.fc1(x)))))
+
+
+def _mse(pred, target):
+    return jnp.mean((pred - target) ** 2)
+
+
+@pytest.mark.world_8
+def test_bn_training_matches_torch_over_5_steps(cpu_devices):
+    """BN batch stats + running-stat updates must track torch exactly
+    (dropout p=0 so the two frameworks see identical computations)."""
+    mesh = make_device_mesh((8,), ("d",))
+    torch.manual_seed(0)
+    module = BNNet(p_drop=0.0).train()
+    x = torch.randn(32, 16)
+    y = torch.randn(32, 8)
+
+    step, init_state = make_torch_train_step(
+        module, (x,), _mse, optimizer="sgd", lr=0.1, mesh=mesh,
+        train=True, donate_state=False)
+    state = init_state()
+    jx, jy = jnp.asarray(x.numpy()), jnp.asarray(y.numpy())
+    rng = jax.random.PRNGKey(0)
+    losses = []
+    for i in range(5):
+        state, loss = step(state, jax.random.fold_in(rng, i), jx, jy)
+        losses.append(float(loss))
+
+    # torch reference
+    ref = BNNet(p_drop=0.0).train()
+    ref.load_state_dict(module.state_dict())
+    opt = torch.optim.SGD(ref.parameters(), lr=0.1)
+    ref_losses = []
+    for _ in range(5):
+        opt.zero_grad()
+        out = ref(x)
+        loss = ((out - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        ref_losses.append(float(loss))
+
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4, atol=1e-6)
+    (trainable, buffers), _ = state
+    ref_sd = {k: v.detach().numpy() for k, v in ref.state_dict().items()}
+    for k, v in {**trainable, **buffers}.items():
+        np.testing.assert_allclose(np.asarray(v, dtype=np.float64),
+                                   ref_sd[k].astype(np.float64),
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
+
+
+def test_dropout_training_semantics():
+    """Training dropout: masks differ per rng, zeros appear at ~p rate, and
+    kept values are scaled by 1/(1-p)."""
+    module = nn.Sequential(nn.Dropout(0.5)).train()
+    x = torch.ones(1000, 4)
+    fn, params = torch_module_to_jax(module, (x,), train=True)
+    jx = jnp.asarray(x.numpy())
+    out1, _ = fn(params, jax.random.PRNGKey(0), jx)
+    out2, _ = fn(params, jax.random.PRNGKey(1), jx)
+    assert not np.allclose(np.asarray(out1), np.asarray(out2))
+    vals = np.asarray(out1).ravel()
+    zero_rate = (vals == 0).mean()
+    assert 0.4 < zero_rate < 0.6, zero_rate
+    assert np.allclose(vals[vals != 0], 2.0)
+
+
+@pytest.mark.world_8
+def test_torch_adam_instance_translation(cpu_devices):
+    """A warm torch.optim.Adam is translated (hyperparams + exp_avg state)
+    and continues matching torch for further steps."""
+    mesh = make_device_mesh((8,), ("d",))
+    torch.manual_seed(1)
+    module = nn.Sequential(nn.Linear(16, 8)).eval()
+    x = torch.randn(32, 16)
+    y = torch.randn(32, 8)
+    opt = torch.optim.Adam(module.parameters(), lr=3e-3, betas=(0.8, 0.95),
+                           eps=1e-7, weight_decay=0.01)
+
+    # warm torch for 3 steps
+    for _ in range(3):
+        opt.zero_grad()
+        ((module(x) - y) ** 2).mean().backward()
+        opt.step()
+
+    step, init_state = make_torch_train_step(
+        module, (x,), _mse, optimizer=opt, mesh=mesh, donate_state=False)
+    state = init_state()
+    jx, jy = jnp.asarray(x.numpy()), jnp.asarray(y.numpy())
+    for _ in range(3):
+        state, loss = step(state, jx, jy)
+
+    for _ in range(3):
+        opt.zero_grad()
+        ((module(x) - y) ** 2).mean().backward()
+        opt.step()
+
+    params, _ = state
+    ref_sd = {k: v.detach().numpy() for k, v in module.state_dict().items()}
+    for k, v in params.items():
+        np.testing.assert_allclose(np.asarray(v), ref_sd[k],
+                                   rtol=2e-4, atol=1e-5, err_msg=k)
+
+
+def test_unsupported_torch_optimizer_raises():
+    module = nn.Linear(4, 4)
+    opt = torch.optim.RMSprop(module.parameters())
+    with pytest.raises(NotImplementedError, match="RMSprop"):
+        make_torch_train_step(module.eval(), (torch.randn(2, 4),), _mse,
+                              optimizer=opt,
+                              mesh=make_device_mesh((8,), ("d",)))
+
+
+@pytest.mark.world_8
+def test_eval_mode_step_does_not_touch_bn_buffers(cpu_devices):
+    """In eval-export training (train=False), BN running stats feed the
+    forward; they must stay frozen, not be 'optimized'."""
+    mesh = make_device_mesh((8,), ("d",))
+    module = BNNet(p_drop=0.0).eval()
+    x = torch.randn(32, 16)
+    y = torch.randn(32, 8)
+    step, init_state = make_torch_train_step(
+        module, (x,), _mse, optimizer="adam", lr=0.1, mesh=mesh,
+        donate_state=False)
+    state = init_state()
+    jx, jy = jnp.asarray(x.numpy()), jnp.asarray(y.numpy())
+    before = {k: np.asarray(v) for k, v in state[0].items()
+              if "running" in k or "num_batches" in k}
+    assert before, "BNNet should have running-stat buffers"
+    for _ in range(3):
+        state, _ = step(state, jx, jy)
+    for k, v0 in before.items():
+        np.testing.assert_array_equal(np.asarray(state[0][k]), v0,
+                                      err_msg=k)
