@@ -234,6 +234,22 @@ class SpmdSolver:
             logger.info("[SpmdSolver] tied %d clusters into %d groups",
                         len(self.clusters), n_rep)
 
+    def assignment_comm_cost(self, chosen: Dict[str, NodeStrategy]) -> float:
+        """Pure edge-communication cost of a node-strategy assignment
+        (no y costs): 0.0 means sync-free."""
+        pick: Dict[int, int] = {}
+        for c in self.clusters:
+            for s in range(c.strategy_count()):
+                if all(repr(c.strategies[s][uid][1])
+                       == repr(chosen.get(c.nodes[uid].name))
+                       for uid in c.strategies[s]):
+                    pick[c.cid] = s
+                    break
+            else:
+                return float("inf")
+        return sum(e.comm[pick[e.up_cluster.cid], pick[e.down_cluster.cid]]
+                   for e in self.edges)
+
     # ----------------------------------------------------------------- solve
 
     def solve(self) -> Dict[str, NodeStrategy]:

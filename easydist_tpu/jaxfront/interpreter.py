@@ -189,8 +189,18 @@ class ShardingAnalyzer:
                     and hasattr(v.aval, "shape"))
         total += sum(int(np.prod(v.aval.shape)) for v in eqn.outvars
                      if hasattr(v.aval, "shape"))
+        # jax.checkpoint bodies: recursively analyze the inner jaxpr and
+        # compose a rule analytically — execution discovery would run the
+        # whole body eagerly per candidate (reference r1 gap: remat regions
+        # fell back to replicate)
+        if prim_name in ("remat2", "remat", "checkpoint"):
+            rule = self._discover_composite(eqn)
+            if rule is not None:
+                return rule
+
         if total > edconfig.discovery_hint_numel:
-            rule = self._discover_shrunk(eqn, bind_fn, bind_params, prim_name)
+            rule = self._discover_shrunk(eqn, bind_fn, bind_params,
+                                         prim_name)
             if rule is not None:
                 logger.info("discovery hint-shrink applied to %s (%d elems)",
                             prim_name, total)
@@ -210,11 +220,159 @@ class ShardingAnalyzer:
             self.prompts[prim_name] = space
         return {"space": space, "recombines": recombines}
 
-    def _discover_shrunk(self, eqn, bind_fn, bind_params, prim_name):
+    def _discover_composite(self, eqn):
+        """Analytic rule for a call-like eqn (jax.checkpoint body): analyze
+        the inner jaxpr recursively, then propagate each candidate input
+        sharding through the inner nodes' strategy pools.  A seed survives
+        only if a SYNC-FREE assignment exists (every consumer takes the
+        sharded operand as-is; partial sums may only surface at composite
+        outputs).  Surviving seeds become the composite's shard groups.
+        """
+        import functools
+
+        from easydist_tpu.metashard.combination import Recombine, Reduction
+        from easydist_tpu.metashard.metair import Placement
+        from .bridge import jaxpr_to_metagraph
+
+        inner = eqn.params.get("jaxpr")
+        if inner is None:
+            return None
+        if not hasattr(inner, "jaxpr"):  # raw Jaxpr -> ClosedJaxpr
+            if inner.constvars:
+                return None
+            inner = jex_core.ClosedJaxpr(inner, ())
+        from .inline import inline_calls
+
+        inner = inline_calls(inner)  # remat bodies keep nested pjit calls
+
+        sub = ShardingAnalyzer(inner, world_size=self.world_size)
+        sub.prompts = self.prompts  # share caches with the outer analysis
+        sub.rules = self.rules
+        rules, shape_info = sub.run()
+
+        in_rows = [v for v in eqn.invars
+                   if not isinstance(v, jex_core.Literal)]
+        inner_invars = inner.jaxpr.invars
+        if len(in_rows) != len(inner_invars):
+            return None
+        in_names = [sub.names.name(v) for v in inner_invars]
+        out_names = [None if isinstance(v, jex_core.Literal)
+                     else sub.names.name(v) for v in inner.jaxpr.outvars]
+
+        from easydist_tpu.autoflow import MeshAxisSpec, SpmdSolver
+
+        axis = MeshAxisSpec("_composite", self.world_size)
+
+        def propagate(seed_name, seed_dim):
+            """Sync-free assignment containing the seed, found by an exact
+            solve of the inner graph with the seed placeholder pinned and a
+            pure-communication objective.  -> ({invar: dim}, {out:
+            Placement}) or None when the optimum still needs a collective.
+            """
+            target = Placement.shard(seed_dim)
+            g = jaxpr_to_metagraph(inner, rules, shape_info,
+                                   world_size=self.world_size,
+                                   names=sub.names)
+            _inject_partial_propagation(g, self.world_size)
+
+            def excl(node):
+                if node.name != seed_name:
+                    return []
+                return [s for s in node.strategy_pool(self.world_size)
+                        if repr(s.out_placements[0]) != repr(target)]
+
+            g.coarsen(self.world_size, level=0, exclude_map=excl)
+            # exact untied solve: cluster tying trades a sliver of
+            # optimality for speed, but sync-free detection needs the true
+            # zero-comm optimum (the graph is one block, small)
+            saved_dedup = edconfig.solver_cluster_dedup
+            edconfig.solver_cluster_dedup = False
+            try:
+                solver = SpmdSolver(g, axis)
+                # composite boundaries are free: partial/sharded outputs are
+                # legal (they become the composite's recombines), and there
+                # is no compute-redundancy choice to price inside one group
+                solver.output_y_cost.clear()
+                chosen = solver.solve()
+            except Exception:
+                return None
+            finally:
+                edconfig.solver_cluster_dedup = saved_dedup
+            if repr(chosen.get(seed_name).out_placements[0]) != repr(target):
+                return None  # divisibility removed the pin
+            if solver.assignment_comm_cost(chosen) > 0.0:
+                return None
+
+            ins = {}
+            for name in in_names:
+                s = chosen.get(name)
+                p = s.out_placements[0] if s is not None else None
+                if p is not None and p.is_shard():
+                    ins[name] = p.dim
+            outs = {}
+            for node in g.ops:
+                s = chosen.get(node.name)
+                if s is None:
+                    continue
+                for v, p in zip(node.outvars, s.out_placements):
+                    if v is not None and p is not None \
+                            and not p.is_replicate():
+                        outs[v.name] = p
+            return (ins, {n: p for n, p in outs.items() if n in
+                          set(filter(None, out_names))})
+
+        groups = []
+        seen = set()
+        for row, (v, name) in enumerate(zip(inner_invars, in_names)):
+            shape = tuple(v.aval.shape)
+            for d, size in enumerate(shape):
+                if size % self.world_size != 0 or size < self.world_size:
+                    continue
+                res = propagate(name, d)
+                if res is None:
+                    continue
+                ins, outs = res
+                key = (tuple(sorted(ins.items())),
+                       tuple(sorted((k, repr(p)) for k, p in outs.items())))
+                if key in seen:
+                    continue
+                seen.add(key)
+                groups.append((ins, outs))
+
+        if not groups:
+            return None
+
+        from easydist_tpu.metashard.annotation import DimSharding, ShardSpace
+
+        table = [[DimSharding() for _ in v.aval.shape] for v in inner_invars]
+        recombines = {}
+        for g, (ins, outs) in enumerate(groups, start=1):
+            for row, name in enumerate(in_names):
+                if name in ins:
+                    table[row][ins[name]] = DimSharding(group=g)
+            fns = []
+            for name in out_names:
+                p = outs.get(name) if name is not None else None
+                if p is None:
+                    fns.append(functools.partial(Recombine.identity))
+                elif p.is_shard():
+                    fns.append(functools.partial(Recombine.concat, dim=p.dim))
+                else:
+                    fns.append(functools.partial(Recombine.reduce,
+                                                 op=Reduction.SUM))
+            recombines[g] = fns
+        logger.info("composite rule for %s: %d shard groups",
+                    eqn.primitive.name, len(groups))
+        return {"space": ShardSpace(table), "recombines": recombines}
+
+    def _discover_shrunk(self, eqn, bind_fn, bind_params, prim_name,
+                         cap=None):
         """Discovery on a size-reduced instance of the eqn, or None if the
         primitive rejects the shrunk shapes (shape-dependent params)."""
         import types
 
+        if cap is None:
+            cap = edconfig.discovery_hint_numel
         unit = max(self.world_size * edconfig.discovery_nshards, 8)
         sizes = sorted({d for v in list(eqn.invars) + list(eqn.outvars)
                         if hasattr(getattr(v, "aval", None), "shape")
@@ -235,7 +393,7 @@ class ShardingAnalyzer:
         # halve the largest mapped sizes (to a multiple of `unit`) until the
         # inputs fit the hint budget
         for _ in range(64):
-            if shrunk_total(size_map) <= edconfig.discovery_hint_numel:
+            if shrunk_total(size_map) <= cap:
                 break
             grew = False
             for d in sizes:
@@ -277,3 +435,39 @@ class ShardingAnalyzer:
         if prim_name not in self.prompts and space.max_group() > 0:
             self.prompts[prim_name] = space
         return {"space": space, "recombines": recombines}
+
+
+# ops through which a partial-sum placement propagates linearly: f(sum_i x_i)
+# == sum_i f(x_i) when every other operand is replicated.  Used only inside
+# composite (jax.checkpoint body) solves, where a partial may travel to the
+# composite boundary and become a reduce recombine — e.g. a bias gradient's
+# reduce_sum inside a differentiated remat body.
+_PARTIAL_LINEAR_1IN = {"reshape", "transpose", "convert_element_type",
+                       "squeeze", "expand_dims", "broadcast_in_dim", "neg",
+                       "rev", "slice", "reduce_sum", "copy"}
+_PARTIAL_LINEAR_2IN = {"mul", "div", "dot_general"}
+
+
+def _inject_partial_propagation(graph, world_size: int) -> None:
+    from easydist_tpu.metashard.metair import NodeStrategy, Placement
+
+    par = Placement.partial()
+    rep = Placement.replicate()
+    for node in graph.ops:
+        base = node.strategy_pool(world_size)  # builds _pool_cache
+        if not base or node._pool_cache is None:
+            continue
+        template = base[0]
+        n_in = len(template.in_placements)
+        n_out = len(template.out_placements)
+        extras = []
+        if node.op_key in _PARTIAL_LINEAR_1IN and n_in >= 1:
+            # partial rides the first (data) operand; any trailing operands
+            # must be replicated
+            extras.append(NodeStrategy([par] + [rep] * (n_in - 1),
+                                       [par] * n_out))
+        elif node.op_key in _PARTIAL_LINEAR_2IN and n_in == 2:
+            extras.append(NodeStrategy([par, rep], [par] * n_out))
+            if node.op_key != "div":  # div is linear in the numerator only
+                extras.append(NodeStrategy([rep, par], [par] * n_out))
+        node._pool_cache = node._pool_cache + extras

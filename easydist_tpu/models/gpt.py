@@ -31,6 +31,11 @@ class GPTConfig:
     attention: str = "einsum"
     attn_mesh: object = None
     attn_axis: str = "sp"
+    # per-block rematerialization: "none", "full" (jax.checkpoint each
+    # block), or "dots" (save matmul outputs only) — trades recompute for
+    # O(layers) instead of O(layers x activations) live memory in the bwd.
+    # edconfig.remat_policy ("none"|"dots"|"all") overrides when set.
+    remat: str = "none"
 
     @staticmethod
     def small(**kw):
@@ -117,15 +122,35 @@ def gpt_apply(params, cfg: GPTConfig, tokens):
     """tokens: int32 [batch, seq] -> logits [batch, seq, vocab]."""
     dtype = jnp.dtype(cfg.dtype)
     x = params["wte"][tokens].astype(dtype) + params["wpe"].astype(dtype)[None, :tokens.shape[1]]
-    for blk in params["blocks"]:
+    def block_fn(blk, x):
         x = x + _attention(
             _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"]).astype(dtype),
             blk["attn"], cfg, dtype)
         h = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"]).astype(dtype)
         h = jax.nn.gelu(h @ blk["mlp"]["fc"]["w"].astype(dtype)
                         + blk["mlp"]["fc"]["b"].astype(dtype))
-        x = x + (h @ blk["mlp"]["proj"]["w"].astype(dtype)
-                 + blk["mlp"]["proj"]["b"].astype(dtype))
+        return x + (h @ blk["mlp"]["proj"]["w"].astype(dtype)
+                    + blk["mlp"]["proj"]["b"].astype(dtype))
+
+    from easydist_tpu import config as edconfig
+
+    policy_map = {"none": cfg.remat, "dots": "dots", "all": "full",
+                  "full": "full"}
+    if edconfig.remat_policy not in policy_map:
+        raise ValueError(f"unknown remat_policy "
+                         f"{edconfig.remat_policy!r}; expected "
+                         f"none|dots|all|full")
+    remat = policy_map[edconfig.remat_policy]
+    if remat not in ("none", "full", "dots"):
+        raise ValueError(f"unknown GPTConfig.remat {cfg.remat!r}; "
+                         f"expected none|full|dots")
+    if remat == "full":
+        block_fn = jax.checkpoint(block_fn)
+    elif remat == "dots":
+        block_fn = jax.checkpoint(
+            block_fn, policy=jax.checkpoint_policies.checkpoint_dots)
+    for blk in params["blocks"]:
+        x = block_fn(blk, x)
     x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
     return x.astype(jnp.float32) @ params["wte"].T
 

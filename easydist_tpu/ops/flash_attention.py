@@ -1,14 +1,19 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as Pallas TPU kernels (forward AND fused backward).
 
 Blockwise online-softmax attention: Q blocks stream over the grid, K/V live
 in VMEM per (batch*head) program, statistics (running max / denominator)
 stay in f32 scratch.  O(seq) memory instead of materializing the [T, T]
 score matrix; MXU-shaped matmul blocks.
 
-The backward pass recomputes attention in plain jax (correct, O(T^2) bytes
-in the bwd only); a fused flash backward kernel is future work.  The ring
-variant composes this kernel with the ppermute loop in
-parallel/ring_attention.py.
+The backward is the FlashAttention-2 recipe: the forward saves the per-row
+logsumexp, `delta = rowsum(dO * O)` is precomputed, then two kernels stream
+blocks — dQ over Q-blocks (K/V resident), dK/dV over K-blocks (Q/dO
+resident) — recomputing P = exp(S - lse) per block.  No [T, T] residual
+survives the forward.  The ring variant composes this kernel with the
+ppermute loop in parallel/ring_attention.py.
+
+Reference scenario: the reference relies on torch SDPA/cutlass kernels
+(benchmark/torch/model/gpt.py attention); this is the TPU-native analog.
 """
 
 from __future__ import annotations
@@ -24,8 +29,8 @@ from jax.experimental import pallas as pl
 _NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_k: int,
-                  causal: bool, scale: float, q_block: int):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                  seq_k: int, causal: bool, scale: float, q_block: int):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
     bq, d = q.shape
@@ -61,20 +66,24 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_k: int,
     else:
         n_kb_eff = n_kb
     o_acc, m_acc, l_acc = jax.lax.fori_loop(0, n_kb_eff, body, (o0, m0, l0))
-    o_ref[0] = (o_acc / jnp.maximum(l_acc, 1e-30)[:, None]).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l_acc, 1e-30)
+    o_ref[0] = (o_acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = (m_acc + jnp.log(l_safe)).astype(jnp.float32)
+
+
+def _pick_block(block: int, t: int) -> int:
+    b = min(block, t)
+    while t % b:
+        b //= 2
+    return max(b, 1)
 
 
 def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
                    block_k: int, interpret: bool):
     b, h, t_q, d = q.shape
     t_k = k.shape[2]
-    bq = min(block_q, t_q)
-    bk = min(block_k, t_k)
-    while t_q % bq:
-        bq //= 2
-    while t_k % bk:
-        bk //= 2
-    bq, bk = max(bq, 1), max(bk, 1)
+    bq = _pick_block(block_q, t_q)
+    bk = _pick_block(block_k, t_k)
 
     qf = q.reshape(b * h, t_q, d)
     kf = k.reshape(b * h, t_k, d)
@@ -82,7 +91,7 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
 
     kernel = functools.partial(_flash_kernel, block_k=bk, seq_k=t_k,
                                causal=causal, scale=scale, q_block=bq)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, t_q // bq),
         in_specs=[
@@ -90,11 +99,159 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
             pl.BlockSpec((1, t_k, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, t_k, d), lambda bh, qi: (bh, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq), lambda bh, qi: (bh, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t_q, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, t_q), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, t_q, d), lse
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, seq_k: int, causal: bool,
+                         scale: float, q_block: int):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0].astype(jnp.float32)
+    delta = delta_ref[0].astype(jnp.float32)
+    bq, d = q.shape
+    q_pos = qi * q_block + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(kb, dq_acc):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k_blk.T
+        if causal:
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # masked entries: exp(-inf) = 0
+        dp = do @ v_blk.T
+        ds = p * (dp - delta[:, None])
+        return dq_acc + ds @ k_blk
+
+    n_kb = seq_k // block_k
+    if causal:
+        n_kb_eff = jnp.minimum(n_kb, (qi + 1) * q_block // block_k
+                               + (1 if q_block % block_k else 0))
+    else:
+        n_kb_eff = n_kb
+    dq0 = jnp.zeros((bq, d), jnp.float32)
+    dq_acc = jax.lax.fori_loop(0, n_kb_eff, body, dq0)
+    dq_ref[0] = (dq_acc * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, seq_q: int,
+                          causal: bool, scale: float, k_block: int):
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    bk, d = k.shape
+    k_pos = ki * k_block + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+
+    def body(qb, carry):
+        dk_acc, dv_acc = carry
+        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, pl.ds(qb * block_q, block_q)].astype(jnp.float32)
+        delta_blk = delta_ref[0, pl.ds(qb * block_q, block_q)] \
+            .astype(jnp.float32)
+        s = (q_blk * scale) @ k.T  # [block_q, bk]
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_blk[:, None])
+        dv_acc = dv_acc + p.T @ do_blk
+        dp = do_blk @ v.T
+        ds = p * (dp - delta_blk[:, None])
+        dk_acc = dk_acc + (ds.T @ q_blk) * scale
+        return dk_acc, dv_acc
+
+    n_qb = seq_q // block_q
+    if causal:
+        # q blocks strictly above this k block's first row are fully masked
+        start = (ki * k_block) // block_q
+    else:
+        start = 0
+    zeros = jnp.zeros((bk, d), jnp.float32)
+    dk_acc, dv_acc = jax.lax.fori_loop(start, n_qb, body, (zeros, zeros))
+    dk_ref[0] = dk_acc.astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, g, causal: bool, scale: float,
+                    block_q: int, block_k: int, interpret: bool,
+                    g_lse=None):
+    b, h, t_q, d = q.shape
+    t_k = k.shape[2]
+    bq = _pick_block(block_q, t_q)
+    bk = _pick_block(block_k, t_k)
+
+    qf = q.reshape(b * h, t_q, d)
+    kf = k.reshape(b * h, t_k, d)
+    vf = v.reshape(b * h, t_k, d)
+    dof = g.reshape(b * h, t_q, d)
+    of = o.reshape(b * h, t_q, d)
+    # delta_i = sum_d dO_i O_i — O(T) rowwise, plain XLA; an lse cotangent
+    # enters with opposite sign (dL/ds += g_lse * p)
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+    if g_lse is not None:
+        delta = delta - g_lse.reshape(b * h, t_q).astype(jnp.float32)
+
+    dq_kernel = functools.partial(_flash_bwd_dq_kernel, block_k=bk,
+                                  seq_k=t_k, causal=causal, scale=scale,
+                                  q_block=bq)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b * h, t_q // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, t_k, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, t_k, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq), lambda bh, qi: (bh, qi)),
+            pl.BlockSpec((1, bq), lambda bh, qi: (bh, qi)),
+        ],
         out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, t_q, d), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf)
-    return out.reshape(b, h, t_q, d)
+    )(qf, kf, vf, dof, lse, delta)
+
+    dkv_kernel = functools.partial(_flash_bwd_dkv_kernel, block_q=bq,
+                                   seq_q=t_q, causal=causal, scale=scale,
+                                   k_block=bk)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b * h, t_k // bk),
+        in_specs=[
+            pl.BlockSpec((1, t_q, d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, t_q, d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, t_q), lambda bh, ki: (bh, 0)),
+            pl.BlockSpec((1, t_q), lambda bh, ki: (bh, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t_k, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, t_k, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    return (dq.reshape(b, h, t_q, d), dk.reshape(b, h, t_k, d),
+            dv.reshape(b, h, t_k, d))
 
 
 def _reference_attention(q, k, v, causal: bool, scale: float):
@@ -110,36 +267,53 @@ def _reference_attention(q, k, v, causal: bool, scale: float):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention_lse(q, k, v, causal: bool = True,
+                        scale: Optional[float] = None, block_q: int = 256,
+                        block_k: int = 256,
+                        interpret: Optional[bool] = None):
+    """Like :func:`flash_attention` but also returns the per-row logsumexp
+    [batch*heads, seq] (f32) — differentiable in BOTH outputs, which ring
+    attention needs (the online merge weights blocks by their lse)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                          interpret)
+
+
+def _fwd_lse(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, lse = flash_attention_lse(q, k, v, causal, scale, block_q, block_k,
+                                   interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _bwd_lse(causal, scale, block_q, block_k, interpret, res, cts):
+    q, k, v, o, lse = res
+    g, g_lse = cts
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # dL/ds_ij = p_ij * (dp_ij - delta_i) + g_lse_i * p_ij: the lse
+    # cotangent folds into delta (delta' = delta - g_lse), so the same
+    # kernels serve both outputs
+    return _flash_backward(q, k, v, o, lse, g, causal, scale, block_q,
+                           block_k, interpret,
+                           g_lse=None if g_lse is None else g_lse)
+
+
+flash_attention_lse.defvjp(_fwd_lse, _bwd_lse)
+
+
 def flash_attention(q, k, v, causal: bool = True,
                     scale: Optional[float] = None, block_q: int = 256,
                     block_k: int = 256, interpret: Optional[bool] = None):
     """q, k, v: [batch, heads, seq, head_dim].  Returns same shape.
 
     `interpret=None` auto-selects the Pallas interpreter off-TPU so tests
-    run on CPU; on TPU the kernel compiles natively.
+    run on CPU; on TPU the kernels compile natively.
     """
-    if scale is None:
-        scale = 1.0 / math.sqrt(q.shape[-1])
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
-
-
-def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
-
-
-def _bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    if scale is None:
-        scale = 1.0 / math.sqrt(q.shape[-1])
-
-    def ref(q, k, v):
-        return _reference_attention(q, k, v, causal, scale)
-
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
-
-
-flash_attention.defvjp(_fwd, _bwd)
+    out, _ = flash_attention_lse(q, k, v, causal, scale, block_q, block_k,
+                                 interpret)
+    return out

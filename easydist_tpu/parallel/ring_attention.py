@@ -37,26 +37,58 @@ def _block_attn(q, k, v, mask, scale):
     return o, m_safe, l
 
 
-def _ring_attention_local(q, k, v, axis: str, causal: bool, scale: float):
+def _ring_attention_local(q, k, v, axis: str, causal: bool, scale: float,
+                          block_impl: str = "einsum"):
     n = jax.lax.psum(1, axis)
     idx = jax.lax.axis_index(axis)
     t_local = q.shape[2]
 
     q_pos = idx * t_local + jnp.arange(t_local)
 
+    def flash_block(q_, k_blk, v_blk, src):
+        """Pallas flash kernel as the per-block compute: its normalized
+        output + logsumexp form a valid (o, m, l=1) triple for the online
+        merge (o_norm = o_raw/l and lse = m + log l).  flash_attention_lse
+        is a custom_vjp in both outputs, so the ring stays differentiable."""
+        from easydist_tpu.ops.flash_attention import flash_attention_lse
+
+        b, h, t, _ = q_.shape
+
+        def run(block_causal):
+            out, lse = flash_attention_lse(q_, k_blk, v_blk, block_causal,
+                                           scale)
+            return out.astype(jnp.float32), lse.reshape(b, h, t)
+
+        if causal:
+            out_b, lse_b = jax.lax.cond(
+                src == idx, lambda _: run(True), lambda _: run(False), None)
+            visible = src <= idx  # src > idx: block fully in the future
+            m_b = jnp.where(visible, lse_b, -1e30 / 2)
+            l_b = jnp.where(visible, 1.0, 0.0) * jnp.ones_like(lse_b)
+            o_b = jnp.where(visible, out_b, 0.0)
+        else:
+            o_b, m_b = run(False)
+            l_b = jnp.ones_like(m_b)
+        return o_b, m_b, l_b
+
     def step(carry, r):
         o_acc, m_acc, l_acc, k_blk, v_blk = carry
         # block r came from device (idx - r) mod n
         src = jnp.mod(idx - r, n)
-        k_pos = src * t_local + jnp.arange(t_local)
-        if causal:
-            mask = k_pos[None, None, None, :] <= q_pos[None, None, :, None]
+        if block_impl == "flash":
+            o_b, m_b, l_b = flash_block(q, k_blk, v_blk, src)
         else:
-            mask = jnp.ones((1, 1, t_local, t_local), bool)
-        # rotate k/v in their input dtype (half the ICI bytes for bf16);
-        # accumulate in f32 per block
-        o_b, m_b, l_b = _block_attn(q, k_blk.astype(jnp.float32),
-                                    v_blk.astype(jnp.float32), mask, scale)
+            k_pos = src * t_local + jnp.arange(t_local)
+            if causal:
+                mask = k_pos[None, None, None, :] <= q_pos[None, None, :,
+                                                           None]
+            else:
+                mask = jnp.ones((1, 1, t_local, t_local), bool)
+            # rotate k/v in their input dtype (half the ICI bytes for
+            # bf16); accumulate in f32 per block
+            o_b, m_b, l_b = _block_attn(q, k_blk.astype(jnp.float32),
+                                        v_blk.astype(jnp.float32), mask,
+                                        scale)
 
         m_new = jnp.maximum(m_acc, m_b)
         alpha = jnp.exp(m_acc - m_new)
@@ -80,16 +112,22 @@ def _ring_attention_local(q, k, v, axis: str, causal: bool, scale: float):
 
 
 def ring_attention(q, k, v, mesh, axis: str = "sp", causal: bool = True,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None,
+                   block_impl: Optional[str] = None):
     """Exact attention with q/k/v sequence-sharded over mesh axis `axis`.
 
     q, k, v: [batch, heads, seq, head_dim] global arrays (seq divisible by
     the axis size).  Returns [batch, heads, seq, head_dim] sharded the same.
+
+    block_impl: per-device block compute — "flash" (Pallas kernel, O(t/n)
+    block memory) or "einsum".  None auto-selects flash on TPU.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
+    if block_impl is None:
+        block_impl = "flash" if jax.default_backend() == "tpu" else "einsum"
     fn = functools.partial(_ring_attention_local, axis=axis, causal=causal,
-                           scale=scale)
+                           scale=scale, block_impl=block_impl)
     spec = P(None, None, axis, None)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec, check_vma=False)(q, k, v)

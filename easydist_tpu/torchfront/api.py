@@ -133,7 +133,6 @@ def make_torch_train_step(module, example_args, loss_fn: Callable,
     # through its running stats, and "training" them corrupts inference
     buffer_names = fwd.buffer_names
     trainable0 = {k: v for k, v in params0.items() if k not in buffer_names}
-    buffers0 = {k: v for k, v in params0.items() if k in buffer_names}
 
     if parallel_mode != "auto":
         from easydist_tpu.jaxfront.mesh import get_device_mesh

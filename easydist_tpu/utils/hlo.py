@@ -37,8 +37,11 @@ def collective_summary(hlo_text: str) -> Dict[str, Tuple[int, int]]:
         if len(rhs) > 1 and op in rhs[1]:
             seg = rhs[1][:rhs[1].index(op)]
         shapes = re.findall(r"(\w+)\[([\d,]*)\]", seg)
-        if m.group(2):  # async -start: tuple aliases (operand, result);
-            shapes = shapes[-1:]  # count the result once, like the sync form
+        if m.group(2) and len(shapes) >= 2:
+            # async -start result tuples alias (operands..., results...) —
+            # a combined collective carries several tensors; count the
+            # result half once, like the sync form
+            shapes = shapes[len(shapes) // 2:]
         total = 0
         for dt, shape in shapes:
             n = 1

@@ -1,0 +1,73 @@
+"""Remat-aware analysis (VERDICT r1 #9): jax.checkpoint regions get real
+sharding rules instead of replicate-fallback, and a remat'd model compiles
+to the same plan as its un-remat'd twin."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from easydist_tpu.jaxfront import easydist_compile, make_device_mesh
+from easydist_tpu.models import GPTConfig, make_gpt_train_step
+from easydist_tpu.utils.hlo import collective_summary
+
+
+@pytest.mark.world_8
+def test_undifferentiated_checkpoint_composite_rule(cpu_devices):
+    """A forward checkpoint region gets an analytic composite rule with
+    batch AND tensor-parallel groups (no eager body execution)."""
+    from easydist_tpu.jaxfront.api import ShardingAnalyzer
+    from easydist_tpu.jaxfront.inline import inline_calls
+
+    def block(p, x):
+        h = jnp.tanh(x @ p["w1"] + p["b1"])
+        return h @ p["w2"]
+
+    p = {"w1": jnp.ones((64, 128)), "b1": jnp.zeros((128,)),
+         "w2": jnp.ones((128, 64))}
+    x = jnp.ones((32, 64))
+    closed = inline_calls(jax.make_jaxpr(
+        lambda p, x: jax.checkpoint(block)(p, x) * 2.0)(p, x))
+    analyzer = ShardingAnalyzer(closed, world_size=8)
+    eqn = next(e for e in closed.jaxpr.eqns if "remat" in e.primitive.name)
+    t0 = time.perf_counter()
+    rule = analyzer._discover_composite(eqn)
+    assert time.perf_counter() - t0 < 5.0
+    assert rule is not None and rule["space"].max_group() >= 2
+
+
+@pytest.mark.world_8
+@pytest.mark.long_duration
+@pytest.mark.parametrize("remat", ["full", "dots"])
+def test_remat_gpt_plan_matches_unremat_twin(cpu_devices, remat):
+    """The remat'd GPT train step must get the SAME emitted collectives as
+    the un-remat'd model (reference r1 gap: checkpoint bodies degenerated
+    to replicate), at bounded compile time."""
+    mesh = make_device_mesh((8,), ("dp",), devices=cpu_devices)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (16, 64), 0, 256)
+
+    def build(remat_mode):
+        cfg = GPTConfig.tiny(seq=64, dim=64, heads=4, layers=2, vocab=256,
+                             remat=remat_mode)
+        step, init = make_gpt_train_step(cfg)
+        state = init(jax.random.PRNGKey(0))
+        return step, state
+
+    step0, state0 = build("none")
+    base = easydist_compile(step0, mesh=mesh).get_compiled(
+        state0, tok, tok)
+    base_coll = collective_summary(base.executable().as_text())
+
+    step1, state1 = build(remat)
+    ref_state, ref_loss = jax.jit(step1)(state1, tok, tok)
+    t0 = time.perf_counter()
+    res = easydist_compile(step1, mesh=mesh).get_compiled(state1, tok, tok)
+    compile_s = time.perf_counter() - t0
+    coll = collective_summary(res.executable().as_text())
+
+    assert coll == base_coll, (coll, base_coll)
+    assert compile_s < 60, compile_s
+    (_, loss) = res.tree_jitted(build(remat)[1], tok, tok)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)

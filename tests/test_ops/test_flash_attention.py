@@ -52,3 +52,53 @@ def test_flash_gradients():
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_fused_backward_matches_reference(causal):
+    """The Pallas backward kernels (dq + dkdv, lse/delta recompute) must
+    reproduce einsum-attention gradients, including uneven tail blocks."""
+    q, k, v = make_qkv(jax.random.PRNGKey(5), t=48, d=16)
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, causal, None, 16, 16, True)
+        return jnp.sum(out * jnp.cos(out))
+
+    def loss_ref(q, k, v):
+        out = _reference_attention(q, k, v, causal, 1.0 / math.sqrt(16))
+        return jnp.sum(out * jnp.cos(out))
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_flash_backward_has_no_quadratic_residual():
+    """O(T) training memory: no [T, T] tensor may appear anywhere in the
+    differentiated program (VERDICT r1 #10 — the old backward rebuilt the
+    full score matrix in plain jax)."""
+    t = 64
+    q, k, v = make_qkv(jax.random.PRNGKey(6), t=t, d=16)
+
+    def loss(q, k, v):
+        return jnp.mean(flash_attention(q, k, v, True, None, 16, 16, True)
+                        ** 2)
+
+    def scan_jaxpr(jaxpr, found):
+        for eqn in jaxpr.eqns:
+            for v_ in eqn.outvars:
+                shape = tuple(getattr(v_.aval, "shape", ()))
+                if len(shape) >= 2 and shape[-1] == t and shape[-2] == t:
+                    found.append((eqn.primitive.name, shape))
+            for sub in eqn.params.values():
+                if hasattr(sub, "jaxpr"):
+                    scan_jaxpr(sub.jaxpr, found)
+                elif hasattr(sub, "eqns"):
+                    scan_jaxpr(sub, found)
+        return found
+
+    closed = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    found = scan_jaxpr(closed.jaxpr, [])
+    assert not found, f"quadratic intermediates: {found}"
