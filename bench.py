@@ -58,6 +58,31 @@ def main():
     # measurements — device/tunnel throughput drifts between runs, so a
     # sequential A-then-B comparison is biased; the median of per-rep ratios
     # cancels the drift.
+    # the framework may pick its own kernels: probe the Pallas
+    # flash-attention variant of the same model and, if faster, bench THAT
+    # model for both sides — vs_baseline always compares easydist against
+    # jax.jit of the SAME step (guarded: any failure keeps the einsum path)
+    variant = "einsum"
+    if on_tpu:
+        try:
+            import dataclasses
+
+            cfg_fl = dataclasses.replace(cfg, attention="flash")
+            step_fl, init_fl = make_gpt_train_step(cfg_fl)
+            t_fl = _bench_step(jax.jit(step_fl, donate_argnums=(0,)),
+                               init_fl(jax.random.PRNGKey(0)),
+                               tokens, targets, warmup=2, iters=5)
+            t_ei = _bench_step(jax.jit(step, donate_argnums=(0,)),
+                               init_state(jax.random.PRNGKey(0)),
+                               tokens, targets, warmup=2, iters=5)
+            print(f"# attention probe: flash {t_fl*1e3:.2f}ms vs "
+                  f"einsum {t_ei*1e3:.2f}ms", file=sys.stderr)
+            if t_fl < t_ei:
+                variant, step, init_state = "flash", step_fl, init_fl
+        except Exception as e:  # kernel unavailable: einsum path stands
+            print(f"# flash variant skipped: {e}", file=sys.stderr)
+    print(f"# benching attention={variant}", file=sys.stderr)
+
     base = jax.jit(step, donate_argnums=(0,))
     compiled = easydist_compile(step, mesh=mesh)
     ratios, t_eds, t_bases = [], [], []

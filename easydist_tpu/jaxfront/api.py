@@ -67,6 +67,15 @@ def infer_state_io(args, out_shape) -> Dict[int, int]:
         if (not o_leaves or o_td != a_td
                 or jax.tree_util.treedef_is_leaf(a_td)
                 or [leaf_sig(l) for l in o_leaves] != [leaf_sig(l) for l in a_leaves]):
+            # warn only when the unpaired output looks like STATE (a
+            # container) — a scalar loss ending the pairing is the normal
+            # (new_state, loss) shape, not a donation problem
+            if pairs and o_leaves and not jax.tree_util.treedef_is_leaf(o_td):
+                logger.info(
+                    "state_io pairing stopped at output %d (structure "
+                    "mismatch): later state will NOT be donated — pass "
+                    "state_io explicitly to avoid the extra buffers",
+                    out_base)
             break
         for k in range(len(o_leaves)):
             pairs[out_base + k] = in_base + k

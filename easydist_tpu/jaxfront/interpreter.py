@@ -303,28 +303,62 @@ class ShardingAnalyzer:
             if solver.assignment_comm_cost(chosen) > 0.0:
                 return None
 
-            ins = {}
-            for name in in_names:
-                s = chosen.get(name)
-                p = s.out_placements[0] if s is not None else None
-                if p is not None and p.is_shard():
-                    ins[name] = p.dim
-            outs = {}
-            for node in g.ops:
+            # the zero-comm optimum may ALSO shard chains unrelated to the
+            # seed (the memory tie-break likes sharding): keep only what is
+            # CONNECTED to the seed through non-replicated placements, so
+            # independent chains stay available for their own groups
+            var_p: Dict[str, object] = {}
+            for node in list(g.ops) + list(g.inputs):
                 s = chosen.get(node.name)
                 if s is None:
                     continue
                 for v, p in zip(node.outvars, s.out_placements):
                     if v is not None and p is not None \
                             and not p.is_replicate():
-                        outs[v.name] = p
-            return (ins, {n: p for n, p in outs.items() if n in
-                          set(filter(None, out_names))})
+                        var_p[v.name] = p
+            adj: Dict[str, set] = {}
+            for node in g.ops:
+                touched = [v.name for v in list(node.invars)
+                           + list(node.outvars)
+                           if v is not None and v.name in var_p]
+                for a in touched:
+                    adj.setdefault(a, set()).update(touched)
+            reach = {seed_name}
+            frontier = [seed_name]
+            while frontier:
+                cur = frontier.pop()
+                for nxt in adj.get(cur, ()):
+                    if nxt not in reach:
+                        reach.add(nxt)
+                        frontier.append(nxt)
+
+            ins = {}
+            for name in in_names:
+                p = var_p.get(name)
+                if name in reach and p is not None and p.is_shard():
+                    ins[name] = p.dim
+            outs = {n: var_p[n] for n in filter(None, out_names)
+                    if n in reach and n in var_p}
+            return (ins, outs)
+
+        numel_of = {}
+        for v, name in zip(inner_invars, in_names):
+            numel_of[name] = int(np.prod(v.aval.shape))
+        out_numel = {}
+        for v in inner.jaxpr.outvars:
+            if not isinstance(v, jex_core.Literal):
+                out_numel[sub.names.name(v)] = int(np.prod(v.aval.shape))
 
         groups = []
         seen = set()
         for row, (v, name) in enumerate(zip(inner_invars, in_names)):
             shape = tuple(v.aval.shape)
+            # don't SEED from bias-sized inputs (their "groups" shard odd
+            # broadcast chains); they may still join groups seeded from
+            # substantive tensors.  64 elems/device keeps small-but-real
+            # data inputs seedable.
+            if numel_of[name] < self.world_size * 64:
+                continue
             for d, size in enumerate(shape):
                 if size % self.world_size != 0 or size < self.world_size:
                     continue
@@ -332,6 +366,13 @@ class ShardingAnalyzer:
                 if res is None:
                     continue
                 ins, outs = res
+                # drop degenerate groups (a lone sharded bias): the value
+                # of a group scales with everything it shards, so judge by
+                # the TOTAL sharded footprint, not the seed's size
+                sharded_numel = sum(numel_of.get(n, 0) for n in ins) + \
+                    sum(out_numel.get(n, 0) for n in outs)
+                if sharded_numel < max(4096, self.world_size ** 2):
+                    continue
                 key = (tuple(sorted(ins.items())),
                        tuple(sorted((k, repr(p)) for k, p in outs.items())))
                 if key in seen:
@@ -346,10 +387,20 @@ class ShardingAnalyzer:
 
         table = [[DimSharding() for _ in v.aval.shape] for v in inner_invars]
         recombines = {}
+        kept = []
+        for ins, outs in groups:
+            g = len(kept) + 1
+            cells = [(row, ins[name]) for row, name in enumerate(in_names)
+                     if name in ins]
+            if any(table[r][d].group != 0 for r, d in cells):
+                continue  # a dim can carry one group id; first group wins
+            for r, d in cells:
+                table[r][d] = DimSharding(group=g)
+            kept.append((ins, outs))
+        groups = kept
+        if not groups:
+            return None
         for g, (ins, outs) in enumerate(groups, start=1):
-            for row, name in enumerate(in_names):
-                if name in ins:
-                    table[row][ins[name]] = DimSharding(group=g)
             fns = []
             for name in out_names:
                 p = outs.get(name) if name is not None else None
@@ -365,14 +416,12 @@ class ShardingAnalyzer:
                     eqn.primitive.name, len(groups))
         return {"space": ShardSpace(table), "recombines": recombines}
 
-    def _discover_shrunk(self, eqn, bind_fn, bind_params, prim_name,
-                         cap=None):
+    def _discover_shrunk(self, eqn, bind_fn, bind_params, prim_name):
         """Discovery on a size-reduced instance of the eqn, or None if the
         primitive rejects the shrunk shapes (shape-dependent params)."""
         import types
 
-        if cap is None:
-            cap = edconfig.discovery_hint_numel
+        cap = edconfig.discovery_hint_numel
         unit = max(self.world_size * edconfig.discovery_nshards, 8)
         sizes = sorted({d for v in list(eqn.invars) + list(eqn.outvars)
                         if hasattr(getattr(v, "aval", None), "shape")

@@ -33,8 +33,7 @@ class GPTConfig:
     attn_axis: str = "sp"
     # per-block rematerialization: "none", "full" (jax.checkpoint each
     # block), or "dots" (save matmul outputs only) — trades recompute for
-    # O(layers) instead of O(layers x activations) live memory in the bwd.
-    # edconfig.remat_policy ("none"|"dots"|"all") overrides when set.
+    # O(layers) instead of O(layers x activations) live memory in the bwd
     remat: str = "none"
 
     @staticmethod
@@ -132,15 +131,10 @@ def gpt_apply(params, cfg: GPTConfig, tokens):
         return x + (h @ blk["mlp"]["proj"]["w"].astype(dtype)
                     + blk["mlp"]["proj"]["b"].astype(dtype))
 
-    from easydist_tpu import config as edconfig
-
-    policy_map = {"none": cfg.remat, "dots": "dots", "all": "full",
-                  "full": "full"}
-    if edconfig.remat_policy not in policy_map:
-        raise ValueError(f"unknown remat_policy "
-                         f"{edconfig.remat_policy!r}; expected "
-                         f"none|dots|all|full")
-    remat = policy_map[edconfig.remat_policy]
+    # per-block remat is driven ONLY by cfg.remat; the EASYDIST_REMAT_POLICY
+    # env knob applies to compiled-function emission (jaxfront/api.py), a
+    # separate mechanism — stacking both from one knob would double-remat
+    remat = cfg.remat
     if remat not in ("none", "full", "dots"):
         raise ValueError(f"unknown GPTConfig.remat {cfg.remat!r}; "
                          f"expected none|full|dots")

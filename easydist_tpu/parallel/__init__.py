@@ -11,4 +11,4 @@ from .pipeline import (spmd_pipeline, spmd_pipeline_grad,  # noqa: F401
 from .dp import ddp_step, zero_shard_params, zero2_step, zero3_step  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401
 from .ulysses import ulysses_attention  # noqa: F401
-from .auto_pipeline import pipeline_forward  # noqa: F401
+from .auto_pipeline import pipeline_forward, split_point  # noqa: F401

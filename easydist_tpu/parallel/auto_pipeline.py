@@ -36,6 +36,35 @@ from easydist_tpu.jaxfront.inline import inline_calls
 _HEAVY = {"dot_general", "conv_general_dilated"}
 
 
+# ---------------------------------------------------------- split markers
+# User-annotated split points (reference annotate_split_points,
+# pp/compile_pipeline.py:60-78): `split_point(x)` is an identity that
+# survives tracing as its own equation; _StagePlan cuts stages there.
+
+split_point_p = jex_core.Primitive("ed_split_point")
+split_point_p.def_impl(lambda x: x)
+split_point_p.def_abstract_eval(lambda x: x)
+
+
+def _register_split_rules():
+    from jax.interpreters import ad, batching, mlir
+
+    mlir.register_lowering(
+        split_point_p, mlir.lower_fun(lambda x: x, multiple_results=False))
+    ad.deflinear2(split_point_p, lambda ct, x: [ct])
+    batching.primitive_batchers[split_point_p] = \
+        lambda args, dims: (split_point_p.bind(args[0]), dims[0])
+
+
+_register_split_rules()
+
+
+def split_point(x):
+    """Mark a pipeline split after this value: everything producing `x`
+    belongs to the earlier stage.  N markers -> N+1 stages."""
+    return split_point_p.bind(x)
+
+
 def _eqn_flops(eqn) -> float:
     if eqn.primitive.name not in _HEAVY:
         return 1.0
@@ -72,7 +101,17 @@ class _StagePlan:
         jaxpr = closed_jaxpr.jaxpr
         self.closed = closed_jaxpr
         eqns = jaxpr.eqns
-        ends = _balanced_splits([_eqn_flops(e) for e in eqns], n_stages)
+        marker_idx = [i for i, e in enumerate(eqns)
+                      if e.primitive is split_point_p]
+        if marker_idx:
+            if len(marker_idx) != n_stages - 1:
+                raise ValueError(
+                    f"{len(marker_idx)} split_point markers imply "
+                    f"{len(marker_idx) + 1} stages, but n_stages="
+                    f"{n_stages}")
+            ends = [i + 1 for i in marker_idx] + [len(eqns)]
+        else:
+            ends = _balanced_splits([_eqn_flops(e) for e in eqns], n_stages)
         starts = [0] + ends[:-1]
         self.stage_eqns = [eqns[s:e] for s, e in zip(starts, ends)]
         self.n_stages = n_stages
@@ -126,6 +165,32 @@ class _StagePlan:
             math.prod(getattr(v, "aval", v).shape) if hasattr(v, "aval")
             else 1 for v in self.out_vars), 1)
 
+    def plan_params(self, param_vars):
+        """Assign each param leaf to the single stage using it (packed into
+        that stage's sharded buffer) or to the replicated shared set (used
+        by several stages / non-float).  Returns (stage_layouts,
+        shared_idx) over param positions."""
+        use_stages: Dict = {v: set() for v in param_vars}
+        for s, st_eqns in enumerate(self.stage_eqns):
+            for e in st_eqns:
+                for v in e.invars:
+                    if not isinstance(v, jex_core.Literal) \
+                            and v in use_stages:
+                        use_stages[v].add(s)
+        stage_layouts: List[List[int]] = [[] for _ in self.stage_eqns]
+        shared_idx: List[int] = []
+        for i, v in enumerate(param_vars):
+            stages = use_stages[v]
+            # the packed buffer rides in f32: only <=32-bit floats survive
+            # the round-trip losslessly; f64 (and ints) stay replicated
+            packable = v.aval.dtype in (jnp.float32, jnp.bfloat16,
+                                        jnp.float16)
+            if len(stages) == 1 and packable:
+                stage_layouts[next(iter(stages))].append(i)
+            else:
+                shared_idx.append(i)
+        return stage_layouts, shared_idx
+
     def pack(self, values: List, total: int):
         parts = [jnp.ravel(v).astype(jnp.float32) for v in values]
         flat = jnp.concatenate(parts) if parts else jnp.zeros((0,), jnp.float32)
@@ -141,11 +206,20 @@ class _StagePlan:
 
 
 def pipeline_forward(fn: Callable, example_params, example_mb, mesh,
-                     n_stages: int, n_microbatches: int, axis: str = "pp"):
+                     n_stages: int, n_microbatches: int, axis: str = "pp",
+                     shard_params: bool = False):
     """Auto-split `fn(params, mb)` into a pipelined callable.
 
-    Returns pipe(params, microbatches[M, ...mb shape]) -> stacked outputs
-    [M, ...out shape] (replicated over pp).
+    Stages split at user `split_point` markers when present, else at
+    FLOP-balanced cuts.  Returns pipe(params, microbatches[M, ...mb shape])
+    -> stacked outputs [M, ...out shape] (replicated over pp).
+
+    shard_params=True additionally returns pack_params: params whose leaves
+    are used by exactly one stage live ONLY on that stage's device (packed
+    [n_stages, max_bytes] buffer sharded over `pp` — per-device param
+    memory ~1/n_stages); leaves used across stages stay replicated.  Call
+    as pipe(pack_params(params), microbatches); the reference equivalent is
+    the per-stage submod params of compile_pipeline.py:762-1087.
     """
     closed = inline_calls(jax.make_jaxpr(fn)(example_params, example_mb))
     plan = _StagePlan(closed, n_stages)
@@ -156,11 +230,25 @@ def pipeline_forward(fn: Callable, example_params, example_mb, mesh,
     data_vars = jaxpr.invars[n_param_leaves:]
     S, M = n_stages, n_microbatches
 
+    stage_layouts = shared_pos = stage_param_elems = None
+    if shard_params:
+        stage_layouts, shared_pos = plan.plan_params(param_vars)
+        stage_param_elems = max(
+            [sum(math.prod(param_vars[i].aval.shape) for i in lay)
+             for lay in stage_layouts] + [1])
+
     def make_branch(s: int):
         def branch(buf_in, param_vals, data_vals):
             env = {}
-            for var, val in zip(param_vars, param_vals):
-                env[var] = val
+            if shard_params:
+                local_buf, shared_vals = param_vals
+                env.update(plan.unpack(
+                    local_buf, [param_vars[i] for i in stage_layouts[s]]))
+                for pos, val in zip(shared_pos, shared_vals):
+                    env[param_vars[pos]] = val
+            else:
+                for var, val in zip(param_vars, param_vals):
+                    env[var] = val
             for var, val in zip(data_vars, data_vals):
                 env[var] = val
             for var, val in zip(jaxpr.constvars, closed.consts):
@@ -196,7 +284,13 @@ def pipeline_forward(fn: Callable, example_params, example_mb, mesh,
     branches = [make_branch(s) for s in range(S)]
 
     def pipelined(params, microbatches):
-        param_leaves = jax.tree_util.tree_leaves(params)
+        if shard_params:
+            packed, shared_vals = params  # from pack_params
+            param_arg = (packed, tuple(shared_vals))
+            param_spec = (P(axis, None), tuple(P() for _ in shared_vals))
+        else:
+            param_arg = tuple(jax.tree_util.tree_leaves(params))
+            param_spec = P()
         mb_leaves = jax.tree_util.tree_leaves(microbatches)
         if len(mb_leaves) != len(data_vars):
             raise ValueError(
@@ -205,9 +299,12 @@ def pipeline_forward(fn: Callable, example_params, example_mb, mesh,
 
         @lambda f: shard_map(
             f, mesh=mesh,
-            in_specs=(P(), tuple(P() for _ in mb_leaves)),
+            in_specs=(param_spec, tuple(P() for _ in mb_leaves)),
             out_specs=P(), check_vma=False)
         def run(param_vals, x_mb_leaves):
+            if shard_params:
+                packed_local, shared_vals_l = param_vals
+                param_vals = (packed_local[0], shared_vals_l)
             stage_id = jax.lax.axis_index(axis)
             T = M + S - 1
 
@@ -216,8 +313,10 @@ def pipeline_forward(fn: Callable, example_params, example_mb, mesh,
                 # stage s consumes microbatch t - s
                 mb_idx = jnp.clip(t - stage_id, 0, M - 1)
                 data_vals = [x[mb_idx] for x in x_mb_leaves]
+                branch_params = (param_vals if shard_params
+                                 else list(param_vals))
                 buf_out, out_pack = jax.lax.switch(
-                    stage_id, branches, buf, list(param_vals), data_vals)
+                    stage_id, branches, buf, branch_params, data_vals)
                 out_idx = jnp.clip(t - (S - 1), 0, M - 1)
                 emit = jnp.logical_and(stage_id == S - 1, t >= S - 1)
                 outputs = outputs.at[out_idx].set(
@@ -234,7 +333,7 @@ def pipeline_forward(fn: Callable, example_params, example_mb, mesh,
                 axis)
             return outputs
 
-        packed = run(tuple(param_leaves), tuple(mb_leaves))  # [M, out_elems]
+        packed = run(param_arg, tuple(mb_leaves))  # [M, out_elems]
         # unpack each microbatch row back to the fn's output structure
         results = []
         off = 0
@@ -246,4 +345,19 @@ def pipeline_forward(fn: Callable, example_params, example_mb, mesh,
             off += n
         return results[0] if len(results) == 1 else tuple(results)
 
-    return pipelined
+    if not shard_params:
+        return pipelined
+
+    def pack_params(params):
+        """params pytree -> (packed [n_stages, max_elems], shared leaves).
+        Place the packed array with NamedSharding(mesh, P(axis, None)) (or
+        let the pipelined jit's constraint do it) so each device holds only
+        its stage's parameters."""
+        leaves = jax.tree_util.tree_leaves(params)
+        if len(leaves) != n_param_leaves:
+            raise ValueError("params pytree does not match the example")
+        rows = [plan.pack([leaves[i] for i in lay], stage_param_elems)
+                for lay in stage_layouts]
+        return jnp.stack(rows), tuple(leaves[i] for i in shared_pos)
+
+    return pipelined, pack_params

@@ -25,6 +25,9 @@ class MoEConfig:
     d_model: int
     d_ff: int
     capacity_factor: float = 1.25
+    # experts per token: 1 = Switch routing, 2 = GShard-style top-2 (gates
+    # renormalized over the selected experts)
+    top_k: int = 1
 
 
 def moe_init(cfg: MoEConfig, key) -> Dict:
@@ -38,27 +41,48 @@ def moe_init(cfg: MoEConfig, key) -> Dict:
     }
 
 
+def _routing(probs, n_experts: int, capacity: int, top_k: int, dtype):
+    """Top-k routing with per-expert capacity shared across slots.
+
+    Returns (dispatch [n, E, C] summed over slots, per-slot combine
+    weights as a list of ([n, E, C] dispatch_s, gate_s [n]) pairs,
+    onehot_all [n, E] for the aux loss).
+    """
+    topk_probs, topk_idx = jax.lax.top_k(probs, top_k)  # [n, k]
+    if top_k == 1:
+        gates = topk_probs  # Switch: gate by the raw router probability
+    else:
+        gates = topk_probs / jnp.maximum(
+            jnp.sum(topk_probs, axis=-1, keepdims=True), 1e-9)
+
+    counts = jnp.zeros((probs.shape[1],), probs.dtype)  # filled per expert
+    slot_dispatch = []
+    onehot_all = jnp.zeros_like(probs)
+    for s in range(top_k):
+        onehot = jax.nn.one_hot(topk_idx[:, s], n_experts, dtype=dtype)
+        pos = counts[None, :] + jnp.cumsum(onehot, axis=0) - 1.0
+        pos_tok = jnp.sum(pos * onehot, axis=-1)
+        keep = pos_tok < capacity
+        pos_oh = jax.nn.one_hot(pos_tok.astype(jnp.int32), capacity,
+                                dtype=dtype)
+        disp = onehot[:, :, None] * pos_oh[:, None, :] * keep[:, None, None]
+        slot_dispatch.append((disp, gates[:, s] * keep))
+        counts = counts + jnp.sum(onehot * keep[:, None], axis=0)
+        onehot_all = onehot_all + onehot
+    dispatch = sum(d for d, _ in slot_dispatch)
+    return dispatch, slot_dispatch, onehot_all
+
+
 def _moe_local(x, router, w_in, w_out, *, axis: str, n_experts: int,
-               capacity: int):
+               capacity: int, top_k: int = 1):
     """x: [n_local, d]; w_in/w_out: [E/n, ...] local expert shards."""
     n_local, d = x.shape
     ep = jax.lax.psum(1, axis)
 
     logits = x @ router  # [n, E]
     probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)  # [n]
-    gate = jnp.max(probs, axis=-1)  # [n]
-
-    onehot = jax.nn.one_hot(expert, n_experts, dtype=x.dtype)  # [n, E]
-    # position of each token within its expert's queue
-    pos = jnp.cumsum(onehot, axis=0) - 1.0  # [n, E]
-    pos_tok = jnp.sum(pos * onehot, axis=-1)  # [n]
-    keep = pos_tok < capacity
-    gate = gate * keep
-
-    pos_oh = jax.nn.one_hot(pos_tok.astype(jnp.int32), capacity,
-                            dtype=x.dtype)  # [n, C]
-    dispatch = onehot[:, :, None] * pos_oh[:, None, :] * keep[:, None, None]
+    dispatch, slot_dispatch, onehot = _routing(probs, n_experts, capacity,
+                                               top_k, x.dtype)
     # [n, E, C] -> buffers [E, C, d]
     buffers = jnp.einsum("nec,nd->ecd", dispatch, x)
 
@@ -73,11 +97,12 @@ def _moe_local(x, router, w_in, w_out, *, axis: str, n_experts: int,
 
     out = jax.lax.all_to_all(out, axis, split_axis=1, concat_axis=0,
                              tiled=True)  # [E, C, d]
-    y = jnp.einsum("nec,ecd->nd", dispatch, out) * gate[:, None]
+    y = sum(jnp.einsum("nec,ecd->nd", disp, out) * gate_s[:, None]
+            for disp, gate_s in slot_dispatch)
 
     # Switch load-balancing loss: E * sum_e frac_tokens_e * mean_prob_e,
-    # averaged over devices
-    frac = jnp.mean(onehot, axis=0)
+    # averaged over devices (assignment fractions normalized by top_k)
+    frac = jnp.mean(onehot, axis=0) / max(top_k, 1)
     mean_prob = jnp.mean(probs, axis=0)
     aux = n_experts * jnp.sum(frac * mean_prob)
     aux = jax.lax.pmean(aux, axis)
@@ -94,13 +119,13 @@ def moe_layer(params: Dict, x, mesh, cfg: MoEConfig,
                          f"ep axis size {ep}")
     n_tokens = x.shape[0]
     n_local = n_tokens // ep
-    capacity = max(1, int(math.ceil(n_local * cfg.capacity_factor
-                                    / cfg.n_experts)))
+    capacity = max(1, int(math.ceil(n_local * cfg.top_k
+                                    * cfg.capacity_factor / cfg.n_experts)))
 
     fn = shard_map(
         lambda xl, r, wi, wo: _moe_local(
             xl, r, wi, wo, axis=axis, n_experts=cfg.n_experts,
-            capacity=capacity),
+            capacity=capacity, top_k=cfg.top_k),
         mesh=mesh,
         in_specs=(P(axis), P(), P(axis), P(axis)),
         out_specs=(P(axis), P()),
@@ -109,32 +134,41 @@ def moe_layer(params: Dict, x, mesh, cfg: MoEConfig,
 
 
 def moe_reference(params: Dict, x, cfg: MoEConfig, n_devices: int = 1):
-    """Single-device semantics-equivalent reference (same capacity limits per
-    source shard) used by tests."""
+    """Single-device semantics-equivalent reference (per-token python loop,
+    same slot-major capacity accounting as `_routing`) used by tests."""
+    import numpy as np
+
     n = x.shape[0]
     n_local = n // n_devices
-    capacity = max(1, int(math.ceil(n_local * cfg.capacity_factor
-                                    / cfg.n_experts)))
+    capacity = max(1, int(math.ceil(n_local * cfg.top_k
+                                    * cfg.capacity_factor / cfg.n_experts)))
     ys = []
     auxes = []
     for s in range(n_devices):
         xs = x[s * n_local:(s + 1) * n_local]
-        logits = xs @ params["router"]
-        probs = jax.nn.softmax(logits, axis=-1)
-        expert = jnp.argmax(probs, axis=-1)
-        gate = jnp.max(probs, axis=-1)
-        onehot = jax.nn.one_hot(expert, cfg.n_experts, dtype=x.dtype)
-        pos = jnp.cumsum(onehot, axis=0) - 1.0
-        pos_tok = jnp.sum(pos * onehot, axis=-1)
-        keep = pos_tok < capacity
-        gate = gate * keep
+        probs = np.asarray(jax.nn.softmax(xs @ params["router"], axis=-1))
+        order = np.argsort(-probs, axis=-1)[:, :cfg.top_k]  # [n, k]
+        topk = np.take_along_axis(probs, order, axis=-1)
+        if cfg.top_k == 1:
+            gates = topk
+        else:
+            gates = topk / np.maximum(topk.sum(-1, keepdims=True), 1e-9)
+
+        counts = np.zeros(cfg.n_experts, np.int64)
         out = jnp.zeros_like(xs)
-        for i in range(xs.shape[0]):
-            e = int(expert[i])
-            h = jax.nn.gelu(xs[i] @ params["w_in"][e])
-            out = out.at[i].set(h @ params["w_out"][e])
-        ys.append(out * gate[:, None])
-        frac = jnp.mean(onehot, axis=0)
-        mean_prob = jnp.mean(probs, axis=0)
-        auxes.append(cfg.n_experts * jnp.sum(frac * mean_prob))
+        onehot_frac = np.zeros(cfg.n_experts)
+        for k in range(cfg.top_k):
+            for i in range(xs.shape[0]):
+                e = int(order[i, k])
+                onehot_frac[e] += 1
+                if counts[e] >= capacity:
+                    continue
+                counts[e] += 1
+                h = jax.nn.gelu(xs[i] @ params["w_in"][e])
+                out = out.at[i].add((h @ params["w_out"][e])
+                                    * gates[i, k])
+        ys.append(out)
+        frac = onehot_frac / xs.shape[0] / max(cfg.top_k, 1)
+        auxes.append(cfg.n_experts * jnp.sum(jnp.asarray(frac)
+                                             * jnp.mean(probs, axis=0)))
     return jnp.concatenate(ys), jnp.mean(jnp.stack(auxes))

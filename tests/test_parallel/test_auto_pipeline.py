@@ -116,3 +116,68 @@ def test_auto_pipeline_multi_leaf_microbatch(mesh_pp):
                       for i in range(M)])
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.world_8
+def test_split_point_markers_control_stages(mesh_pp):
+    """User split_point markers override FLOP balancing (reference
+    annotate_split_points, pp/compile_pipeline.py:60-78)."""
+    from easydist_tpu.parallel import split_point
+
+    d, M, mb = 16, 8, 4
+    params = make_model(jax.random.PRNGKey(0), d)
+
+    def marked_fn(params, x):
+        h = x
+        for i, layer in enumerate(params):
+            h = jnp.tanh(h @ layer["w"])
+            if i in (1, 3, 5):  # 3 markers -> 4 stages
+                h = split_point(h)
+        return h
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (M, mb, d))
+    pipe = pipeline_forward(marked_fn, params, x[0], mesh_pp,
+                            n_stages=4, n_microbatches=M)
+    got = pipe(params, x)
+    want = jnp.stack([model_fn(params, x[i]) for i in range(M)])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+    with pytest.raises(ValueError, match="markers"):
+        pipeline_forward(marked_fn, params, x[0], mesh_pp,
+                         n_stages=3, n_microbatches=M)
+
+
+@pytest.mark.world_8
+def test_shard_params_matches_and_shrinks_memory(mesh_pp):
+    """shard_params=True: per-stage params live only on their stage's
+    device; output still exact and per-device argument bytes shrink ~1/pp
+    (VERDICT r1 #8)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    d, M, mb = 64, 8, 4
+    params = make_model(jax.random.PRNGKey(0), d)
+    x = jax.random.normal(jax.random.PRNGKey(1), (M, mb, d))
+
+    pipe_rep = pipeline_forward(model_fn, params, x[0], mesh_pp,
+                                n_stages=4, n_microbatches=M)
+    pipe_sh, pack = pipeline_forward(model_fn, params, x[0], mesh_pp,
+                                     n_stages=4, n_microbatches=M,
+                                     shard_params=True)
+    packed = pack(params)
+    got = pipe_sh(packed, x)
+    want = jnp.stack([model_fn(params, x[i]) for i in range(M)])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+    # per-device argument bytes: packed buffer sharded over pp vs fully
+    # replicated leaves
+    sharded = NamedSharding(mesh_pp, P("pp", None))
+    rep = NamedSharding(mesh_pp, P())
+    c_sh = jax.jit(pipe_sh, in_shardings=(
+        (sharded, tuple(rep for _ in packed[1])),
+        rep)).lower(packed, x).compile()
+    c_rep = jax.jit(pipe_rep).lower(params, x).compile()
+    a_sh = c_sh.memory_analysis().argument_size_in_bytes
+    a_rep = c_rep.memory_analysis().argument_size_in_bytes
+    assert a_sh < a_rep * 0.5, (a_sh, a_rep)

@@ -41,3 +41,19 @@ def test_moe_gradients_flow(mesh_ep):
         assert np.isfinite(np.asarray(leaf)).all()
     # expert weights must receive nonzero gradient
     assert float(jnp.abs(grads["w_in"]).sum()) > 0
+
+
+@pytest.mark.world_8
+def test_moe_top2_matches_reference(cpu_devices):
+    """GShard-style top-2 routing with renormalized gates and shared
+    capacity accounting across slots."""
+    mesh = make_device_mesh((4,), ("ep",), devices=cpu_devices[:4])
+    cfg = MoEConfig(n_experts=8, d_model=16, d_ff=32, top_k=2,
+                    capacity_factor=2.0)
+    params = moe_init(cfg, jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (32, 16))
+    y, aux = moe_layer(params, x, mesh, cfg)
+    y_ref, aux_ref = moe_reference(params, x, cfg, n_devices=4)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-4)
