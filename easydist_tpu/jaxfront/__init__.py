@@ -7,5 +7,5 @@ and lowers to `NamedSharding` over arbitrary ICI/DCN meshes.
 
 from .api import easydist_compile  # noqa: F401
 from .mesh import get_device_mesh, set_device_mesh, make_device_mesh  # noqa: F401
-from .scope import fix_sharding  # noqa: F401
+from .scope import fix_sharding, scoped_region  # noqa: F401
 from .api import get_opt_strategy  # noqa: F401

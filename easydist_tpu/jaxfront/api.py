@@ -290,6 +290,73 @@ def _axis_solve_order(axis_specs):
                                  -axis_specs[i].size))
 
 
+def solve_axes(closed_jaxpr, axis_specs, world, rules, shape_info, names,
+               state_io_names=None):
+    """The per-axis sequential solve (reference compile_auto.py:128-173):
+    strategies chosen on earlier axes are excluded from later pools and
+    sharded shapes are pre-shrunk, so no dim is double-sharded past
+    divisibility.  Shared by compile_step and scoped_region.
+
+    Returns (per_axis strategies list, last metagraph or None)."""
+    order = _axis_solve_order(axis_specs)
+    per_axis: List[Optional[Dict[str, NodeStrategy]]] = \
+        [None] * len(axis_specs)
+    var_shapes: Dict[str, Tuple[int, ...]] = {}
+    prev_chosen: List[Dict[str, NodeStrategy]] = []
+    graph = None
+    for axis_idx in order:
+        axis = axis_specs[axis_idx]
+        if axis.size == 1:
+            # single-device axis: every placement is equivalent, skip solving
+            per_axis[axis_idx] = {}
+            prev_chosen.append({})
+            continue
+        t0 = time.perf_counter()
+        graph = jaxpr_to_metagraph(closed_jaxpr, rules, shape_info,
+                                   world_size=world, names=names,
+                                   var_shapes=dict(var_shapes),
+                                   state_io=state_io_names or {})
+
+        def exclude_map(node, _prev=tuple(prev_chosen)):
+            if edconfig.allow_repeated_axis_strategy:
+                return []
+            out = []
+            for chosen in _prev:
+                s = chosen.get(node.name)
+                if s is not None and not s.is_all_replicate():
+                    out.append(s)
+            return out
+
+        coarsen_level = (edconfig.coarsen_level
+                         if edconfig.enable_graph_coarsen else 0)
+        graph.coarsen(axis.size, level=coarsen_level,
+                      exclude_map=exclude_map)
+        reach = None
+        if edconfig.predict_comm_overlap:
+            from easydist_tpu.autoflow.reachability import ReachabilityMap
+
+            reach = ReachabilityMap(graph)
+        solver = SpmdSolver(graph, axis, reachability=reach)
+        chosen = solver.solve()
+        per_axis[axis_idx] = chosen
+        prev_chosen.append(chosen)
+        logger.info("[solve] axis %s (%d devices) in %.2fs", axis.name,
+                    axis.size, time.perf_counter() - t0)
+
+        # shrink shapes sharded on this axis for subsequent solves
+        for node in graph.all_nodes():
+            strat = chosen.get(node.name)
+            if strat is None:
+                continue
+            for v, p in zip(node.outvars, strat.out_placements):
+                if v is not None and p is not None and p.is_shard():
+                    shape = list(var_shapes.get(v.name, v.shape))
+                    if shape[p.dim] % axis.size == 0:
+                        shape[p.dim] //= axis.size
+                        var_shapes[v.name] = tuple(shape)
+    return per_axis, graph
+
+
 def compile_step(func, args, kwargs, mesh=None, state_io="auto",
                  donate_state: Optional[bool] = None) -> CompileResult:
     if mesh is None:
@@ -361,61 +428,8 @@ def compile_step(func, args, kwargs, mesh=None, state_io="auto",
                 state_io_names[names.name(ov)] = names.name(jaxpr.invars[in_idx])
 
     # ---- per-axis sequential solve
-    order = _axis_solve_order(axis_specs)
-    per_axis: List[Optional[Dict[str, NodeStrategy]]] = [None] * len(axis_specs)
-    var_shapes: Dict[str, Tuple[int, ...]] = {}
-    prev_chosen: List[Dict[str, NodeStrategy]] = []
-    graph = None
-    for axis_idx in order:
-        axis = axis_specs[axis_idx]
-        if axis.size == 1:
-            # single-device axis: every placement is equivalent, skip solving
-            per_axis[axis_idx] = {}
-            prev_chosen.append({})
-            continue
-        t0 = time.perf_counter()
-        graph = jaxpr_to_metagraph(closed_jaxpr, rules, shape_info,
-                                   world_size=world, names=names,
-                                   var_shapes=dict(var_shapes),
-                                   state_io=state_io_names)
-
-        def exclude_map(node, _prev=tuple(prev_chosen)):
-            if edconfig.allow_repeated_axis_strategy:
-                return []
-            out = []
-            for chosen in _prev:
-                s = chosen.get(node.name)
-                if s is not None and not s.is_all_replicate():
-                    out.append(s)
-            return out
-
-        coarsen_level = (edconfig.coarsen_level
-                         if edconfig.enable_graph_coarsen else 0)
-        graph.coarsen(axis.size, level=coarsen_level,
-                      exclude_map=exclude_map)
-        reach = None
-        if edconfig.predict_comm_overlap:
-            from easydist_tpu.autoflow.reachability import ReachabilityMap
-
-            reach = ReachabilityMap(graph)
-        solver = SpmdSolver(graph, axis, reachability=reach)
-        chosen = solver.solve()
-        per_axis[axis_idx] = chosen
-        prev_chosen.append(chosen)
-        logger.info("[solve] axis %s (%d devices) in %.2fs", axis.name,
-                    axis.size, time.perf_counter() - t0)
-
-        # shrink shapes sharded on this axis for subsequent solves
-        for node in graph.all_nodes():
-            strat = chosen.get(node.name)
-            if strat is None:
-                continue
-            for v, p in zip(node.outvars, strat.out_placements):
-                if v is not None and p is not None and p.is_shard():
-                    shape = list(var_shapes.get(v.name, v.shape))
-                    if shape[p.dim] % axis.size == 0:
-                        shape[p.dim] //= axis.size
-                        var_shapes[v.name] = tuple(shape)
+    per_axis, graph = solve_axes(closed_jaxpr, axis_specs, world, rules,
+                                 shape_info, names, state_io_names)
 
     if edconfig.dump_dir:
         _dump_strategies(graph, [c if c is not None else {} for c in per_axis],

@@ -34,6 +34,56 @@ class _compile_mesh_ctx:
         _COMPILE_MESH = self._prev
 
 
+def scoped_region(fn, mesh, axis_specs=None):
+    """Solve `fn`'s sharding strategy on its OWN mesh and inline the region
+    with that mesh's constraints wherever it is called — including inside a
+    surrounding `easydist_compile` step running on a different mesh view.
+
+    The reference groups model regions with scope markers and solves each
+    scope's strategy separately (torch/scope_auto/scope_marker.py,
+    build_scope_modules.py); on TPU the scope's mesh is just another
+    logical view of the same devices, so the scoped strategy is emitted as
+    `with_sharding_constraint`s over that view and XLA stitches the views
+    together with resharding collectives at the scope boundary.
+
+    Returns wrapped(*args) with fn's semantics.  The per-signature solve
+    runs once and is cached.
+    """
+    _cache = {}
+
+    def wrapped(*args):
+        from .api import ShardingAnalyzer, emit_sharded_fn, solve_axes
+        from .inline import inline_calls
+        from .mesh import get_axis_specs
+
+        flat, treedef = jax.tree_util.tree_flatten(args)
+        key = (treedef, tuple((tuple(getattr(x, "shape", ())),
+                               str(getattr(x, "dtype", type(x))))
+                              for x in flat))
+        hit = _cache.get(key)
+        if hit is None:
+            closed, out_tree = jax.make_jaxpr(fn, return_shape=True)(*args)
+            closed = inline_calls(closed)
+            specs = axis_specs or get_axis_specs(mesh)
+            world = min((s.size for s in specs), default=1)
+            analyzer = ShardingAnalyzer(closed, world_size=world)
+            rules, shape_info = analyzer.run()
+            # same per-axis loop as compile_step: cross-axis exclusion and
+            # shape shrinking keep two axes off the same tensor dim
+            per_axis, _ = solve_axes(closed, specs, world, rules,
+                                     shape_info, analyzer.names)
+            per_axis = [c if c is not None else {} for c in per_axis]
+            sharded = emit_sharded_fn(closed, analyzer.names, per_axis,
+                                      [s.name for s in specs], mesh)
+            out_leaves_tree = jax.tree_util.tree_structure(out_tree)
+            hit = _cache[key] = (sharded, out_leaves_tree)
+        sharded, out_leaves_tree = hit
+        outs = sharded(*flat)
+        return jax.tree_util.tree_unflatten(out_leaves_tree, outs)
+
+    return wrapped
+
+
 def fix_sharding(x, *spec_entries, mesh=None):
     """Pin `x` to PartitionSpec(*spec_entries) on the current mesh
     (the mesh under compilation, else the global mesh).

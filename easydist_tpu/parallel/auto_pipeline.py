@@ -158,6 +158,16 @@ class _StagePlan:
                 raise NotImplementedError(
                     f"non-float output {aval} cannot ride the f32 output "
                     f"transport (would lose precision)")
+        # wire dtype: when every boundary value shares one half-precision
+        # dtype, rotate the transport in that dtype (half the ICI bytes);
+        # mixed or wider dtypes keep the lossless f32 wire.  bf16<->f16
+        # cross-casting would silently drop mantissa/exponent bits.
+        bdts = {v.aval.dtype for b in self.boundaries for v in b}
+        if len(bdts) == 1 and next(iter(bdts)) in (jnp.bfloat16,
+                                                   jnp.float16):
+            self.wire_dtype = next(iter(bdts))
+        else:
+            self.wire_dtype = jnp.float32
         self.buf_elems = max(
             [sum(math.prod(v.aval.shape) for v in b)
              for b in self.boundaries] + [1])
@@ -191,9 +201,9 @@ class _StagePlan:
                 shared_idx.append(i)
         return stage_layouts, shared_idx
 
-    def pack(self, values: List, total: int):
-        parts = [jnp.ravel(v).astype(jnp.float32) for v in values]
-        flat = jnp.concatenate(parts) if parts else jnp.zeros((0,), jnp.float32)
+    def pack(self, values: List, total: int, dtype=jnp.float32):
+        parts = [jnp.ravel(v).astype(dtype) for v in values]
+        flat = jnp.concatenate(parts) if parts else jnp.zeros((0,), dtype)
         return jnp.pad(flat, (0, total - flat.shape[0]))
 
     def unpack(self, buf, variables: List):
@@ -271,10 +281,10 @@ def pipeline_forward(fn: Callable, example_params, example_mb, mesh,
 
             if s < S - 1:
                 buf_out = plan.pack([env[v] for v in plan.boundaries[s]],
-                                    plan.buf_elems)
+                                    plan.buf_elems, plan.wire_dtype)
                 out_pack = jnp.zeros((plan.out_elems,), jnp.float32)
             else:
-                buf_out = jnp.zeros((plan.buf_elems,), jnp.float32)
+                buf_out = jnp.zeros((plan.buf_elems,), plan.wire_dtype)
                 out_pack = plan.pack([read(v) for v in plan.out_vars],
                                      plan.out_elems)
             return buf_out, out_pack
@@ -325,7 +335,7 @@ def pipeline_forward(fn: Callable, example_params, example_mb, mesh,
                     buf_out, axis, [(i, (i + 1) % S) for i in range(S)])
                 return (nxt, outputs), None
 
-            buf0 = jnp.zeros((plan.buf_elems,), jnp.float32)
+            buf0 = jnp.zeros((plan.buf_elems,), plan.wire_dtype)
             outs0 = jnp.zeros((M, plan.out_elems), jnp.float32)
             (_, outputs), _ = jax.lax.scan(tick, (buf0, outs0), jnp.arange(T))
             outputs = jax.lax.psum(

@@ -181,3 +181,32 @@ def test_shard_params_matches_and_shrinks_memory(mesh_pp):
     a_sh = c_sh.memory_analysis().argument_size_in_bytes
     a_rep = c_rep.memory_analysis().argument_size_in_bytes
     assert a_sh < a_rep * 0.5, (a_sh, a_rep)
+
+
+@pytest.mark.world_8
+def test_bf16_boundaries_ride_bf16_wire(mesh_pp):
+    """All-bf16 boundaries rotate in bf16 (half the ICI bytes)."""
+    from easydist_tpu.parallel.auto_pipeline import _StagePlan
+    from easydist_tpu.jaxfront.inline import inline_calls
+
+    d, M, mb = 16, 4, 2
+    params = [{"w": (jax.random.normal(k, (d, d)) / 4).astype(jnp.bfloat16)}
+              for k in jax.random.split(jax.random.PRNGKey(3), 4)]
+
+    def bf16_fn(params, x):
+        h = x.astype(jnp.bfloat16)
+        for layer in params:
+            h = jnp.tanh(h @ layer["w"])
+        return h.astype(jnp.float32)
+
+    x = jax.random.normal(jax.random.PRNGKey(4), (M, mb, d))
+    closed = inline_calls(jax.make_jaxpr(bf16_fn)(params, x[0]))
+    plan = _StagePlan(closed, 4)
+    assert plan.wire_dtype == jnp.bfloat16
+
+    pipe = pipeline_forward(bf16_fn, params, x[0], mesh_pp,
+                            n_stages=4, n_microbatches=M)
+    got = pipe(params, x)
+    want = jnp.stack([bf16_fn(params, x[i]) for i in range(M)])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-2, atol=1e-2)
