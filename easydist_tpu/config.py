@@ -93,6 +93,9 @@ dcn_latency = _env_float("EASYDIST_DCN_LATENCY", 2.0e-5)
 # HBM bandwidth (bytes/s): prices the compute-redundancy of replicated ops
 # (elementwise ops are memory-bound; v5e ~ 810 GB/s)
 hbm_bandwidth = _env_float("EASYDIST_HBM_BANDWIDTH", 8.1e11)
+# load measured alpha/beta/HBM values from the PerfDB when present
+# (runtime.calibrate.calibrate() records them on the target hardware)
+auto_calibration = _env_bool("EASYDIST_AUTO_CALIBRATION", True)
 multihost = _env_bool("EASYDIST_MULTIHOST", False)
 
 # ---------------- runtime ----------------

@@ -281,6 +281,30 @@ class CompileResult:
             self._executable = self.jitted.lower(*self.in_avals).compile()
         return self._executable
 
+    def materialize(self, init_fn, *init_args, arg_offset: int = 0):
+        """Deferred sharded materialization (reference init_helper.py:31-166
+        materialization strategies; the TPU-native form): run `init_fn`
+        under jit with this step's solved input shardings as out_shardings,
+        so state is BORN sharded on device — no replicated host copy ever
+        exists.  `arg_offset` is the flat input position where init_fn's
+        output leaves land in the step's signature (0 = leading state).
+        """
+        out_shape = jax.eval_shape(init_fn, *init_args)
+        leaves = jax.tree_util.tree_leaves(out_shape)
+        n = len(leaves)
+        expect = self.in_avals[arg_offset:arg_offset + n]
+        got = [(tuple(l.shape), np.dtype(l.dtype).name) for l in leaves]
+        want = [(tuple(a.shape), np.dtype(a.dtype).name) for a in expect]
+        if got != want:
+            raise ValueError(
+                f"init_fn output does not match the step's inputs at "
+                f"arg_offset={arg_offset}: init produces {got[:4]}..., "
+                f"step expects {want[:4]}... — wrong offset or init_fn?")
+        shardings = self.in_shardings[arg_offset:arg_offset + n]
+        tree = jax.tree_util.tree_structure(out_shape)
+        out_sh = jax.tree_util.tree_unflatten(tree, shardings)
+        return jax.jit(init_fn, out_shardings=out_sh)(*init_args)
+
 
 def _axis_solve_order(axis_specs):
     """Solve DCN axes first (coarser, costlier), then ICI by size descending
@@ -377,6 +401,14 @@ def compile_step(func, args, kwargs, mesh=None, state_io="auto",
     jaxpr = closed_jaxpr.jaxpr
     logger.info("[trace] %d eqns in %.2fs", len(jaxpr.eqns),
                 time.perf_counter() - t0)
+
+    # measured hardware constants beat datasheet defaults when available
+    # (EASYDIST_AUTO_CALIBRATION=0 opts out; run runtime.calibrate() once
+    # on the target to record them)
+    if edconfig.auto_calibration:
+        from easydist_tpu.runtime.calibrate import apply_calibration
+
+        apply_calibration()
 
     # ---- persistent compile cache: a hit skips discovery AND solving
     cache_key = cached = None

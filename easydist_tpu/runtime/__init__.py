@@ -11,3 +11,4 @@ from .perfdb import PerfDB  # noqa: F401
 from .profiler import profile_compiled, op_cost_analysis, memory_analysis  # noqa: F401
 from .elastic import run_training, multihost_setup  # noqa: F401
 from .data import TokenLoader  # noqa: F401
+from .calibrate import calibrate, apply_calibration  # noqa: F401

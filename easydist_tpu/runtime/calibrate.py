@@ -1,0 +1,125 @@
+"""Profile-guided cost-model calibration (reference: the per-op profiling
+DB feeding solver costs, easydist/utils/graph_profile_db.py + SURVEY §7
+step 8d).
+
+`calibrate(mesh)` microbenchmarks THIS backend — HBM-bound elementwise
+bandwidth, collective launch latency (alpha) and wire bandwidth (beta) —
+and persists the fit in the PerfDB.  `apply_calibration()` loads the stored
+fit into the solver's config so strategy costs reflect measured hardware
+instead of datasheet defaults.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from easydist_tpu import config as edconfig
+
+logger = logging.getLogger(__name__)
+
+_CAL_KEY = "cost_model_calibration"
+_applied = False
+
+
+def _backend_key() -> str:
+    return f"{jax.default_backend()}:{len(jax.devices())}"
+
+
+def _time_fn(fn, *args, iters=10):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def calibrate(mesh=None, axis: Optional[str] = None,
+              persist: bool = True) -> Dict[str, float]:
+    """Measure and (optionally) persist cost-model parameters.
+
+    Returns {"hbm_bandwidth", "ici_bandwidth", "ici_latency"} in the
+    solver's units (bytes/s, seconds/launch).
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    # HBM-bound bandwidth: big elementwise op, bytes moved = read + write
+    n = 1 << 24  # 64 MiB f32
+    x = jnp.ones((n,), jnp.float32)
+    mul = jax.jit(lambda a: a * 1.000001)
+    t = _time_fn(mul, x)
+    hbm = 2 * 4 * n / max(t, 1e-9)
+
+    result = {"hbm_bandwidth": float(hbm)}
+
+    if mesh is not None and mesh.devices.size > 1:
+        axis = axis or mesh.axis_names[0]
+        world = mesh.shape[axis]
+
+        # build ONE jitted collective; _time_fn warms each shape before
+        # timing, so the loop measures dispatch+collective, never retracing
+        ar = jax.jit(shard_map(lambda v: jax.lax.psum(v, axis), mesh=mesh,
+                               in_specs=P(axis), out_specs=P(),
+                               check_vma=False))
+
+        big_elems = 1 << 22  # 16 MiB f32 global
+        small_elems = world  # one element per shard
+        t_big = _time_fn(ar, jnp.ones((big_elems,), jnp.float32))
+        t_small = _time_fn(ar, jnp.ones((small_elems,), jnp.float32))
+        # alpha-beta fit: t = alpha + bytes_wire / bw, with all_reduce wire
+        # bytes = 2 * size * (n-1)/n
+        alpha = max(t_small, 1e-9)
+        wire = 2 * 4 * big_elems * (world - 1) / world
+        bw = wire / max(t_big - alpha, 1e-9)
+        result["ici_latency"] = float(alpha)
+        result["ici_bandwidth"] = float(bw)
+
+    if persist:
+        from .perfdb import PerfDB
+
+        db = PerfDB()
+        db.record_op_perf(_CAL_KEY, _backend_key(), result)
+        try:
+            db.persist()
+        except Exception:
+            logger.warning("could not persist calibration")
+    # fresh measurements take effect NOW, even if an earlier compile
+    # already latched older (or default) values
+    global _applied
+    for name, value in result.items():
+        if value > 0:
+            setattr(edconfig, name, value)
+    _applied = True
+    logger.info("calibration (%s): %s", _backend_key(),
+                {k: f"{v:.3e}" for k, v in result.items()})
+    return result
+
+
+def apply_calibration(force: bool = False) -> bool:
+    """Load a stored calibration for this backend into the solver config.
+    Returns True when values were applied.  Called automatically at the
+    start of each fresh compile (cheap after the first lookup)."""
+    global _applied
+    if _applied and not force:
+        return True
+    try:
+        from .perfdb import PerfDB
+
+        entry = PerfDB().get_op_perf(_CAL_KEY, _backend_key())
+    except Exception:
+        entry = None
+    if not entry:
+        return False
+    for name in ("hbm_bandwidth", "ici_bandwidth", "ici_latency"):
+        if name in entry and entry[name] > 0:
+            setattr(edconfig, name, entry[name])
+    _applied = True
+    logger.info("applied cost-model calibration for %s", _backend_key())
+    return True

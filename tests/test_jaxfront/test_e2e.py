@@ -281,3 +281,40 @@ def test_scoped_region_multi_mesh(cpu_devices):
     # the plain function (no easydist) also works with the scope inline
     got2 = jax.jit(lambda a, b, c: scoped(c @ a, b).sum())(w1, w2, x)
     np.testing.assert_allclose(float(got2), float(want), rtol=1e-5)
+
+
+@pytest.mark.world_8
+def test_materialize_state_born_sharded(mesh_1d):
+    """Deferred init: state materializes directly with the compiled step's
+    shardings (reference init_helper materialization strategies) — no
+    replicated host-side copy."""
+    params, x, y = _mlp_init()
+    compiled = easydist_compile(_mlp_step, mesh=mesh_1d, donate_state=False)
+    res = compiled.get_compiled(params, x, y)
+
+    def init_fn(key):
+        k1, k2 = jax.random.split(key)
+        return (jax.random.normal(k1, (256, 512)) / 16, jnp.zeros((512,)),
+                jax.random.normal(k2, (512, 256)) / 16, jnp.zeros((256,)))
+
+    fresh = res.materialize(init_fn, jax.random.PRNGKey(7))
+    for leaf, want in zip(jax.tree_util.tree_leaves(fresh),
+                          res.in_shardings[:4]):
+        assert leaf.sharding == want, (leaf.sharding, want)
+    # and the step runs on the born-sharded state
+    new_params, loss = res.tree_jitted(fresh, x, y)
+    assert np.isfinite(float(loss))
+
+
+@pytest.mark.world_8
+def test_materialize_rejects_wrong_offset(mesh_1d):
+    params, x, y = _mlp_init()
+    compiled = easydist_compile(_mlp_step, mesh=mesh_1d, donate_state=False)
+    res = compiled.get_compiled(params, x, y)
+
+    def init_fn(key):
+        return (jax.random.normal(key, (256, 512)) / 16, jnp.zeros((512,)),
+                jax.random.normal(key, (512, 256)) / 16, jnp.zeros((256,)))
+
+    with pytest.raises(ValueError, match="arg_offset"):
+        res.materialize(init_fn, jax.random.PRNGKey(0), arg_offset=1)

@@ -141,3 +141,31 @@ def test_restore_host_template_enters_multidevice_jit(cpu_devices):
         out, _ = compiled(restored, x)  # must not raise
         np.testing.assert_allclose(np.asarray(out["w"]),
                                    np.asarray(state2["w"]) + 32.0)
+
+
+@pytest.mark.world_8
+def test_calibration_roundtrip(tmp_path, cpu_devices):
+    """calibrate() measures this backend, persists to the PerfDB, and
+    apply_calibration() feeds the values into the solver config."""
+    from easydist_tpu import config as edconfig
+    from easydist_tpu.jaxfront import make_device_mesh
+    import importlib
+
+    cal = importlib.import_module("easydist_tpu.runtime.calibrate")
+
+    saved = (edconfig.prof_db_path, edconfig.hbm_bandwidth,
+             edconfig.ici_bandwidth, edconfig.ici_latency)
+    edconfig.prof_db_path = str(tmp_path / "perf.db")
+    try:
+        mesh = make_device_mesh((8,), ("d",))
+        result = cal.calibrate(mesh, axis="d")
+        assert result["hbm_bandwidth"] > 0
+        assert result["ici_bandwidth"] > 0 and result["ici_latency"] > 0
+        cal._applied = False
+        assert cal.apply_calibration()
+        assert edconfig.hbm_bandwidth == result["hbm_bandwidth"]
+        assert edconfig.ici_latency == result["ici_latency"]
+    finally:
+        (edconfig.prof_db_path, edconfig.hbm_bandwidth,
+         edconfig.ici_bandwidth, edconfig.ici_latency) = saved
+        cal._applied = False
