@@ -18,21 +18,30 @@ from easydist_tpu.metashard.metair import Placement
 
 @dataclass
 class MeshAxisSpec:
-    """One axis of the device mesh as the solver sees it."""
+    """One axis of the device mesh as the solver sees it.
+
+    bandwidth/latency keep their sentinel until READ (resolved_*): meshes
+    are usually built before runtime calibration updates the config, so
+    latching config values at construction would silently discard measured
+    constants (runtime/calibrate.py)."""
 
     name: str
     size: int
-    bandwidth: float = 0.0  # bytes/s; 0 -> ICI default
+    bandwidth: float = 0.0  # bytes/s; 0 -> per-kind config value at use
     kind: str = "ici"  # "ici" | "dcn"
-    latency: float = -1.0  # seconds per collective launch; <0 -> default
+    latency: float = -1.0  # seconds/launch; <0 -> per-kind config at use
 
-    def __post_init__(self):
-        if self.bandwidth == 0.0:
-            self.bandwidth = (edconfig.dcn_bandwidth if self.kind == "dcn"
-                              else edconfig.ici_bandwidth)
-        if self.latency < 0.0:
-            self.latency = (edconfig.dcn_latency if self.kind == "dcn"
-                            else edconfig.ici_latency)
+    def resolved_bandwidth(self) -> float:
+        if self.bandwidth > 0.0:
+            return self.bandwidth
+        return (edconfig.dcn_bandwidth if self.kind == "dcn"
+                else edconfig.ici_bandwidth)
+
+    def resolved_latency(self) -> float:
+        if self.latency >= 0.0:
+            return self.latency
+        return (edconfig.dcn_latency if self.kind == "dcn"
+                else edconfig.ici_latency)
 
 
 def _all_gather(x: float, n: int) -> float:
@@ -86,7 +95,7 @@ def resharding_cost(var_bytes: float, up: Placement, down: Placement,
     # bias is bytes-equal to replicating it (reduce_scatter + all_gather ==
     # all_reduce) and the memory tie-break scatters small params across the
     # mesh, emitting dozens of sub-KB collectives that cost pure latency.
-    return axis.latency + bytes_wire / axis.bandwidth
+    return axis.resolved_latency() + bytes_wire / axis.resolved_bandwidth()
 
 
 def placement_bytes(var_bytes: float, p: Placement, axis_size: int) -> float:

@@ -177,12 +177,17 @@ class SpmdSolver:
                     mem[i, j] = (placement_bytes(size, pu, self.axis.size)
                                  + placement_bytes(size, pd, self.axis.size))
             if self.reachability is not None and edconfig.predict_comm_overlap:
-                # overlap-capable collectives cost less (reference
-                # adjust_resharding_cost, solver.py:79-84)
+                # overlap-capable collectives cost less — but only as much
+                # as the independent compute can actually hide (the
+                # reference's flat discount, adjust_resharding_cost
+                # solver.py:79-84, fires on ANY parallel flops; here the
+                # hideable seconds bound the reduction per edge)
                 peer = self.reachability.independent_peer_flops(
                     e.up_node.name, e.down_node.name)
                 if peer > 0:
-                    comm = comm * (1.0 - edconfig.comm_overlap_ratio)
+                    hideable = peer / edconfig.peak_flops  # seconds
+                    comm = comm - edconfig.comm_overlap_ratio * \
+                        np.minimum(comm, hideable)
             e.comm, e.mem = comm, mem
 
     def _compute_tie_groups(self):

@@ -63,9 +63,12 @@ all_to_all_punish_factor = _env_float("EASYDIST_ALL_TO_ALL_PUNISH", 3.0)
 # allow re-picking a strategy already chosen on a previous mesh axis
 allow_repeated_axis_strategy = _env_bool("EASYDIST_ALLOW_REPEATED_AXIS_STRATEGY", False)
 # discount resharding cost when independent compute can hide the collective
-# (reference predict_comm_overlap + comm_overlap_ratio, solver.py:74-84)
+# (reference predict_comm_overlap + comm_overlap_ratio, solver.py:74-84);
+# the discount is bounded by the hideable seconds = peer_flops / peak_flops
 predict_comm_overlap = _env_bool("EASYDIST_PREDICT_COMM_OVERLAP", False)
 comm_overlap_ratio = _env_float("EASYDIST_COMM_OVERLAP_RATIO", 0.5)
+# device peak FLOP/s for overlap bounding (v5e bf16 ~197e12; f32 ~49e12)
+peak_flops = _env_float("EASYDIST_PEAK_FLOPS", 4.9e13)
 # (mem_cost_weight was removed: the solver derives the memory tie-break
 # weight from the comm-cost scale so it can order comm-equal solutions but
 # never flip a comm decision — a fixed weight could do either)

@@ -174,7 +174,7 @@ def _compile_cache_key(closed_jaxpr, axis_specs) -> str:
          "solver_cluster_dedup", "per_device_memory_cap",
          "coarsen_level", "enable_graph_coarsen", "predict_comm_overlap",
          "comm_overlap_ratio", "allow_repeated_axis_strategy",
-         "solver_backend", "liveness_only_input"))).encode())
+         "solver_backend", "liveness_only_input", "peak_flops"))).encode())
     names = VarNames()
     for v in closed_jaxpr.jaxpr.invars:
         names.name(v)

@@ -23,7 +23,8 @@ from easydist_tpu import config as edconfig
 logger = logging.getLogger(__name__)
 
 _CAL_KEY = "cost_model_calibration"
-_applied = False
+# None = unchecked, False = checked & absent, True = applied
+_applied = None
 
 
 def _backend_key() -> str:
@@ -76,10 +77,18 @@ def calibrate(mesh=None, axis: Optional[str] = None,
         # alpha-beta fit: t = alpha + bytes_wire / bw, with all_reduce wire
         # bytes = 2 * size * (n-1)/n
         alpha = max(t_small, 1e-9)
-        wire = 2 * 4 * big_elems * (world - 1) / world
-        bw = wire / max(t_big - alpha, 1e-9)
         result["ici_latency"] = float(alpha)
-        result["ici_bandwidth"] = float(bw)
+        if t_big > 1.25 * alpha:
+            wire = 2 * 4 * big_elems * (world - 1) / world
+            # plausibility clamp: a noisy denominator must not persist a
+            # bandwidth that makes collectives near-free in every solve
+            bw = min(wire / (t_big - alpha), 1e13)
+            result["ici_bandwidth"] = float(bw)
+        else:
+            logger.warning(
+                "collective timing is launch-dominated (t_big %.3es ~ "
+                "alpha %.3es): keeping the configured ici_bandwidth", t_big,
+                alpha)
 
     if persist:
         from .perfdb import PerfDB
@@ -107,8 +116,8 @@ def apply_calibration(force: bool = False) -> bool:
     Returns True when values were applied.  Called automatically at the
     start of each fresh compile (cheap after the first lookup)."""
     global _applied
-    if _applied and not force:
-        return True
+    if _applied is not None and not force:
+        return _applied
     try:
         from .perfdb import PerfDB
 
@@ -116,6 +125,7 @@ def apply_calibration(force: bool = False) -> bool:
     except Exception:
         entry = None
     if not entry:
+        _applied = False  # negative result cached: no repeated DB reads
         return False
     for name in ("hbm_bandwidth", "ici_bandwidth", "ici_latency"):
         if name in entry and entry[name] > 0:

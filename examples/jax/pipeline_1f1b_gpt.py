@@ -1,0 +1,45 @@
+"""1F1B (DAPPLE-class) pipelined GPT training with interleaved virtual
+stages — O(n_stages) live microbatches instead of GPipe's O(M)
+(reference: ScheduleDAPPLE, torch/experimental/pp/runtime.py:658-700).
+
+python examples/jax/pipeline_1f1b_gpt.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+if not os.environ.get("EASYDIST_REAL_DEVICES"):
+    from easydist_tpu.utils.testing import force_cpu_devices
+
+    force_cpu_devices(8)
+import jax  # noqa: E402
+
+from easydist_tpu.jaxfront import make_device_mesh  # noqa: E402
+from easydist_tpu.models import GPTConfig  # noqa: E402
+from easydist_tpu.models.gpt import make_gpt_pipeline_step  # noqa: E402
+
+
+def main():
+    # 4 pipeline stages x 2-way data parallel; each device runs TWO virtual
+    # stage chunks (8 chunks total) to shrink the pipeline bubble
+    mesh = make_device_mesh((4, 2), ("pp", "dp"))
+    cfg = GPTConfig.tiny(layers=8)
+    M = 8  # microbatches
+
+    step, init_state = make_gpt_pipeline_step(
+        cfg, mesh, n_microbatches=M, schedule="1f1b", n_virtual=2,
+        data_axis="dp", lr=1e-3)
+    state = init_state(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (M, 2, cfg.seq), 0,
+                                cfg.vocab)
+
+    step = jax.jit(step)
+    for i in range(5):
+        state, loss = step(state, tokens, tokens)
+        print(f"step {i}: loss {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -167,6 +167,61 @@ def test_reachability_overlap():
         edconfig.predict_comm_overlap = False
 
 
+def test_overlap_discount_is_bounded_by_peer_compute():
+    """The discount must scale with hideable seconds (peer_flops /
+    peak_flops), not fire flatly on any parallel work: with a tiny peak
+    throughput the discount is ~full ratio; with a huge one it vanishes."""
+    import easydist_tpu.config as edconfig
+    from easydist_tpu.autoflow.reachability import ReachabilityMap
+
+    def build():
+        g = MetaGraph("two_chains")
+        nx1, vx1 = placeholder("x1", (64, 32))
+        nx2, vx2 = placeholder("x2", (64, 32))
+        nw, vw = placeholder("w", (32, 32))
+        for n in (nx1, nx2, nw):
+            g.add_input(n)
+        a1, va1 = matmul_node("a1", vx1, vw, (64, 32))
+        a2, va2 = matmul_node("a2", va1, vw, (64, 32))
+        b1, vb1 = matmul_node("b1", vx2, vw, (64, 32))
+        join, vj = matmul_node("join", va2, vb1, (64, 64))
+        for n in (a1, b1, a2, join):
+            g.add_op(n)
+        g.outputs.append(vj)
+        return g
+
+    def edge_cost_sum(peak):
+        saved = (edconfig.predict_comm_overlap, edconfig.peak_flops)
+        edconfig.predict_comm_overlap, edconfig.peak_flops = True, peak
+        try:
+            g = build()
+            g.coarsen(AXIS.size, level=0)
+            solver = SpmdSolver(g, AXIS, reachability=ReachabilityMap(g))
+            return sum(float(e.comm.sum()) for e in solver.edges)
+        finally:
+            (edconfig.predict_comm_overlap,
+             edconfig.peak_flops) = saved
+
+    full = edge_cost_sum(1e30)       # nothing hideable: ~undiscounted
+    heavy = edge_cost_sum(1.0)       # everything hideable: full ratio
+    assert heavy < full
+    # with peak -> inf the discount disappears entirely
+    base_saved = edconfig.predict_comm_overlap
+    edconfig.predict_comm_overlap = False
+    try:
+        g = build()
+        g.coarsen(AXIS.size, level=0)
+        solver = SpmdSolver(g, AXIS, reachability=None)
+        undiscounted = sum(float(e.comm.sum()) for e in solver.edges)
+    finally:
+        edconfig.predict_comm_overlap = base_saved
+    assert abs(full - undiscounted) / max(undiscounted, 1e-12) < 1e-6
+    # peer-less edges keep full cost, so the total sits strictly between
+    # the flat-ratio floor and the undiscounted sum
+    assert undiscounted * (1 - edconfig.comm_overlap_ratio) < heavy < \
+        undiscounted
+
+
 @pytest.mark.long_duration
 def test_cluster_dedup_matches_undeduped_and_is_faster():
     """Isomorphic transformer layers tie to one set of ILP variables

@@ -161,11 +161,32 @@ def test_calibration_roundtrip(tmp_path, cpu_devices):
         result = cal.calibrate(mesh, axis="d")
         assert result["hbm_bandwidth"] > 0
         assert result["ici_bandwidth"] > 0 and result["ici_latency"] > 0
-        cal._applied = False
+        cal._applied = None  # force a fresh DB lookup
         assert cal.apply_calibration()
         assert edconfig.hbm_bandwidth == result["hbm_bandwidth"]
         assert edconfig.ici_latency == result["ici_latency"]
     finally:
         (edconfig.prof_db_path, edconfig.hbm_bandwidth,
          edconfig.ici_bandwidth, edconfig.ici_latency) = saved
-        cal._applied = False
+        cal._applied = None
+
+
+@pytest.mark.world_8
+def test_calibrated_latency_reaches_edge_costs(tmp_path, cpu_devices):
+    """Calibration must affect solver costs even for meshes built BEFORE
+    calibrate() ran (axis specs resolve config at use, not construction)."""
+    from easydist_tpu import config as edconfig
+    from easydist_tpu.autoflow import MeshAxisSpec, resharding_cost
+    from easydist_tpu.metashard.metair import Placement
+
+    axis = MeshAxisSpec("d", 8)  # built with defaults
+    saved = edconfig.ici_latency
+    try:
+        base = resharding_cost(1024, Placement.partial(),
+                               Placement.replicate(), axis)
+        edconfig.ici_latency = saved + 1.0  # "calibration" bumps latency
+        bumped = resharding_cost(1024, Placement.partial(),
+                                 Placement.replicate(), axis)
+        assert abs((bumped - base) - 1.0) < 1e-6
+    finally:
+        edconfig.ici_latency = saved
