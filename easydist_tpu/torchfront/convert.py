@@ -263,24 +263,67 @@ def _batch_norm(x, w, b, running_mean, running_var, training, momentum,
     return out
 
 
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _conv_transpose2d(x, w, bias, stride, padding, dilation, output_padding,
+                      groups):
+    """torch ConvTranspose2d == fractionally-strided conv: lhs_dilation =
+    stride, kernel spatially flipped with in/out channels swapped (torch
+    weight layout is [Cin, Cout/g, kh, kw])."""
+    if w.ndim != 4:
+        raise UnsupportedAtenOp(
+            f"transposed convolution with {w.ndim - 2}D kernels "
+            f"(only 2D is implemented)")
+    cin = w.shape[0]
+    kh, kw = w.shape[2], w.shape[3]
+    # [Cin, Cout/g, kh, kw] -> [g, Cin/g, Cout/g, ...] -> [Cout, Cin/g, ...]
+    wg = w.reshape(groups, cin // groups, w.shape[1], kh, kw)
+    wg = jnp.swapaxes(wg, 1, 2).reshape(groups * w.shape[1],
+                                        cin // groups, kh, kw)
+    wg = jnp.flip(wg, axis=(2, 3))
+    pads = []
+    for k, p, d, op in zip((kh, kw), padding, dilation, output_padding):
+        eff = d * (k - 1)
+        pads.append((eff - p, eff - p + op))
+    out = jax.lax.conv_general_dilated(
+        x, wg, (1, 1), pads,
+        lhs_dilation=tuple(stride),
+        rhs_dilation=tuple(dilation),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+@register_aten("aten.conv_transpose2d.input")
+def _conv_transpose2d_input(x, w, bias=None, stride=(1, 1), padding=(0, 0),
+                            output_padding=(0, 0), groups=1,
+                            dilation=(1, 1)):
+    return _conv_transpose2d(x, w, bias, _pair(stride), _pair(padding),
+                             _pair(dilation), _pair(output_padding), groups)
+
+
 @register_aten("aten.conv2d.default", "aten.convolution.default")
 def _conv2d(x, w, bias=None, stride=(1, 1), padding=(0, 0), dilation=(1, 1),
             *rest):
     # torch NCHW / OIHW; groups is the last convolution arg when present
     groups = 1
+    transposed = False
+    output_padding = (0, 0)
     if rest:
         if len(rest) >= 3:  # convolution.default: transposed, output_padding, groups
-            if rest[0]:
-                raise UnsupportedAtenOp("transposed convolution")
+            transposed = bool(rest[0])
+            output_padding = tuple(rest[1]) if rest[1] else (0, 0)
             groups = rest[2]
         else:
             groups = rest[0]
-    if isinstance(stride, int):
-        stride = (stride, stride)
-    if isinstance(padding, int):
-        padding = (padding, padding)
-    if isinstance(dilation, int):
-        dilation = (dilation, dilation)
+    stride, padding, dilation = _pair(stride), _pair(padding), _pair(dilation)
+    if transposed:
+        return _conv_transpose2d(x, w, bias, stride, padding, dilation,
+                                 output_padding, groups)
     out = jax.lax.conv_general_dilated(
         x, w, tuple(stride),
         [(p, p) for p in padding],
@@ -292,24 +335,32 @@ def _conv2d(x, w, bias=None, stride=(1, 1), padding=(0, 0), dilation=(1, 1),
     return out
 
 
+def _ceil_extra(n, k, s, p, d):
+    """Extra high-side padding so reduce_window covers torch's ceil_mode
+    windows; torch ignores windows starting entirely in the padding."""
+    eff = d * (k - 1) + 1
+    out_ceil = -(-(n + 2 * p - eff) // s) + 1
+    # last window must start inside the (left-padded) input
+    if (out_ceil - 1) * s >= n + p:
+        out_ceil -= 1
+    return max((out_ceil - 1) * s + eff - (n + 2 * p), 0)
+
+
 @register_aten("aten.max_pool2d.default")
 def _max_pool2d(x, kernel, stride=None, padding=(0, 0), dilation=(1, 1),
                 ceil_mode=False):
+    kernel = _pair(kernel)
+    stride = _pair(stride or kernel)
+    padding, dilation = _pair(padding), _pair(dilation)
+    pads = [(p, p) for p in padding]
     if ceil_mode:
-        raise UnsupportedAtenOp("max_pool2d with ceil_mode=True")
-    if isinstance(kernel, int):
-        kernel = (kernel, kernel)
-    stride = stride or kernel
-    if isinstance(stride, int):
-        stride = (stride, stride)
-    if isinstance(padding, int):
-        padding = (padding, padding)
-    if isinstance(dilation, int):
-        dilation = (dilation, dilation)
+        pads = [(p, p + _ceil_extra(n, k, s, p, d))
+                for n, k, s, (p, _), d in zip(x.shape[2:], kernel, stride,
+                                              pads, dilation)]
     return jax.lax.reduce_window(
         x, -jnp.inf, jax.lax.max,
         (1, 1) + tuple(kernel), (1, 1) + tuple(stride),
-        [(0, 0), (0, 0)] + [(p, p) for p in padding],
+        [(0, 0), (0, 0)] + pads,
         window_dilation=(1, 1) + tuple(dilation))
 
 
@@ -474,6 +525,23 @@ def _expand(x, sizes, implicit=False):
             shape.append(s)
     x = x.reshape((1,) * offset + x.shape) if offset > 0 else x
     return jnp.broadcast_to(x, tuple(shape))
+
+
+@register_aten("aten.index.Tensor")
+def _index_tensor(x, indices):
+    """Advanced indexing x[idx0, idx1, ...]; None entries keep the dim."""
+    for i in indices:
+        if i is not None and getattr(i, "dtype", None) == jnp.bool_:
+            raise UnsupportedAtenOp(
+                "aten.index.Tensor with a boolean mask (data-dependent "
+                "output shape); use jnp.where-style masking instead")
+    idx = tuple(slice(None) if i is None else i for i in indices)
+    return x[idx]
+
+
+@register_aten("aten.index_select.default")
+def _index_select(x, dim, index):
+    return jnp.take(x, index, axis=dim)
 
 
 @register_aten("aten.masked_fill.Scalar")

@@ -135,9 +135,7 @@ def test_expand_right_aligned():
     assert_matches_torch(Expander(), (torch.randn(4, 5),))
 
 
-def test_transposed_conv_raises():
-    from easydist_tpu.torchfront.convert import UnsupportedAtenOp
-
+def test_transposed_conv_basic():
     class TConv(nn.Module):
         def __init__(self):
             super().__init__()
@@ -146,9 +144,7 @@ def test_transposed_conv_raises():
         def forward(self, x):
             return self.tc(x)
 
-    fn, params = torch_module_to_jax(TConv(), (torch.randn(1, 3, 4, 4),))
-    with pytest.raises((UnsupportedAtenOp, NotImplementedError)):
-        fn(params, jnp.zeros((1, 3, 4, 4)))
+    assert_matches_torch(TConv(), (torch.randn(1, 3, 4, 4),))
 
 
 @pytest.mark.world_8
@@ -233,17 +229,12 @@ def test_max_pool2d_dilation():
     assert_matches_torch(DilatedPoolNet(), (torch.randn(2, 3, 8, 8),))
 
 
-def test_max_pool2d_ceil_mode_raises():
-    from easydist_tpu.torchfront.convert import UnsupportedAtenOp
-
+def test_max_pool2d_ceil_mode_basic():
     class CeilPool(nn.Module):
         def forward(self, x):
             return torch.nn.functional.max_pool2d(x, 2, ceil_mode=True)
 
-    x = torch.randn(2, 3, 7, 7)
-    fn, params = torch_module_to_jax(CeilPool(), (x,))
-    with pytest.raises(UnsupportedAtenOp):
-        fn(params, jnp.asarray(x.numpy()))
+    assert_matches_torch(CeilPool(), (torch.randn(2, 3, 7, 7),))
 
 
 def test_group_norm_bias_without_weight():
@@ -257,3 +248,42 @@ def test_chunk_zero_size_dim():
             return sum(c.sum() for c in chunks)
 
     assert_matches_torch(ZeroChunk(), (torch.zeros(2, 0),))
+
+
+@pytest.mark.parametrize("groups,stride,pad,outpad", [
+    (1, 2, 0, 0), (1, 2, 1, 1), (2, 3, 1, 0), (4, 2, 2, 1)])
+def test_conv_transpose2d_matches_torch(groups, stride, pad, outpad):
+    class TC(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.tc = nn.ConvTranspose2d(8, 8, 3, stride=stride,
+                                         padding=pad,
+                                         output_padding=outpad,
+                                         groups=groups)
+
+        def forward(self, x):
+            return self.tc(x)
+
+    assert_matches_torch(TC(), (torch.randn(2, 8, 6, 6),))
+
+
+@pytest.mark.parametrize("n,stride,pad", [(7, 2, 0), (7, 2, 1), (9, 3, 1),
+                                          (8, 3, 0)])
+def test_max_pool2d_ceil_mode_matches_torch(n, stride, pad):
+    class CeilPool(nn.Module):
+        def forward(self, x):
+            return torch.nn.functional.max_pool2d(
+                x, 3, stride=stride, padding=pad, ceil_mode=True)
+
+    assert_matches_torch(CeilPool(), (torch.randn(2, 3, n, n),))
+
+
+def test_advanced_indexing_matches_torch():
+    class Indexer(nn.Module):
+        def forward(self, x, rows, cols):
+            return x[rows, cols].sum() + torch.index_select(x, 1, cols).sum()
+
+    x = torch.randn(6, 6)
+    rows = torch.tensor([0, 2, 4])
+    cols = torch.tensor([1, 3, 5])
+    assert_matches_torch(Indexer(), (x, rows, cols))
