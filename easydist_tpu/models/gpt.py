@@ -195,9 +195,6 @@ def make_gpt_pipeline_step(cfg: GPTConfig, mesh, n_microbatches: int,
                                        spmd_pipeline_grad)
 
     n_stages = mesh.shape[axis]
-    if n_virtual > 1 and schedule != "1f1b":
-        raise ValueError("n_virtual > 1 requires schedule='1f1b' "
-                         "(interleaving is a 1F1B schedule property)")
     n_chunks = n_stages * max(1, n_virtual)
     if cfg.layers % n_chunks != 0:
         raise ValueError(f"layers {cfg.layers} not divisible by "

@@ -45,10 +45,11 @@ class PipelineConfig:
     # and/or stage params over a tensor axis, all inside the same program
     data_axis: Optional[str] = None  # shards microbatches' batch dim
     param_spec: Optional[object] = None  # extra PartitionSpec tail for params
-    # virtual stages per device (interleaved 1F1B, Megatron-style): the
-    # model is split into n_virtual * n_stages chunks; chunk j runs on
-    # device j % n_stages.  Shrinks the pipeline bubble ~1/n_virtual.
-    # Only used by spmd_pipeline_grad with schedule="1f1b".
+    # virtual stages per device (interleaved, Megatron-style): the model
+    # is split into n_virtual * n_stages chunks; chunk j runs on device
+    # j % n_stages and stage_params carry a LEADING DIM of
+    # n_virtual * n_stages.  Shrinks the pipeline bubble ~1/n_virtual.
+    # Used by spmd_pipeline (forward) and spmd_pipeline_grad ("1f1b").
     n_virtual: int = 1
 
 
@@ -72,7 +73,8 @@ def _stage_param_specs(stage_params, config: PipelineConfig, axis: str):
 def spmd_pipeline(stage_fn: Callable, mesh, config: PipelineConfig):
     """Build fn(stage_params, microbatches) -> outputs.
 
-    stage_params: pytree with leading dim n_stages (sharded over `pp`).
+    stage_params: pytree with leading dim n_stages (or
+    n_virtual * n_stages when interleaving; sharded over `pp`).
     microbatches: [n_microbatches, microbatch..., features] (replicated).
     Returns outputs of the last stage, same leading microbatch layout,
     replicated across the pp axis.
@@ -87,6 +89,8 @@ def spmd_pipeline(stage_fn: Callable, mesh, config: PipelineConfig):
     body = stage_fn
     if config.schedule == "remat":
         body = jax.checkpoint(stage_fn)
+    if config.n_virtual > 1:
+        return _interleaved_forward(body, mesh, config)
 
     def pipelined(stage_params, microbatches):
         # stage-stacked params shard their leading dim over pp (optionally
@@ -139,6 +143,60 @@ def spmd_pipeline(stage_fn: Callable, mesh, config: PipelineConfig):
 def stack_stage_params(per_stage_params):
     """[pytree per stage] -> single pytree with leading stage dim."""
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def _interleaved_forward(body, mesh, config: PipelineConfig):
+    """Forward pipeline with V interleaved virtual chunks per device
+    (chunk j on device j % S): the fwd half of the 1F1B supertick tables,
+    shrinking the fill bubble ~1/V for inference pipelines."""
+    S, M, V = config.n_stages, config.n_microbatches, config.n_virtual
+    axis = config.axis_name
+    tables = _1f1b_schedule_tables(S, V, M, fwd_only=True)
+    U = tables["n_fwd_superticks"]
+
+    def pipelined(stage_params, microbatches):
+        vparams = jax.tree_util.tree_map(
+            lambda p: p.reshape((V, S) + p.shape[1:]), stage_params)
+        base_specs = _stage_param_specs(stage_params, config, axis)
+        vspecs = jax.tree_util.tree_map(
+            lambda sp: P(None, *tuple(sp)), base_specs,
+            is_leaf=lambda x: isinstance(x, P))
+        data_spec = P(None, config.data_axis) if config.data_axis else P()
+
+        @functools.partial(shard_map, mesh=mesh,
+                           in_specs=(vspecs, data_spec),
+                           out_specs=data_spec, check_vma=False)
+        def run(params, x_mb):
+            tree = jax.tree_util
+            s = jax.lax.axis_index(axis)
+            local = tree.tree_map(lambda p: p[:, 0], params)  # [V, ...]
+            MF, KF, FOK = (jnp.asarray(tables[k]) for k in
+                           ("m_f", "k_f", "f_ok"))
+            out0 = jnp.zeros_like(x_mb)
+            zero_mb = jnp.zeros(x_mb.shape[1:], x_mb.dtype)
+
+            def tick(carry, u):
+                act_in, outputs = carry
+                m_f, k_f, f_ok = MF[u, s], KF[u, s], FOK[u, s]
+                local_f = tree.tree_map(lambda p: p[k_f], local)
+                inp = jnp.where((s == 0) & (k_f == 0), x_mb[m_f], act_in)
+                y = body(local_f, inp)
+                emit = (s == S - 1) & (k_f == V - 1) & f_ok
+                outputs = outputs.at[m_f].set(
+                    jnp.where(emit, y, outputs[m_f]))
+                act_out = jax.lax.ppermute(
+                    y, axis, [(i, (i + 1) % S) for i in range(S)])
+                return (act_out, outputs), None
+
+            (_, outputs), _ = jax.lax.scan(tick, (zero_mb, out0),
+                                           jnp.arange(U))
+            return jax.lax.psum(
+                jnp.where(s == S - 1, outputs, jnp.zeros_like(outputs)),
+                axis)
+
+        return run(vparams, microbatches)
+
+    return pipelined
 
 
 def spmd_pipeline_grad(stage_fn: Callable, loss_fn: Callable, mesh,
@@ -363,7 +421,8 @@ def spmd_pipeline_grad(stage_fn: Callable, loss_fn: Callable, mesh,
     return pipelined
 
 
-def _1f1b_schedule_tables(S: int, V: int, M: int):
+def _1f1b_schedule_tables(S: int, V: int, M: int,
+                          fwd_only: bool = False):
     """Host-side supertick schedule for (interleaved) 1F1B.
 
     Global stage j = k*S + s (chunk k on device s), J = V*S stages.
@@ -388,7 +447,7 @@ def _1f1b_schedule_tables(S: int, V: int, M: int):
     def u_b(j, m):
         return (2 * J - 2 - j) + (m % S) + (m // S) * stride
 
-    U = u_b(0, M - 1) + 1
+    U = u_f(J - 1, M - 1) + 1 if fwd_only else u_b(0, M - 1) + 1
     m_f = np.zeros((U, S), np.int32)
     k_f = np.zeros((U, S), np.int32)
     f_ok = np.zeros((U, S), bool)
@@ -400,11 +459,16 @@ def _1f1b_schedule_tables(S: int, V: int, M: int):
         for k in range(V):
             j = k * S + s
             for m in range(M):
-                uf, ub = u_f(j, m), u_b(j, m)
+                uf = u_f(j, m)
                 assert not f_ok[uf, s], "fwd slot conflict"
-                assert not b_ok[ub, s], "bwd slot conflict"
                 m_f[uf, s], k_f[uf, s], f_ok[uf, s] = m, k, True
+                if fwd_only:
+                    continue
+                ub = u_b(j, m)
+                assert not b_ok[ub, s], "bwd slot conflict"
                 m_b[ub, s], k_b[ub, s], b_ok[ub, s] = m, k, True
+            if fwd_only:
+                continue
             # max in-flight microbatches for this (device, chunk): FIFO, so
             # the live set is a contiguous m-window and `m % ring` is unique
             live = max(
@@ -413,4 +477,5 @@ def _1f1b_schedule_tables(S: int, V: int, M: int):
             ring = max(ring, live)
     return {"m_f": m_f, "k_f": k_f, "f_ok": f_ok,
             "m_b": m_b, "k_b": k_b, "b_ok": b_ok,
-            "n_superticks": U, "ring": ring}
+            "n_superticks": U, "n_fwd_superticks": U if fwd_only else None,
+            "ring": ring}

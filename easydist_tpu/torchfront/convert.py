@@ -802,21 +802,31 @@ def _elu(x, alpha=1.0, scale=1.0, input_scale=1.0):
 @register_aten("aten.avg_pool2d.default")
 def _avg_pool2d(x, kernel, stride=None, padding=(0, 0), ceil_mode=False,
                 count_include_pad=True, divisor_override=None):
-    if ceil_mode or divisor_override is not None:
-        raise UnsupportedAtenOp("avg_pool2d with ceil_mode/divisor_override")
-    if isinstance(kernel, int):
-        kernel = (kernel, kernel)
-    stride = stride or kernel
-    if isinstance(stride, int):
-        stride = (stride, stride)
-    if isinstance(padding, int):
-        padding = (padding, padding)
+    if divisor_override is not None:
+        raise UnsupportedAtenOp("avg_pool2d with divisor_override")
+    kernel = _pair(kernel)
+    stride = _pair(stride or kernel)
+    padding = _pair(padding)
     window = (1, 1) + tuple(kernel)
     strides = (1, 1) + tuple(stride)
-    pads = [(0, 0), (0, 0)] + [(p, p) for p in padding]
-    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
+    extra = [(_ceil_extra(n, k, s, p, 1) if ceil_mode else 0)
+             for n, k, s, p in zip(x.shape[2:], kernel, stride, padding)]
+    pads = [(0, 0), (0, 0)] + [(p, p + e) for p, e in zip(padding, extra)]
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides,
+                                   pads)
     if count_include_pad:
-        return summed / (kernel[0] * kernel[1])
+        if not ceil_mode:
+            return summed / (kernel[0] * kernel[1])
+        # explicit padding counts toward the divisor; the implicit ceil
+        # extension never does (torch semantics): count ones over the
+        # explicitly-padded input with only the ceil extension as zero-pad
+        xp_ones = jnp.pad(jnp.ones_like(x),
+                          [(0, 0), (0, 0)] + [(p, p) for p in padding],
+                          constant_values=1.0)
+        counts = jax.lax.reduce_window(
+            xp_ones, 0.0, jax.lax.add, window, strides,
+            [(0, 0), (0, 0)] + [(0, e) for e in extra])
+        return summed / counts
     counts = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
                                    window, strides, pads)
     return summed / counts

@@ -227,12 +227,26 @@ def test_gpt_1f1b_hybrid_pp_dp_matches_plain(cpu_devices):
     _tree_allclose(new_params, ref_params, rtol=1e-3, atol=1e-5)
 
 
-def test_gpt_pipeline_rejects_virtual_without_1f1b(cpu_devices):
+def test_gpt_gpipe_interleaved_matches_plain(cpu_devices):
+    """gpipe + n_virtual: the interleaved forward pipeline differentiates
+    through the scan, so even the gpipe-grad path interleaves."""
     from easydist_tpu.jaxfront import make_device_mesh
     from easydist_tpu.models.gpt import make_gpt_pipeline_step
 
     mesh = make_device_mesh((4,), ("pp",), devices=cpu_devices[:4])
-    with pytest.raises(ValueError, match="n_virtual"):
-        make_gpt_pipeline_step(GPTConfig.tiny(layers=8), mesh,
-                               n_microbatches=4, schedule="gpipe",
-                               n_virtual=2)
+    cfg = GPTConfig.tiny(layers=8)
+    M, mb = 4, 2
+    step, init = make_gpt_pipeline_step(cfg, mesh, n_microbatches=M,
+                                        schedule="gpipe", n_virtual=2)
+    state = init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (M, mb, cfg.seq), 0,
+                                cfg.vocab)
+    (new_params, _), loss = jax.jit(step)(state, tokens, tokens)
+
+    plain_step, plain_init = make_gpt_train_step(cfg, lr=1e-4)
+    plain_state = plain_init(jax.random.PRNGKey(0))
+    merged = tokens.reshape(M * mb, cfg.seq)
+    (ref_params, _), ref_loss = plain_step(plain_state, merged, merged)
+    np.testing.assert_allclose(float(loss), float(ref_loss),
+                               rtol=1e-4, atol=1e-6)
+    _tree_allclose(new_params, ref_params, rtol=1e-3, atol=1e-5)

@@ -275,3 +275,19 @@ def test_interleaved_1f1b_matches_sequential(mesh_pp, M):
                     jax.tree_util.tree_leaves(want_grads)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.world_8
+@pytest.mark.parametrize("M", [8, 10])
+def test_interleaved_forward_pipeline(mesh_pp, M):
+    """Forward-only interleaved pipeline: 8 chunks on 4 devices."""
+    S, V, mb, d = 4, 2, 4, 16
+    stages = make_stages(jax.random.PRNGKey(30), S * V, d)
+    x = jax.random.normal(jax.random.PRNGKey(31), (M, mb, d))
+    stacked = stack_stage_params(stages)
+    pipe = jax.jit(spmd_pipeline(
+        stage_fn, mesh_pp, PipelineConfig(S, M, n_virtual=V)))
+    got = pipe(stacked, x)
+    want = sequential(stages, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)

@@ -287,3 +287,14 @@ def test_advanced_indexing_matches_torch():
     rows = torch.tensor([0, 2, 4])
     cols = torch.tensor([1, 3, 5])
     assert_matches_torch(Indexer(), (x, rows, cols))
+
+
+@pytest.mark.parametrize("include_pad", [True, False])
+def test_avg_pool2d_ceil_mode_matches_torch(include_pad):
+    class CeilAvg(nn.Module):
+        def forward(self, x):
+            return torch.nn.functional.avg_pool2d(
+                x, 3, stride=2, padding=1, ceil_mode=True,
+                count_include_pad=include_pad)
+
+    assert_matches_torch(CeilAvg(), (torch.randn(2, 3, 7, 7),))
