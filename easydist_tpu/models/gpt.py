@@ -182,10 +182,10 @@ def make_gpt_pipeline_step(cfg: GPTConfig, mesh, n_microbatches: int,
     the pipelined middle (reference scenario: benchmark/torch/pp/gpt).
 
     schedule="gpipe"/"remat" differentiates through the forward pipeline;
-    schedule="1f1b" (optionally with n_virtual>1 interleaved chunks) runs
-    the DAPPLE-class supertick schedule with O(n_stages) live microbatches,
-    backpropagating into the embedding and head via the pipeline's aux
-    input/head gradients.
+    schedule="1f1b" runs the DAPPLE-class supertick schedule with
+    O(n_stages) live microbatches, backpropagating into the embedding and
+    head via the pipeline's aux input/head gradients.  n_virtual>1
+    interleaves virtual stage chunks under ANY schedule.
 
     Requires cfg.layers % (n_stages * n_virtual) == 0.  Returns
     (train_step, init_state): state = (params, opt); train_step(state,

@@ -145,6 +145,19 @@ def stack_stage_params(per_stage_params):
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_stage_params)
 
 
+def _virtual_params_and_specs(stage_params, config, axis, V, S):
+    """[V*S, ...] stage params regrouped to [V, S, ...] with specs sharding
+    the S dim over pp (shared by the interleaved forward and 1F1B paths)."""
+    vparams = jax.tree_util.tree_map(
+        lambda p: p.reshape((V, S) + p.shape[1:]), stage_params)
+    base_specs = _stage_param_specs(stage_params, config, axis)
+    vspecs = jax.tree_util.tree_map(
+        lambda sp: P(None, *tuple(sp)), base_specs,
+        is_leaf=lambda x: isinstance(x, P))
+    data_spec = P(None, config.data_axis) if config.data_axis else P()
+    return vparams, vspecs, data_spec
+
+
 def _interleaved_forward(body, mesh, config: PipelineConfig):
     """Forward pipeline with V interleaved virtual chunks per device
     (chunk j on device j % S): the fwd half of the 1F1B supertick tables,
@@ -152,16 +165,11 @@ def _interleaved_forward(body, mesh, config: PipelineConfig):
     S, M, V = config.n_stages, config.n_microbatches, config.n_virtual
     axis = config.axis_name
     tables = _1f1b_schedule_tables(S, V, M, fwd_only=True)
-    U = tables["n_fwd_superticks"]
+    U = tables["n_superticks"]
 
     def pipelined(stage_params, microbatches):
-        vparams = jax.tree_util.tree_map(
-            lambda p: p.reshape((V, S) + p.shape[1:]), stage_params)
-        base_specs = _stage_param_specs(stage_params, config, axis)
-        vspecs = jax.tree_util.tree_map(
-            lambda sp: P(None, *tuple(sp)), base_specs,
-            is_leaf=lambda x: isinstance(x, P))
-        data_spec = P(None, config.data_axis) if config.data_axis else P()
+        vparams, vspecs, data_spec = _virtual_params_and_specs(
+            stage_params, config, axis, V, S)
 
         @functools.partial(shard_map, mesh=mesh,
                            in_specs=(vspecs, data_spec),
@@ -278,13 +286,8 @@ def spmd_pipeline_grad(stage_fn: Callable, loss_fn: Callable, mesh,
         lp_in = loss_params if aux else ()
         # stage-stacked params [V*S, ...] regrouped to [V, S, ...]: chunk k
         # of device s is global stage k*S + s
-        vparams = jax.tree_util.tree_map(
-            lambda p: p.reshape((V, S) + p.shape[1:]), stage_params)
-        base_specs = _stage_param_specs(stage_params, config, axis)
-        vspecs = jax.tree_util.tree_map(
-            lambda sp: P(None, *tuple(sp)), base_specs,
-            is_leaf=lambda x: isinstance(x, P))
-        data_spec = P(None, config.data_axis) if config.data_axis else P()
+        vparams, vspecs, data_spec = _virtual_params_and_specs(
+            stage_params, config, axis, V, S)
 
         @functools.partial(shard_map, mesh=mesh,
                            in_specs=(vspecs, data_spec, data_spec, P()),
@@ -477,5 +480,4 @@ def _1f1b_schedule_tables(S: int, V: int, M: int,
             ring = max(ring, live)
     return {"m_f": m_f, "k_f": k_f, "f_ok": f_ok,
             "m_b": m_b, "k_b": k_b, "b_ok": b_ok,
-            "n_superticks": U, "n_fwd_superticks": U if fwd_only else None,
-            "ring": ring}
+            "n_superticks": U, "ring": ring}

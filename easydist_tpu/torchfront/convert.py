@@ -366,9 +366,20 @@ def _max_pool2d(x, kernel, stride=None, padding=(0, 0), dilation=(1, 1),
 
 @register_aten("aten.adaptive_avg_pool2d.default")
 def _adaptive_avg_pool2d(x, output_size):
-    if tuple(output_size) == (1, 1):
+    out = _pair(tuple(output_size))
+    if out == (1, 1):
         return x.mean(axis=(2, 3), keepdims=True)
-    raise UnsupportedAtenOp("adaptive_avg_pool2d with output != 1x1")
+    if all(n % o == 0 for n, o in zip(x.shape[2:], out)):
+        # evenly-divisible case: non-overlapping kernel = stride = n/o
+        # (torch uses the same fixed windows here)
+        kh, kw = x.shape[2] // out[0], x.shape[3] // out[1]
+        summed = jax.lax.reduce_window(
+            x, 0.0, jax.lax.add, (1, 1, kh, kw), (1, 1, kh, kw),
+            [(0, 0)] * 4)
+        return summed / (kh * kw)
+    raise UnsupportedAtenOp(
+        "adaptive_avg_pool2d with non-divisible output size "
+        "(variable window sizes)")
 
 
 @register_aten("aten.mean.dim")
@@ -820,9 +831,9 @@ def _avg_pool2d(x, kernel, stride=None, padding=(0, 0), ceil_mode=False,
         # explicit padding counts toward the divisor; the implicit ceil
         # extension never does (torch semantics): count ones over the
         # explicitly-padded input with only the ceil extension as zero-pad
-        xp_ones = jnp.pad(jnp.ones_like(x),
-                          [(0, 0), (0, 0)] + [(p, p) for p in padding],
-                          constant_values=1.0)
+        xp_ones = jnp.ones(
+            x.shape[:2] + tuple(n + 2 * p for n, p in
+                                zip(x.shape[2:], padding)), x.dtype)
         counts = jax.lax.reduce_window(
             xp_ones, 0.0, jax.lax.add, window, strides,
             [(0, 0), (0, 0)] + [(0, e) for e in extra])

@@ -298,3 +298,11 @@ def test_avg_pool2d_ceil_mode_matches_torch(include_pad):
                 count_include_pad=include_pad)
 
     assert_matches_torch(CeilAvg(), (torch.randn(2, 3, 7, 7),))
+
+
+def test_adaptive_avg_pool2d_divisible():
+    class Ada(nn.Module):
+        def forward(self, x):
+            return torch.nn.functional.adaptive_avg_pool2d(x, (4, 2))
+
+    assert_matches_torch(Ada(), (torch.randn(2, 3, 8, 8),))
