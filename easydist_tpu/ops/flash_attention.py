@@ -1,14 +1,18 @@
 """Flash attention as Pallas TPU kernels (forward AND fused backward).
 
-Blockwise online-softmax attention: Q blocks stream over the grid, K/V live
-in VMEM per (batch*head) program, statistics (running max / denominator)
-stay in f32 scratch.  O(seq) memory instead of materializing the [T, T]
-score matrix; MXU-shaped matmul blocks.
+Blockwise online-softmax attention with **streamed K/V**: the K/V (and in
+the dK/dV pass, Q/dO) blocks ride the innermost grid dimension, so VMEM
+residency per program is O(block) — independent of sequence length — and
+long-context (8k-32k) sequences fit the ~16 MB VMEM budget.  Statistics
+(running max / denominator) and the output accumulator persist in f32 VMEM
+scratch across the innermost grid steps (TPU grids iterate sequentially, so
+scratch carries between iterations; ``@pl.when(ki == 0)`` initialises,
+``@pl.when(ki == last)`` writes out).
 
 The backward is the FlashAttention-2 recipe: the forward saves the per-row
-logsumexp, `delta = rowsum(dO * O)` is precomputed, then two kernels stream
-blocks — dQ over Q-blocks (K/V resident), dK/dV over K-blocks (Q/dO
-resident) — recomputing P = exp(S - lse) per block.  No [T, T] residual
+logsumexp, ``delta = rowsum(dO * O)`` is precomputed in XLA, then two
+kernels stream blocks — dQ accumulates over K-blocks, dK/dV accumulate over
+Q-blocks — recomputing ``P = exp(S - lse)`` per block.  No [T, T] residual
 survives the forward.  The ring variant composes this kernel with the
 ppermute loop in parallel/ring_attention.py.
 
@@ -25,50 +29,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
-
-
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
-                  seq_k: int, causal: bool, scale: float, q_block: int):
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
-    bq, d = q.shape
-
-    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    o0 = jnp.zeros((bq, d), jnp.float32)
-
-    q_pos = qi * q_block + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-
-    def body(kb, carry):
-        o_acc, m_acc, l_acc = carry
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = q @ k_blk.T  # [bq, block_k]
-        if causal:
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
-        m_new = jnp.maximum(m_acc, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m_acc - m_new)
-        l_new = l_acc * alpha + jnp.sum(p, axis=-1)
-        o_new = o_acc * alpha[:, None] + p @ v_blk
-        return o_new, m_new, l_new
-
-    n_kb = seq_k // block_k
-    if causal:
-        # blocks fully above the diagonal contribute nothing; bound the loop
-        # at the q block's last row
-        n_kb_eff = jnp.minimum(n_kb, (qi + 1) * q_block // block_k
-                               + (1 if q_block % block_k else 0))
-    else:
-        n_kb_eff = n_kb
-    o_acc, m_acc, l_acc = jax.lax.fori_loop(0, n_kb_eff, body, (o0, m0, l0))
-    l_safe = jnp.maximum(l_acc, 1e-30)
-    o_ref[0] = (o_acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = (m_acc + jnp.log(l_safe)).astype(jnp.float32)
 
 
 def _pick_block(block: int, t: int) -> int:
@@ -78,113 +41,169 @@ def _pick_block(block: int, t: int) -> int:
     return max(b, 1)
 
 
+def _causal_mask(s, qi, ki, block_q, block_k):
+    bq, bk = s.shape
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(k_pos <= q_pos, s, _NEG_INF)
+
+
+# ---------------------------------------------------------------- forward
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, o_scr, m_scr, l_scr,
+                  *, causal: bool, scale: float, block_q: int, block_k: int,
+                  n_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        o_scr[...] = jnp.zeros_like(o_scr)
+
+    # a K block strictly above the diagonal contributes nothing
+    run = (ki * block_k <= (qi + 1) * block_q - 1) if causal else (ki >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale        # [bq, d]
+        k_blk = k_ref[0].astype(jnp.float32)            # [bk, d]
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = q @ k_blk.T                                 # [bq, bk]
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        m_prev = m_scr[...]                             # [bq, 1]
+        l_prev = l_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)                 # [bq, 1]
+        m_scr[...] = m_new
+        l_scr[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        o_scr[...] = o_scr[...] * alpha + p @ v_blk
+
+    @pl.when(ki == n_k - 1)
+    def _write():
+        l_safe = jnp.maximum(l_scr[...], 1e-30)         # [bq, 1]
+        o_ref[0] = (o_scr[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[...] + jnp.log(l_safe)).astype(jnp.float32)
+
+
 def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
                    block_k: int, interpret: bool):
     b, h, t_q, d = q.shape
     t_k = k.shape[2]
     bq = _pick_block(block_q, t_q)
     bk = _pick_block(block_k, t_k)
+    n_q, n_k = t_q // bq, t_k // bk
 
     qf = q.reshape(b * h, t_q, d)
     kf = k.reshape(b * h, t_k, d)
     vf = v.reshape(b * h, t_k, d)
 
-    kernel = functools.partial(_flash_kernel, block_k=bk, seq_k=t_k,
-                               causal=causal, scale=scale, q_block=bq)
+    kernel = functools.partial(_flash_kernel, causal=causal, scale=scale,
+                               block_q=bq, block_k=bk, n_k=n_k)
     out, lse = pl.pallas_call(
         kernel,
-        grid=(b * h, t_q // bq),
+        grid=(b * h, n_q, n_k),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, t_k, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, t_k, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, bq), lambda bh, qi: (bh, qi)),
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, t_q, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, t_q), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, t_q, 1), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, t_q, d), lse
+    return out.reshape(b, h, t_q, d), lse.reshape(b * h, t_q)
+
+
+# --------------------------------------------------------------- backward
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, *, block_k: int, seq_k: int, causal: bool,
-                         scale: float, q_block: int):
+                         dq_ref, dq_scr, *, causal: bool, scale: float,
+                         block_q: int, block_k: int, n_k: int):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0].astype(jnp.float32)
-    delta = delta_ref[0].astype(jnp.float32)
-    bq, d = q.shape
-    q_pos = qi * q_block + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    ki = pl.program_id(2)
 
-    def body(kb, dq_acc):
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    run = (ki * block_k <= (qi + 1) * block_q - 1) if causal else (ki >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0].astype(jnp.float32)            # [bq, 1]
+        delta = delta_ref[0].astype(jnp.float32)        # [bq, 1]
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
         s = q @ k_blk.T
         if causal:
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])  # masked entries: exp(-inf) = 0
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse)  # masked entries: exp(-inf) = 0
         dp = do @ v_blk.T
-        ds = p * (dp - delta[:, None])
-        return dq_acc + ds @ k_blk
+        ds = p * (dp - delta)
+        dq_scr[...] = dq_scr[...] + ds @ k_blk
 
-    n_kb = seq_k // block_k
-    if causal:
-        n_kb_eff = jnp.minimum(n_kb, (qi + 1) * q_block // block_k
-                               + (1 if q_block % block_k else 0))
-    else:
-        n_kb_eff = n_kb
-    dq0 = jnp.zeros((bq, d), jnp.float32)
-    dq_acc = jax.lax.fori_loop(0, n_kb_eff, body, dq0)
-    dq_ref[0] = (dq_acc * scale).astype(dq_ref.dtype)
+    @pl.when(ki == n_k - 1)
+    def _write():
+        dq_ref[0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, *, block_q: int, seq_q: int,
-                          causal: bool, scale: float, k_block: int):
+                          dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
+                          scale: float, block_q: int, block_k: int,
+                          n_q: int):
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    bk, d = k.shape
-    k_pos = ki * k_block + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+    qb = pl.program_id(2)
 
-    def body(qb, carry):
-        dk_acc, dv_acc = carry
-        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse_blk = lse_ref[0, pl.ds(qb * block_q, block_q)].astype(jnp.float32)
-        delta_blk = delta_ref[0, pl.ds(qb * block_q, block_q)] \
-            .astype(jnp.float32)
-        s = (q_blk * scale) @ k.T  # [block_q, bk]
+    @pl.when(qb == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    # a Q block strictly above this K block's first row is fully masked
+    run = ((qb + 1) * block_q - 1 >= ki * block_k) if causal else (qb >= 0)
+
+    @pl.when(run)
+    def _compute():
+        k = k_ref[0].astype(jnp.float32)                # [bk, d]
+        v = v_ref[0].astype(jnp.float32)
+        q_blk = q_ref[0].astype(jnp.float32)            # [bq, d]
+        do_blk = do_ref[0].astype(jnp.float32)
+        lse_blk = lse_ref[0].astype(jnp.float32)        # [bq, 1]
+        delta_blk = delta_ref[0].astype(jnp.float32)
+        s = (q_blk * scale) @ k.T                       # [bq, bk]
         if causal:
-            q_pos = qb * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 0)
-            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse_blk[:, None])
-        dv_acc = dv_acc + p.T @ do_blk
+            s = _causal_mask(s, qb, ki, block_q, block_k)
+        p = jnp.exp(s - lse_blk)
+        dv_scr[...] = dv_scr[...] + p.T @ do_blk
         dp = do_blk @ v.T
-        ds = p * (dp - delta_blk[:, None])
-        dk_acc = dk_acc + (ds.T @ q_blk) * scale
-        return dk_acc, dv_acc
+        ds = p * (dp - delta_blk)
+        dk_scr[...] = dk_scr[...] + (ds.T @ q_blk) * scale
 
-    n_qb = seq_q // block_q
-    if causal:
-        # q blocks strictly above this k block's first row are fully masked
-        start = (ki * k_block) // block_q
-    else:
-        start = 0
-    zeros = jnp.zeros((bk, d), jnp.float32)
-    dk_acc, dv_acc = jax.lax.fori_loop(start, n_qb, body, (zeros, zeros))
-    dk_ref[0] = dk_acc.astype(dk_ref.dtype)
-    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+    @pl.when(qb == n_q - 1)
+    def _write():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _flash_backward(q, k, v, o, lse, g, causal: bool, scale: float,
@@ -194,6 +213,7 @@ def _flash_backward(q, k, v, o, lse, g, causal: bool, scale: float,
     t_k = k.shape[2]
     bq = _pick_block(block_q, t_q)
     bk = _pick_block(block_k, t_k)
+    n_q, n_k = t_q // bq, t_k // bk
 
     qf = q.reshape(b * h, t_q, d)
     kf = k.reshape(b * h, t_k, d)
@@ -205,53 +225,87 @@ def _flash_backward(q, k, v, o, lse, g, causal: bool, scale: float,
     delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
     if g_lse is not None:
         delta = delta - g_lse.reshape(b * h, t_q).astype(jnp.float32)
+    # trailing singleton keeps lse/delta sublane-major inside the kernels
+    # (a [bq]-lane -> [bq, 1]-sublane reshape is a transpose Mosaic hates)
+    lse3 = lse.reshape(b * h, t_q, 1)
+    delta3 = delta.reshape(b * h, t_q, 1)
 
-    dq_kernel = functools.partial(_flash_bwd_dq_kernel, block_k=bk,
-                                  seq_k=t_k, causal=causal, scale=scale,
-                                  q_block=bq)
+    dq_kernel = functools.partial(_flash_bwd_dq_kernel, causal=causal,
+                                  scale=scale, block_q=bq, block_k=bk,
+                                  n_k=n_k)
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(b * h, t_q // bq),
+        grid=(b * h, n_q, n_k),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, t_k, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, t_k, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, bq), lambda bh, qi: (bh, qi)),
-            pl.BlockSpec((1, bq), lambda bh, qi: (bh, qi)),
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, t_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qf, kf, vf, dof, lse, delta)
+    )(qf, kf, vf, dof, lse3, delta3)
 
-    dkv_kernel = functools.partial(_flash_bwd_dkv_kernel, block_q=bq,
-                                   seq_q=t_q, causal=causal, scale=scale,
-                                   k_block=bk)
+    dkv_kernel = functools.partial(_flash_bwd_dkv_kernel, causal=causal,
+                                   scale=scale, block_q=bq, block_k=bk,
+                                   n_q=n_q)
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(b * h, t_k // bk),
+        grid=(b * h, n_k, n_q),
         in_specs=[
-            pl.BlockSpec((1, t_q, d), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, t_q, d), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, t_q), lambda bh, ki: (bh, 0)),
-            pl.BlockSpec((1, t_q), lambda bh, ki: (bh, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, ki, qb: (bh, qb, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qb: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qb: (bh, ki, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, ki, qb: (bh, qb, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, ki, qb: (bh, qb, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, ki, qb: (bh, qb, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qb: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qb: (bh, ki, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, t_k, d), k.dtype),
             jax.ShapeDtypeStruct((b * h, t_k, d), v.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qf, kf, vf, dof, lse, delta)
+    )(qf, kf, vf, dof, lse3, delta3)
 
     return (dq.reshape(b, h, t_q, d), dk.reshape(b, h, t_k, d),
             dv.reshape(b, h, t_k, d))
+
+
+def estimate_vmem_bytes(t_q: int, t_k: int, d: int, block_q: int = 256,
+                        block_k: int = 256) -> int:
+    """Worst-case per-program VMEM residency across the three kernels
+    (blocks + f32 scratch), double-buffered DMA included.  Sequence-length
+    independent by construction — the long-context guarantee."""
+    bq = _pick_block(block_q, t_q)
+    bk = _pick_block(block_k, t_k)
+    f32 = 4
+
+    def dbl(*block_bytes):  # pallas double-buffers streamed blocks
+        return 2 * sum(block_bytes)
+
+    fwd = dbl(bq * d * f32, 2 * bk * d * f32, bq * d * f32, bq * f32) \
+        + (bq * d + 2 * bq) * f32
+    dq = dbl(bq * d * f32 * 2, 2 * bk * d * f32, 2 * bq * f32,
+             bq * d * f32) + bq * d * f32
+    dkv = dbl(bq * d * f32 * 2, 2 * bk * d * f32, 2 * bq * f32,
+              2 * bk * d * f32) + 2 * bk * d * f32
+    return max(fwd, dq, dkv)
 
 
 def _reference_attention(q, k, v, causal: bool, scale: float):
