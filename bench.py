@@ -170,6 +170,11 @@ def main():
         # easydist against jax.jit of the SAME step.
         variant = "einsum"
         jit_base = jax.jit(step, donate_argnums=(0,))
+        # model-FLOPs source stays the einsum program even if the flash
+        # variant is adopted below: XLA cost_analysis cannot see inside a
+        # Pallas custom call, so the flash jit under-reports FLOPs by the
+        # whole attention share and would deflate MFU
+        flops_jit, flops_fresh = jit_base, fresh
         if on_tpu:
             try:
                 import dataclasses
@@ -218,7 +223,7 @@ def main():
         # model FLOPs per step from XLA's own cost analysis (for MFU)
         flops_per_step = None
         try:
-            ca = jit_base.lower(fresh(), tokens, targets).compile() \
+            ca = flops_jit.lower(flops_fresh(), tokens, targets).compile() \
                 .cost_analysis()
             if isinstance(ca, list):
                 ca = ca[0]

@@ -38,6 +38,27 @@ from .cost_model import MeshAxisSpec, placement_bytes, resharding_cost
 
 logger = logging.getLogger(__name__)
 
+_op_times_cache: Optional[Tuple[Tuple[str, float], Dict[str, float]]] = None
+
+
+def _cached_op_times() -> Dict[str, float]:
+    """PerfDB op-time table, reloaded only when the DB file changes (the
+    solver runs once per mesh axis per compile)."""
+    global _op_times_cache
+    import os
+
+    path = edconfig.prof_db_path
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return {}
+    key = (path, mtime)
+    if _op_times_cache is None or _op_times_cache[0] != key:
+        from easydist_tpu.runtime.op_profile import load_op_times
+
+        _op_times_cache = (key, load_op_times())
+    return _op_times_cache[1]
+
 
 class _Edge:
     """One producer-cluster -> consumer-cluster tensor dependency."""
@@ -126,6 +147,11 @@ class SpmdSolver:
         # term, replicate-everything is a free zero-communication optimum.
         self.output_y_cost: Dict[int, np.ndarray] = {}
         inv_hbm = 1.0 / edconfig.hbm_bandwidth
+        # measured per-op seconds (PerfDB, keyed by the node's signature)
+        # price compute-redundancy exactly; the HBM proxy covers misses
+        # (reference runtime_prof.py:35-150 -> solver costs)
+        op_times = _cached_op_times() if edconfig.use_op_cost_db else {}
+        n_comp = n_hit = 0
         for c in self.clusters:
             costs = None
             for s in range(c.strategy_count()):
@@ -134,18 +160,34 @@ class SpmdSolver:
                     node = c.nodes[uid]
                     if node.is_input:
                         continue
-                    out_bytes = sum(v.size_bytes() for v in node.outvars
-                                    if v is not None)
-                    sharded = any(p is not None and not p.is_replicate()
-                                  for p in strat.out_placements)
+                    measured = op_times.get(node.sig) if node.sig else None
+                    if s == 0:
+                        n_comp += 1
+                        n_hit += measured is not None
+                    if measured is not None:
+                        full_t = measured
+                    else:
+                        full_t = sum(v.size_bytes() for v in node.outvars
+                                     if v is not None) * inv_hbm
+                    # only SHARD splits the compute 1/n: a contracted-dim
+                    # dot (S inputs, P output) works on 1/n slices, but a
+                    # pure P-propagating op (P in -> P out) runs full-shape
+                    # on every rank, same as replicate
+                    sharded = any(
+                        p is not None and p.is_shard()
+                        for p in list(strat.out_placements)
+                        + list(strat.in_placements))
                     factor = (1.0 / self.axis.size) if sharded else 1.0
-                    t += factor * out_bytes * inv_hbm
+                    t += factor * full_t
                 if t > 0.0:
                     if costs is None:
                         costs = np.zeros(c.strategy_count())
                     costs[s] = t
             if costs is not None:
                 self.output_y_cost[c.cid] = costs
+        if op_times and n_comp:
+            logger.info("[SpmdSolver] op-cost DB hit rate %d/%d (%.0f%%)",
+                        n_hit, n_comp, 100.0 * n_hit / n_comp)
         state_outs = set(self.graph.state_io)
         for var in self.graph.outputs:
             if var.name in state_outs or var.producer is None:
@@ -266,7 +308,8 @@ class SpmdSolver:
             logger.exception("ILP solve failed; falling back to beam search")
             return self.beam_search()
 
-    def _ilp_solve(self) -> Dict[str, NodeStrategy]:
+    def _ilp_solve(self, apply_memory_cap: bool = True
+                   ) -> Dict[str, NodeStrategy]:
         start = time.perf_counter()
         rep = self.tie_rep
         rep_clusters = [c for c in self.clusters if rep[c.cid] == c.cid]
@@ -357,7 +400,7 @@ class SpmdSolver:
                 row += 1
 
         # optional hard memory cap per liveness step
-        cap = edconfig.per_device_memory_cap
+        cap = edconfig.per_device_memory_cap if apply_memory_cap else 0
         if cap > 0:
             cap_eff = cap * edconfig.memory_ratio
             producer_cluster = {}
@@ -405,6 +448,17 @@ class SpmdSolver:
                             "mip_rel_gap": edconfig.solver_mip_rel_gap})
         # status 1 = iteration/time limit: keep the incumbent if HiGHS found one
         if res.x is None or res.status not in (0, 1):
+            if apply_memory_cap and edconfig.per_device_memory_cap > 0 \
+                    and res.status == 2:
+                # no sharding assignment satisfies the liveness cap: solve
+                # for minimum communication uncapped — the downstream remat
+                # pass (schedule/remat.py) closes the remaining memory gap
+                logger.warning(
+                    "[SpmdSolver] liveness cap %.2f GiB infeasible on axis "
+                    "%s; re-solving uncapped (auto-remat takes over)",
+                    edconfig.per_device_memory_cap * edconfig.memory_ratio
+                    / 2**30, self.axis.name)
+                return self._ilp_solve(apply_memory_cap=False)
             raise RuntimeError(f"MILP failed: status={res.status} {res.message}")
         logger.info("[SpmdSolver] axis=%s clusters=%d (%d tied) edges=%d "
                     "(%d grouped) vars=%d cost=%.3e time=%.2fs",

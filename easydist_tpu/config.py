@@ -72,9 +72,15 @@ peak_flops = _env_float("EASYDIST_PEAK_FLOPS", 4.9e13)
 # (mem_cost_weight was removed: the solver derives the memory tie-break
 # weight from the comm-cost scale so it can order comm-equal solutions but
 # never flip a comm decision — a fixed weight could do either)
-# hard per-device memory cap in bytes (0 = unconstrained); v5e has 16 GiB HBM
-per_device_memory_cap = _env_int("EASYDIST_MEMORY_CAP", 0)
+# per-device memory cap in bytes: -1 = auto (ask the real device's
+# memory_stats at compile; unknown backends stay uncapped), 0 = off,
+# >0 = explicit cap.  v5e has 16 GiB HBM.
+per_device_memory_cap = _env_int("EASYDIST_MEMORY_CAP", -1)
 memory_ratio = _env_float("EASYDIST_MEMORY_RATIO", 0.9)
+# compiler-chosen rematerialization when the planned peak exceeds the cap
+# (schedule/remat.py); max eqns re-executed per recompute chain
+enable_auto_remat = _env_bool("EASYDIST_AUTO_REMAT", True)
+remat_max_chain_len = _env_int("EASYDIST_REMAT_MAX_CHAIN", 96)
 liveness_only_input = _env_bool("EASYDIST_LIVENESS_ONLY_INPUT", False)
 solver_backend = os.environ.get("EASYDIST_SOLVER", "milp")  # milp | beam
 beam_width = _env_int("EASYDIST_BEAM_WIDTH", 100)
@@ -82,6 +88,10 @@ beam_width = _env_int("EASYDIST_BEAM_WIDTH", 100)
 # collapse to one set of decision variables; solve time for an L-layer stack
 # approaches the 1-layer solve)
 solver_cluster_dedup = _env_bool("EASYDIST_SOLVER_CLUSTER_DEDUP", True)
+# carry PARTIAL placements in the GLOBAL strategy pools so the ILP can
+# defer an all-reduce across linear consumers (reference metair.py:376-481
+# carries partials globally; previously composite-rule inner solves only)
+enable_partial_pools = _env_bool("EASYDIST_PARTIAL_POOLS", True)
 
 # ---------------- mesh / comm cost model ----------------
 # per-axis link bandwidth in bytes/s used to weight collective cost between
@@ -111,3 +121,6 @@ remat_policy = os.environ.get("EASYDIST_REMAT_POLICY", "none")
 # ---------------- profiling / perf db ----------------
 prof_db_path = os.environ.get("EASYDIST_PERF_DB", os.path.expanduser("~/.easydist_tpu/perf.db"))
 enable_runtime_prof = _env_bool("EASYDIST_RUNTIME_PROF", False)
+# price solver compute-redundancy with measured per-op seconds from the
+# PerfDB when available (runtime/op_profile.py); proxy otherwise
+use_op_cost_db = _env_bool("EASYDIST_OP_COST_DB", True)

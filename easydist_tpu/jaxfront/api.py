@@ -105,15 +105,33 @@ def _combined_spec(placements: List[Optional[Placement]],
 
 def emit_sharded_fn(closed_jaxpr, names: VarNames,
                     per_axis: List[Dict[str, NodeStrategy]],
-                    axis_names: Sequence[str], mesh):
+                    axis_names: Sequence[str], mesh, remat_plan=None,
+                    partial_regions=None):
     """Build fn(*flat_args) -> flat_outs replaying the jaxpr with sharding
     constraints on every strategy-carrying equation input
-    (reference add_sharding_jaxpr, jax/api.py:114-170)."""
+    (reference add_sharding_jaxpr, jax/api.py:114-170).
+
+    `remat_plan` (schedule/remat.py) redirects planned far consumers to
+    recomputed values: before such a consumer, its chain equations are
+    re-executed into a shared overlay whose sources pass through
+    `optimization_barrier` (so XLA CSE cannot fold the duplicates back),
+    and overlay entries are dropped after their last planned reader."""
     jaxpr = closed_jaxpr.jaxpr
     consts = closed_jaxpr.consts
+    recompute = remat_plan.recompute if remat_plan else {}
+    overlay_last_use = remat_plan.overlay_last_use if remat_plan else {}
+    region_at = {}  # start eqn idx -> PartialRegion
+    in_region = set()
+    for r in (partial_regions or []):
+        region_at[r.start] = r
+        in_region.update(range(r.start, r.end + 1))
 
     def sharded_fn(*flat_args):
+        from .partial_regions import emit_region
+
         env = {}
+        overlay = {}  # var -> recomputed value (shared across consumers)
+        overlay_evict = {}  # eqn idx at which to drop -> [vars]
 
         def read(v):
             return v.val if isinstance(v, jex_core.Literal) else env[v]
@@ -124,10 +142,48 @@ def emit_sharded_fn(closed_jaxpr, names: VarNames,
             env[var] = val
 
         for idx, eqn in enumerate(jaxpr.eqns):
+            if idx in region_at:
+                # deferred-reduction region: local chain under shard_map
+                # with one psum fence (partial_regions.py)
+                emit_region(region_at[idx], jaxpr, env, mesh)
+            if idx in in_region:
+                continue
+            chain = recompute.get(idx)
+            if chain:
+                for e in chain:
+                    ceqn = jaxpr.eqns[e]
+                    if all(u in overlay for u in ceqn.outvars):
+                        continue
+                    csub, cparams = ceqn.primitive.get_bind_params(
+                        ceqn.params)
+                    cin = []
+                    for u in ceqn.invars:
+                        if isinstance(u, jex_core.Literal):
+                            cin.append(u.val)
+                        elif u in overlay:
+                            cin.append(overlay[u])
+                        else:
+                            val = env[u]
+                            if hasattr(val, "ndim"):
+                                val = jax.lax.optimization_barrier(val)
+                            cin.append(val)
+                    cout = ceqn.primitive.bind(*csub, *cin, **cparams)
+                    if not ceqn.primitive.multiple_results:
+                        cout = [cout]
+                    last = overlay_last_use.get(e, idx)
+                    for u, val in zip(ceqn.outvars, cout):
+                        overlay[u] = val
+                        overlay_evict.setdefault(last, []).append(u)
+
             node_name = f"op{idx}"
             strategies = [chosen.get(node_name) for chosen in per_axis]
             subfuns, bind_params = eqn.primitive.get_bind_params(eqn.params)
-            invals = [read(v) for v in eqn.invars]
+            if chain:
+                invals = [v.val if isinstance(v, jex_core.Literal)
+                          else (overlay[v] if v in overlay else env[v])
+                          for v in eqn.invars]
+            else:
+                invals = [read(v) for v in eqn.invars]
 
             var_pos = 0
             for i, v in enumerate(eqn.invars):
@@ -150,6 +206,8 @@ def emit_sharded_fn(closed_jaxpr, names: VarNames,
                 out = [out]
             for var, val in zip(eqn.outvars, out):
                 env[var] = val
+            for u in overlay_evict.pop(idx, ()):
+                overlay.pop(u, None)
 
         return [read(v) for v in jaxpr.outvars]
 
@@ -172,6 +230,7 @@ def _compile_cache_key(closed_jaxpr, axis_specs) -> str:
         ("ici_bandwidth", "dcn_bandwidth", "ici_latency", "dcn_latency",
          "hbm_bandwidth", "all_to_all_punish_factor",
          "solver_cluster_dedup", "per_device_memory_cap",
+         "enable_partial_pools", "enable_auto_remat",
          "coarsen_level", "enable_graph_coarsen", "predict_comm_overlap",
          "comm_overlap_ratio", "allow_repeated_axis_strategy",
          "solver_backend", "liveness_only_input", "peak_flops"))).encode())
@@ -340,6 +399,14 @@ def solve_axes(closed_jaxpr, axis_specs, world, rules, shape_info, names,
                                    world_size=world, names=names,
                                    var_shapes=dict(var_shapes),
                                    state_io=state_io_names or {})
+        if edconfig.enable_partial_pools:
+            # PARTIAL rides linear op chains in the GLOBAL pools: the ILP
+            # can then pay a cheaper reduce_scatter fence (P->S) or a
+            # single deferred all_reduce instead of one per producer
+            # (reference carries partials globally, metair.py:376-481)
+            from .interpreter import _inject_partial_propagation
+
+            _inject_partial_propagation(graph, axis.size)
 
         def exclude_map(node, _prev=tuple(prev_chosen)):
             if edconfig.allow_repeated_axis_strategy:
@@ -475,6 +542,26 @@ def compile_step(func, args, kwargs, mesh=None, state_io="auto",
                            in_tree, out_tree, state_pairs, donate_state)
 
 
+def _xla_peak_bytes(closed_jaxpr, names, per_axis_final, axis_specs, mesh,
+                    remat_plan=None, partial_regions=None):
+    """Per-device peak of the sharded program as XLA schedules it: temp +
+    argument bytes from memory_analysis (one extra XLA compile; no device
+    execution).  Probes the same emission (regions included) that ships."""
+    try:
+        fn = emit_sharded_fn(closed_jaxpr, names, per_axis_final,
+                             [s.name for s in axis_specs], mesh,
+                             remat_plan=remat_plan,
+                             partial_regions=partial_regions)
+        avals = [jax.ShapeDtypeStruct(v.aval.shape, v.aval.dtype)
+                 for v in closed_jaxpr.jaxpr.invars]
+        ma = jax.jit(fn).lower(*avals).compile().memory_analysis()
+        return int(ma.temp_size_in_bytes + ma.argument_size_in_bytes)
+    except Exception as e:
+        logger.warning("[remat] XLA peak probe failed (%s); trusting the "
+                       "liveness model", e)
+        return None
+
+
 def _finish_compile(closed_jaxpr, jaxpr, names, per_axis, graph, axis_specs,
                     mesh, args, kwargs, flat_args, in_tree, out_tree,
                     state_pairs, donate_state):
@@ -482,6 +569,81 @@ def _finish_compile(closed_jaxpr, jaxpr, names, per_axis, graph, axis_specs,
     compile-cache paths)."""
     axis_names = [s.name for s in axis_specs]
     per_axis_final = [c if c is not None else {} for c in per_axis]
+
+    # ---- deferred-reduction regions for solver-chosen PARTIAL chains
+    # (found BEFORE remat so the memory probes measure the program that
+    # actually ships, and remat chains never reach inside a region)
+    partial_regions = None
+    if edconfig.enable_partial_pools:
+        from .partial_regions import find_partial_regions
+
+        partial_regions = find_partial_regions(jaxpr, per_axis_final,
+                                               axis_names)
+    region_eqns = {i for r in (partial_regions or [])
+                   for i in range(r.start, r.end + 1)}
+
+    # ---- memory: plan the per-device peak under the (auto-resolved) HBM
+    # cap; over cap -> compiler-chosen remat (schedule/remat.py — the TPU
+    # form of the reference memory-opt path, compile_auto.py:353-453)
+    remat_plan = None
+    if edconfig.enable_auto_remat:
+        from easydist_tpu.schedule.remat import plan_remat, resolve_memory_cap
+
+        cap = resolve_memory_cap(mesh)
+        if cap > 0:
+            state_io_names = {}
+            for out_idx, in_idx in state_pairs.items():
+                if out_idx < len(jaxpr.outvars) and in_idx < len(jaxpr.invars):
+                    ov = jaxpr.outvars[out_idx]
+                    if not isinstance(ov, jex_core.Literal):
+                        state_io_names[names.name(ov)] = \
+                            names.name(jaxpr.invars[in_idx])
+            t0 = time.perf_counter()
+            axis_sizes = [s.size for s in axis_specs]
+            remat_plan = plan_remat(closed_jaxpr, names, per_axis_final,
+                                    axis_sizes, cap, state_io_names,
+                                    banned_eqns=region_eqns)
+            if remat_plan is not None and jax.default_backend() != "cpu":
+                # the liveness model is a python-order upper bound; before
+                # paying recompute, ask XLA's own scheduler (memory_analysis
+                # — ground truth, no execution).  CPU backends report
+                # temp_size 0 and skip these checks.
+                actual = _xla_peak_bytes(closed_jaxpr, names, per_axis_final,
+                                         axis_specs, mesh,
+                                         partial_regions=partial_regions)
+                if actual is not None and actual <= cap:
+                    logger.info(
+                        "[remat] model peak %.2f GiB over cap but XLA "
+                        "schedules it in %.2f GiB (cap %.2f) — no remat",
+                        remat_plan.base_peak / 2**30, actual / 2**30,
+                        cap / 2**30)
+                    remat_plan = None
+                elif actual is not None:
+                    # verify the rewrite helps XLA before shipping it:
+                    # recompute barriers can also BLOCK scheduler freedom
+                    actual_rm = _xla_peak_bytes(
+                        closed_jaxpr, names, per_axis_final, axis_specs,
+                        mesh, remat_plan=remat_plan,
+                        partial_regions=partial_regions)
+                    if actual_rm is None or actual_rm >= actual:
+                        logger.warning(
+                            "[remat] rewrite did not reduce XLA peak "
+                            "(%.2f -> %s GiB); dropping it — program "
+                            "exceeds the %.2f GiB cap by %.2f GiB",
+                            actual / 2**30,
+                            actual_rm and f"{actual_rm/2**30:.2f}",
+                            cap / 2**30, (actual - cap) / 2**30)
+                        remat_plan = None
+                    else:
+                        logger.info(
+                            "[remat] XLA peak %.2f -> %.2f GiB (cap %.2f"
+                            " GiB)%s", actual / 2**30, actual_rm / 2**30,
+                            cap / 2**30,
+                            "" if actual_rm <= cap else " — best effort,"
+                            " still over cap")
+            if remat_plan:
+                logger.info("[remat] planned in %.2fs",
+                            time.perf_counter() - t0)
 
     # ---- input shardings from placeholder strategies
     in_shardings = []
@@ -495,7 +657,8 @@ def _finish_compile(closed_jaxpr, jaxpr, names, per_axis, graph, axis_specs,
 
     # ---- emit + jit
     sharded_fn = emit_sharded_fn(closed_jaxpr, names, per_axis_final,
-                                 axis_names, mesh)
+                                 axis_names, mesh, remat_plan=remat_plan,
+                                 partial_regions=partial_regions)
     if edconfig.remat_policy != "none":
         # rematerialization policy for callers who differentiate THROUGH the
         # compiled function (a compiled train step already contains its own
@@ -556,9 +719,11 @@ def _finish_compile(closed_jaxpr, jaxpr, names, per_axis, graph, axis_specs,
 
     in_avals = [jax.ShapeDtypeStruct(v.aval.shape, v.aval.dtype)
                 for v in jaxpr.invars]
-    return CompileResult(jitted, tree_jitted, in_shardings, per_axis_final,
-                         graph, mesh, in_tree, out_tree, len(flat_args),
-                         in_avals=in_avals)
+    result = CompileResult(jitted, tree_jitted, in_shardings, per_axis_final,
+                           graph, mesh, in_tree, out_tree, len(flat_args),
+                           in_avals=in_avals)
+    result.remat_plan = remat_plan
+    return result
 
 
 class CompiledFunction:

@@ -80,7 +80,7 @@ def jaxpr_to_metagraph(closed_jaxpr, rules: Dict[str, dict],
         node = MetaNode(name=f"op{idx}", op_key=eqn.primitive.name,
                         invars=invars, outvars=outvars,
                         space=rule["space"], recombines=rule["recombines"],
-                        arg_rows=arg_rows)
+                        arg_rows=arg_rows, sig=sig)
         graph.add_op(node)
 
     for v in jaxpr.outvars:

@@ -1,0 +1,246 @@
+"""Deferred-reduction emission for solver-chosen PARTIAL chains.
+
+GSPMD has no user-visible "partial" annotation: when the solver defers an
+all-reduce across a linear chain (dot -> scale -> dot -> sum), plain
+constraint emission cannot express it — XLA reduces right after the first
+dot (measured: an 8 KiB all-reduce where a 4-byte one suffices).  This pass
+finds maximal runs of consecutive equations whose chosen strategy carries
+PARTIAL on one mesh axis and emits each run as a `shard_map` region:
+sharded sources enter per their solved placement, the chain computes on
+local shards (values inside are partial-by-construction), and ONE
+`jax.lax.psum` at the region fence realizes the deferred reduction —
+exactly the reference's global-partial deferral (metair.py:376-481)
+re-expressed with XLA collectives.
+
+v1 scope: single-axis regions (the run's equations must be unsharded on
+every other mesh axis), flat primitives only.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+logger = logging.getLogger(__name__)
+
+# primitives that may live inside a region: P-creators (contracted dot /
+# sharded-dim reduce) + P-linear chain ops (match the pool injection,
+# interpreter._PARTIAL_LINEAR_*)
+_REGION_PRIMS = frozenset((
+    "dot_general", "reduce_sum", "reshape", "transpose",
+    "convert_element_type", "squeeze", "expand_dims", "broadcast_in_dim",
+    "neg", "rev", "slice", "copy", "mul", "div", "add_any",
+))
+
+
+@dataclass
+class PartialRegion:
+    """One consecutive run [start, end] of P-carrying equations."""
+    start: int
+    end: int
+    axis_idx: int
+    axis_name: str
+    # var -> spec entries for sharded sources ({dim: axis_name})
+    source_shard_dim: Dict[object, int] = field(default_factory=dict)
+    # region-produced vars read outside the region (fence: psum) mapped to
+    # whether they are P (need the psum) at region exit
+    fence_partial: Set[object] = field(default_factory=set)
+
+
+def find_partial_regions(jaxpr, per_axis: Sequence[Dict], axis_names,
+                         ) -> List[PartialRegion]:
+    from jax.extend import core as jex_core
+
+    regions: List[PartialRegion] = []
+    n_axes = len(per_axis)
+    if n_axes == 0:
+        return regions
+
+    def strat(a, idx):
+        return per_axis[a].get(f"op{idx}")
+
+    def carries_p(a, idx):
+        s = strat(a, idx)
+        if s is None:
+            return False
+        return any(p is not None and p.is_partial()
+                   for p in s.out_placements)
+
+    def clean_other_axes(a, idx):
+        for b in range(n_axes):
+            if b == a:
+                continue
+            s = strat(b, idx)
+            if s is None:
+                continue
+            if any(p is not None and not p.is_replicate()
+                   for p in list(s.out_placements) + list(s.in_placements)):
+                return False
+        return True
+
+    eqns = jaxpr.eqns
+    for a in range(n_axes):
+        idx = 0
+        while idx < len(eqns):
+            if not (carries_p(a, idx)
+                    and eqns[idx].primitive.name in _REGION_PRIMS
+                    and clean_other_axes(a, idx)):
+                idx += 1
+                continue
+            start = idx
+            while idx + 1 < len(eqns) and carries_p(a, idx + 1) \
+                    and eqns[idx + 1].primitive.name in _REGION_PRIMS \
+                    and clean_other_axes(a, idx + 1):
+                idx += 1
+            end = idx
+            idx += 1
+            if end == start:
+                # a lone P producer gains nothing from a region; XLA's
+                # immediate reduction is already optimal
+                continue
+
+            region = PartialRegion(start, end, a, str(axis_names[a]))
+            produced: Set[object] = set()
+            ok = True
+            for j in range(start, end + 1):
+                eqn = eqns[j]
+                s = strat(a, j)
+                pos = 0
+                for v in eqn.invars:
+                    if isinstance(v, jex_core.Literal):
+                        continue
+                    if v not in produced:
+                        p = (s.in_placements[pos]
+                             if s and pos < len(s.in_placements) else None)
+                        if p is not None and p.is_shard():
+                            prev = region.source_shard_dim.get(v)
+                            if prev is not None and prev != p.dim:
+                                ok = False  # conflicting source shardings
+                            region.source_shard_dim[v] = p.dim
+                        elif p is not None and p.is_partial() \
+                                and v not in produced:
+                            ok = False  # P flowing in from outside the run
+                    pos += 1
+                for v in eqn.outvars:
+                    produced.add(v)
+            if not ok:
+                continue
+
+            # fences: region-produced vars read after the region (or
+            # returned); record whether they exit as P
+            out_set = {v for v in jaxpr.outvars
+                       if not isinstance(v, jex_core.Literal)}
+            last_strat = None
+            for j in range(start, end + 1):
+                p_out = {}
+                s = strat(a, j)
+                for k, v in enumerate(eqns[j].outvars):
+                    p = (s.out_placements[k]
+                         if s and k < len(s.out_placements) else None)
+                    p_out[v] = p is not None and p.is_partial()
+                if last_strat is None:
+                    last_strat = {}
+                last_strat.update(p_out)
+            consumed_later: Set[object] = set()
+            for j in range(end + 1, len(eqns)):
+                for v in eqns[j].invars:
+                    if not isinstance(v, jex_core.Literal):
+                        consumed_later.add(v)
+            for v in list(produced):
+                if v in consumed_later or v in out_set:
+                    if last_strat.get(v):
+                        region.fence_partial.add(v)
+            regions.append(region)
+    # keep non-overlapping regions only (one axis per run; first wins)
+    taken: Set[int] = set()
+    final = []
+    for r in sorted(regions, key=lambda r: (r.start, -(r.end - r.start))):
+        span = set(range(r.start, r.end + 1))
+        if span & taken:
+            continue
+        taken |= span
+        final.append(r)
+    if final:
+        logger.info("[partial] %d deferred-reduction region(s): %s",
+                    len(final),
+                    [(r.start, r.end, r.axis_name) for r in final])
+    return final
+
+
+def emit_region(region: PartialRegion, jaxpr, env, mesh):
+    """Execute one region under shard_map: local chain + one psum fence.
+    Reads sources from `env`, writes region outputs (post-fence, global
+    semantics) back into `env`."""
+    import jax
+    from jax import shard_map
+    from jax.extend import core as jex_core
+    from jax.sharding import PartitionSpec
+
+    eqns = jaxpr.eqns[region.start:region.end + 1]
+    produced = {v for eqn in eqns for v in eqn.outvars}
+    sources = []
+    seen = set()
+    for eqn in eqns:
+        for v in eqn.invars:
+            if isinstance(v, jex_core.Literal) or v in produced or v in seen:
+                continue
+            seen.add(v)
+            sources.append(v)
+    # region outputs = produced vars needed outside (production order)
+    consumed_later: Set[object] = set()
+    for e in jaxpr.eqns[region.end + 1:]:
+        for v in e.invars:
+            if not isinstance(v, jex_core.Literal):
+                consumed_later.add(v)
+    out_set = {v for v in jaxpr.outvars
+               if not isinstance(v, jex_core.Literal)}
+    outs = []
+    for eqn in eqns:
+        for v in eqn.outvars:
+            if v in consumed_later or v in out_set:
+                outs.append(v)
+
+    axis = region.axis_name
+
+    def body(*src_vals):
+        local = dict(zip(sources, src_vals))
+
+        def read(v):
+            return v.val if isinstance(v, jex_core.Literal) else local[v]
+
+        for eqn in eqns:
+            sub, params = eqn.primitive.get_bind_params(eqn.params)
+            vals = eqn.primitive.bind(*sub, *[read(v) for v in eqn.invars],
+                                      **params)
+            if not eqn.primitive.multiple_results:
+                vals = [vals]
+            for var, val in zip(eqn.outvars, vals):
+                local[var] = val
+        result = []
+        for v in outs:
+            val = local[v]
+            if v in region.fence_partial:
+                val = jax.lax.psum(val, axis)  # THE deferred reduction
+            result.append(val)
+        return tuple(result)
+
+    def spec_for(v):
+        nd = len(v.aval.shape)
+        entries = [None] * nd
+        d = region.source_shard_dim.get(v)
+        if d is not None and d < nd:
+            entries[d] = axis
+        return PartitionSpec(*entries)
+
+    in_specs = tuple(spec_for(v) for v in sources)
+    out_specs = tuple(PartitionSpec() for _ in outs)
+    auto = frozenset(mesh.axis_names) - {axis}
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    if auto:
+        kwargs["auto"] = auto
+    fn = shard_map(body, **kwargs)
+    results = fn(*[env[v] for v in sources])
+    for v, val in zip(outs, results):
+        env[v] = val

@@ -135,11 +135,15 @@ class MetaNode:
                  space: Optional[ShardSpace] = None,
                  recombines: Optional[Dict[int, object]] = None,
                  arg_rows: Optional[List[int]] = None,
-                 is_input: bool = False):
+                 is_input: bool = False, sig: Optional[str] = None):
         MetaNode._uid += 1
         self.uid = MetaNode._uid
         self.name = name
         self.op_key = op_key
+        # full op signature (primitive + params + shapes/dtypes) — the
+        # PerfDB key for measured per-op runtimes (reference
+        # runtime_prof.py keys ops the same way)
+        self.sig = sig
         self.invars = invars
         self.outvars = outvars
         self.space = space

@@ -3,12 +3,25 @@
 Hand-written (rather than optax) so the optimizer update is plain jaxpr
 arithmetic the discovery engine shards like any other op — the analog of the
 reference tracing `optimizer.step()` into the same fx graph
-(torch/compile.py:52-83)."""
+(torch/compile.py:52-83).
+
+Hyperparameters (`lr`, `weight_decay`) accept either a scalar or a pytree
+matching `params` — per-parameter-group settings (torch.optim param_groups,
+reference compile.py:52-67 traces them natively) become per-leaf trees.
+"""
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+
+def _hyper_tree(val, params):
+    """Broadcast a scalar hyperparameter to every param leaf; pass trees
+    through (must match the params structure)."""
+    if isinstance(val, (int, float)) or getattr(val, "ndim", None) == 0:
+        return jax.tree_util.tree_map(lambda _: val, params)
+    return val
 
 
 def adam_init(params):
@@ -19,11 +32,14 @@ def adam_init(params):
 
 
 def adam_update(params, grads, state, lr=1e-3, b1=0.9, b2=0.999, eps=1e-8,
-                weight_decay=0.0):
-    if weight_decay:
-        # torch.optim.Adam semantics: L2 folded into the gradient
-        grads = jax.tree_util.tree_map(lambda g, p: g + weight_decay * p,
-                                       grads, params)
+                weight_decay=0.0, decoupled=False):
+    """torch.optim.Adam semantics; `decoupled=True` gives AdamW (weight
+    decay applied directly to the parameter, not folded into the grad)."""
+    lr_t = _hyper_tree(lr, params)
+    wd_t = _hyper_tree(weight_decay, params)
+    if not decoupled:
+        grads = jax.tree_util.tree_map(lambda g, p, wd: g + wd * p,
+                                       grads, params, wd_t)
     count = state["count"] + 1
     mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g,
                                 state["mu"], grads)
@@ -31,11 +47,54 @@ def adam_update(params, grads, state, lr=1e-3, b1=0.9, b2=0.999, eps=1e-8,
                                 state["nu"], grads)
     c1 = 1 - b1 ** count.astype(jnp.float32)
     c2 = 1 - b2 ** count.astype(jnp.float32)
-    new_params = jax.tree_util.tree_map(
-        lambda p, m, v: p - lr * (m / c1) / (jnp.sqrt(v / c2) + eps),
-        params, mu, nu)
+    if decoupled:
+        new_params = jax.tree_util.tree_map(
+            lambda p, m, v, lr_, wd_: p - lr_ * (
+                (m / c1) / (jnp.sqrt(v / c2) + eps) + wd_ * p),
+            params, mu, nu, lr_t, wd_t)
+    else:
+        new_params = jax.tree_util.tree_map(
+            lambda p, m, v, lr_: p - lr_ * (m / c1) / (jnp.sqrt(v / c2) + eps),
+            params, mu, nu, lr_t)
     return new_params, {"mu": mu, "nu": nu, "count": count}
 
 
-def sgd_update(params, grads, lr=1e-2):
-    return jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+def adamw_update(params, grads, state, lr=1e-3, b1=0.9, b2=0.999, eps=1e-8,
+                 weight_decay=1e-2):
+    return adam_update(params, grads, state, lr=lr, b1=b1, b2=b2, eps=eps,
+                       weight_decay=weight_decay, decoupled=True)
+
+
+def sgd_init(params):
+    """Momentum buffers (torch initializes the buffer to the first grad —
+    equivalent to momentum * 0 + g)."""
+    return {"buf": jax.tree_util.tree_map(jnp.zeros_like, params)}
+
+
+def sgd_update(params, grads, lr=1e-2, momentum=0.0, nesterov=False,
+               weight_decay=0.0, state=None):
+    """torch.optim.SGD semantics.  Stateless (returns new params) when
+    `state` is None and momentum is 0; with momentum pass `state` from
+    `sgd_init` and receive `(new_params, new_state)`."""
+    lr_t = _hyper_tree(lr, params)
+    wd_t = _hyper_tree(weight_decay, params)
+    grads = jax.tree_util.tree_map(lambda g, p, wd: g + wd * p,
+                                   grads, params, wd_t)
+    if momentum:
+        if state is None:
+            raise ValueError("sgd momentum requires state from sgd_init()")
+        buf = jax.tree_util.tree_map(lambda b, g: momentum * b + g,
+                                     state["buf"], grads)
+        if nesterov:
+            grads = jax.tree_util.tree_map(lambda g, b: g + momentum * b,
+                                           grads, buf)
+        else:
+            grads = buf
+        new_params = jax.tree_util.tree_map(lambda p, g, lr_: p - lr_ * g,
+                                            params, grads, lr_t)
+        return new_params, {"buf": buf}
+    new_params = jax.tree_util.tree_map(lambda p, g, lr_: p - lr_ * g,
+                                        params, grads, lr_t)
+    if state is not None:
+        return new_params, state
+    return new_params

@@ -48,6 +48,28 @@ def _causal_mask(s, qi, ki, block_q, block_k):
     return jnp.where(k_pos <= q_pos, s, _NEG_INF)
 
 
+def _kv_index_map(causal, block_q, block_k):
+    """K/V block index for grid (bh, qi, ki).  Causal: steps strictly above
+    the diagonal clamp to the diagonal block — Pallas skips the DMA when
+    the mapped index repeats, so fully-masked blocks cost no HBM traffic
+    (the kernel's @pl.when already skips their compute)."""
+    if not causal:
+        return lambda bh, qi, ki: (bh, ki, 0)
+    return lambda bh, qi, ki: (
+        bh, jnp.minimum(ki, ((qi + 1) * block_q - 1) // block_k), 0)
+
+
+def _q_index_map(causal, block_q, block_k):
+    """Q-side block index for grid (bh, ki, qb) (dK/dV pass).  Causal: Q
+    blocks strictly above the K block's first row are fully masked — clamp
+    to the first contributing block so leading masked steps re-use one
+    fetch."""
+    if not causal:
+        return lambda bh, ki, qb: (bh, qb, 0)
+    return lambda bh, ki, qb: (
+        bh, jnp.maximum(qb, (ki * block_k) // block_q), 0)
+
+
 # ---------------------------------------------------------------- forward
 
 
@@ -104,13 +126,14 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
 
     kernel = functools.partial(_flash_kernel, causal=causal, scale=scale,
                                block_q=bq, block_k=bk, n_k=n_k)
+    kv_map = _kv_index_map(causal, bq, bk)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, n_q, n_k),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), kv_map),
+            pl.BlockSpec((1, bk, d), kv_map),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
@@ -233,13 +256,14 @@ def _flash_backward(q, k, v, o, lse, g, causal: bool, scale: float,
     dq_kernel = functools.partial(_flash_bwd_dq_kernel, causal=causal,
                                   scale=scale, block_q=bq, block_k=bk,
                                   n_k=n_k)
+    kv_map = _kv_index_map(causal, bq, bk)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(b * h, n_q, n_k),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), kv_map),
+            pl.BlockSpec((1, bk, d), kv_map),
             pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0)),
@@ -255,16 +279,17 @@ def _flash_backward(q, k, v, o, lse, g, causal: bool, scale: float,
     dkv_kernel = functools.partial(_flash_bwd_dkv_kernel, causal=causal,
                                    scale=scale, block_q=bq, block_k=bk,
                                    n_q=n_q)
+    q_map = _q_index_map(causal, bq, bk)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(b * h, n_k, n_q),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, ki, qb: (bh, qb, 0)),
+            pl.BlockSpec((1, bq, d), q_map),
             pl.BlockSpec((1, bk, d), lambda bh, ki, qb: (bh, ki, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, ki, qb: (bh, ki, 0)),
-            pl.BlockSpec((1, bq, d), lambda bh, ki, qb: (bh, qb, 0)),
-            pl.BlockSpec((1, bq, 1), lambda bh, ki, qb: (bh, qb, 0)),
-            pl.BlockSpec((1, bq, 1), lambda bh, ki, qb: (bh, qb, 0)),
+            pl.BlockSpec((1, bq, d), q_map),
+            pl.BlockSpec((1, bq, 1), q_map),
+            pl.BlockSpec((1, bq, 1), q_map),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda bh, ki, qb: (bh, ki, 0)),

@@ -31,14 +31,10 @@ def _backend_key() -> str:
     return f"{jax.default_backend()}:{len(jax.devices())}"
 
 
-def _time_fn(fn, *args, iters=10):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+def _time_fn(fn, *args, iters=12):
+    from easydist_tpu.utils.timer import two_point_time
+
+    return two_point_time(fn, args, n1=max(2, iters // 4), n2=iters)
 
 
 def calibrate(mesh=None, axis: Optional[str] = None,

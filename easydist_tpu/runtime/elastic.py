@@ -53,6 +53,17 @@ def run_training(step_fn: Callable, init_state: Callable, data_iter,
     else:
         state = load_checkpoint(ckpt_dir, init_state(), step=start)
         logger.info("elastic: resumed from step %d", start)
+        # position the data stream: without this, a restart re-trains on
+        # batches 0..start (silent double-sampling)
+        if hasattr(data_iter, "skip"):
+            already = getattr(data_iter, "batches_consumed", 0)
+            if already < start:
+                data_iter.skip(start - already)
+                logger.info("elastic: data cursor advanced to batch %d",
+                            start)
+
+    if hasattr(data_iter, "skip") and not hasattr(data_iter, "__next__"):
+        data_iter = iter(data_iter)
 
     t0 = time.perf_counter()
     for step in range(start, total_steps):

@@ -16,7 +16,8 @@ from typing import Callable, Optional
 import jax
 
 from easydist_tpu.jaxfront.api import easydist_compile
-from easydist_tpu.models.optim import adam_init, adam_update, sgd_update
+from easydist_tpu.models.optim import (adam_init, adam_update, sgd_init,
+                                       sgd_update)
 from .convert import torch_module_to_jax
 
 
@@ -32,55 +33,91 @@ def easydist_compile_torch(module, example_args, mesh=None, **kwargs):
 
 
 def _translate_torch_optimizer(optimizer, module):
-    """torch.optim instance -> ("adam"/"sgd", hyperparams, state translator)
-    (reference: the user's own torch optimizer captured by fx tracing,
-    torch/compile.py:25-95; here translated into the equivalent jax update).
+    """torch.optim instance -> ("adam"/"adamw"/"sgd", hyperparams, state
+    translator) (reference: the user's own torch optimizer captured by fx
+    tracing, torch/compile.py:25-95; here translated into the equivalent jax
+    update).
+
+    Multiple param groups translate into per-parameter lr/weight_decay
+    TREES (models/optim.py broadcasts them leafwise); a param absent from
+    every group gets lr 0 (torch would never step it).  Betas/eps/momentum
+    must be uniform across groups.
     """
-    import torch
-
     name_of = {id(p): n for n, p in module.named_parameters()}
-    group = optimizer.param_groups[0]
-    if len(optimizer.param_groups) != 1:
-        raise NotImplementedError("multiple param groups not supported")
-
+    groups = optimizer.param_groups
     kind = type(optimizer).__name__.lower()
-    if kind == "adam":
-        if group.get("amsgrad", False) or group.get("maximize", False):
-            raise NotImplementedError("Adam amsgrad/maximize not supported")
-        hyper = {"lr": group["lr"], "b1": group["betas"][0],
-                 "b2": group["betas"][1], "eps": group["eps"],
-                 "weight_decay": group.get("weight_decay", 0.0)}
-    elif kind == "sgd":
-        if group.get("momentum", 0) or group.get("nesterov", False) \
-                or group.get("weight_decay", 0):
-            raise NotImplementedError(
-                "SGD momentum/nesterov/weight_decay not supported")
-        hyper = {"lr": group["lr"]}
-    else:
+    if kind not in ("adam", "adamw", "sgd"):
         raise NotImplementedError(
             f"torch optimizer {type(optimizer).__name__} not supported "
-            f"(Adam and plain SGD are)")
+            f"(Adam, AdamW and SGD are)")
+
+    def uniform(key, default=None):
+        vals = {repr(g.get(key, default)) for g in groups}
+        if len(vals) != 1:
+            raise NotImplementedError(
+                f"per-group {key} not supported (groups have {vals})")
+        return groups[0].get(key, default)
+
+    # per-param trees over every named parameter; group membership decides
+    lr_tree = {n: 0.0 for n in name_of.values()}
+    wd_tree = {n: 0.0 for n in name_of.values()}
+    for g in groups:
+        for p in g["params"]:
+            qual = name_of.get(id(p))
+            if qual is None:
+                raise ValueError(
+                    "optimizer param not found among module parameters")
+            lr_tree[qual] = float(g["lr"])
+            wd_tree[qual] = float(g.get("weight_decay", 0.0))
+    multi = len(groups) > 1
+
+    if kind in ("adam", "adamw"):
+        if uniform("amsgrad", False) or uniform("maximize", False):
+            raise NotImplementedError("Adam amsgrad/maximize not supported")
+        betas = uniform("betas")
+        hyper = {"lr": lr_tree if multi else groups[0]["lr"],
+                 "b1": betas[0], "b2": betas[1], "eps": uniform("eps"),
+                 "weight_decay": wd_tree if multi
+                 else groups[0].get("weight_decay", 0.0),
+                 "decoupled": kind == "adamw"}
+    else:  # sgd
+        hyper = {"lr": lr_tree if multi else groups[0]["lr"],
+                 "momentum": float(uniform("momentum", 0.0) or 0.0),
+                 "nesterov": bool(uniform("nesterov", False)),
+                 "weight_decay": wd_tree if multi
+                 else groups[0].get("weight_decay", 0.0)}
 
     def translate_state(params0):
-        """Carry over a warm optimizer's exp_avg/exp_avg_sq/step."""
-        if kind != "adam":
-            return None
+        """Carry over a warm optimizer's exp_avg/exp_avg_sq/step (adam) or
+        momentum buffers (sgd)."""
         import jax.numpy as jnp
         import numpy as np
 
+        if kind == "sgd":
+            if not hyper["momentum"]:
+                return None
+            opt = sgd_init({k: v for k, v in params0.items()})
+            for p, st in optimizer.state.items():
+                qual = name_of.get(id(p))
+                if qual is None or st.get("momentum_buffer") is None:
+                    continue
+                opt["buf"][qual] = jnp.array(
+                    st["momentum_buffer"].detach().numpy())
+            return opt
         opt = adam_init({k: v for k, v in params0.items()})
         step_count = 0
         for p, st in optimizer.state.items():
             qual = name_of.get(id(p))
             if qual is None or "exp_avg" not in st:
                 continue
-            opt["mu"][qual] = jnp.asarray(st["exp_avg"].detach().numpy())
-            opt["nu"][qual] = jnp.asarray(st["exp_avg_sq"].detach().numpy())
+            opt["mu"][qual] = jnp.array(st["exp_avg"].detach().numpy())
+            opt["nu"][qual] = jnp.array(st["exp_avg_sq"].detach().numpy())
             step_count = int(st["step"])
         opt["count"] = jnp.asarray(np.int32(step_count))
         return opt
 
-    return kind, hyper, translate_state
+    # adamw rides the adam code path (decoupled flag in hyper)
+    return ("adam" if kind == "adamw" else kind), hyper, translate_state
 
 
 def make_torch_train_step(module, example_args, loss_fn: Callable,
@@ -186,6 +223,24 @@ def make_torch_train_step(module, example_args, loss_fn: Callable,
             new_tp, new_opt = adam_update(trainable, grads, opt, lr=lr,
                                           **hyper)
             return ({**new_tp, **buffers}, new_opt), loss
+    elif optimizer == "sgd" and hyper.get("momentum"):
+        def init_state():
+            opt = translate_state(trainable0) if translate_state else None
+            return (params0, opt if opt is not None else sgd_init(trainable0))
+
+        def step(state, inputs, *targets):
+            params, opt = state
+            trainable = {k: v for k, v in params.items()
+                         if k not in buffer_names}
+            buffers = {k: v for k, v in params.items() if k in buffer_names}
+
+            def objective(tp):
+                return loss_fn(fwd({**tp, **buffers}, inputs), *targets)
+
+            loss, grads = jax.value_and_grad(objective)(trainable)
+            new_tp, new_opt = sgd_update(trainable, grads, lr=lr,
+                                         state=opt, **hyper)
+            return ({**new_tp, **buffers}, new_opt), loss
     elif optimizer == "sgd":
         def init_state():
             return params0
@@ -199,7 +254,8 @@ def make_torch_train_step(module, example_args, loss_fn: Callable,
                 return loss_fn(fwd({**tp, **buffers}, inputs), *targets)
 
             loss, grads = jax.value_and_grad(objective)(trainable)
-            return {**sgd_update(trainable, grads, lr=lr), **buffers}, loss
+            return {**sgd_update(trainable, grads, lr=lr, **hyper),
+                    **buffers}, loss
     else:
         raise ValueError(f"unknown optimizer {optimizer!r}")
 
@@ -235,6 +291,24 @@ def _make_train_mode_step(module, example_args, loss_fn, optimizer, lr,
             new_tp, new_opt = adam_update(trainable, grads, opt, lr=lr,
                                           **hyper)
             return ((new_tp, {**buffers, **new_buf}), new_opt), loss
+    elif optimizer == "sgd" and hyper.get("momentum"):
+        def init_state():
+            opt = translate_state(trainable0) if translate_state else None
+            return ((trainable0, buffers0),
+                    opt if opt is not None else sgd_init(trainable0))
+
+        def step(state, rng, inputs, *targets):
+            (trainable, buffers), opt = state
+
+            def objective(tp):
+                out, new_buf = fwd({**tp, **buffers}, rng, inputs)
+                return loss_fn(out, *targets), new_buf
+
+            (loss, new_buf), grads = jax.value_and_grad(
+                objective, has_aux=True)(trainable)
+            new_tp, new_opt = sgd_update(trainable, grads, lr=lr,
+                                         state=opt, **hyper)
+            return ((new_tp, {**buffers, **new_buf}), new_opt), loss
     elif optimizer == "sgd":
         def init_state():
             return ((trainable0, buffers0), None)
@@ -248,7 +322,7 @@ def _make_train_mode_step(module, example_args, loss_fn, optimizer, lr,
 
             (loss, new_buf), grads = jax.value_and_grad(
                 objective, has_aux=True)(trainable)
-            new_tp = sgd_update(trainable, grads, lr=lr)
+            new_tp = sgd_update(trainable, grads, lr=lr, **hyper)
             return ((new_tp, {**buffers, **new_buf}), None), loss
     else:
         raise ValueError(f"unknown optimizer {optimizer!r}")

@@ -267,71 +267,98 @@ def _pair(v):
     return (v, v) if isinstance(v, int) else tuple(v)
 
 
-def _conv_transpose2d(x, w, bias, stride, padding, dilation, output_padding,
-                      groups):
-    """torch ConvTranspose2d == fractionally-strided conv: lhs_dilation =
-    stride, kernel spatially flipped with in/out channels swapped (torch
-    weight layout is [Cin, Cout/g, kh, kw])."""
-    if w.ndim != 4:
+def _ntuple(v, rank):
+    if isinstance(v, int):
+        return (v,) * rank
+    t = tuple(v)
+    return t * rank if len(t) == 1 else t
+
+
+def _conv_dims(rank):
+    s = "DHW"[3 - rank:]
+    return ("NC" + s, "OI" + s, "NC" + s)
+
+
+def _conv_transpose_nd(x, w, bias, stride, padding, dilation, output_padding,
+                       groups):
+    """torch ConvTransposeNd (N=1,2,3) == fractionally-strided conv:
+    lhs_dilation = stride, kernel spatially flipped with in/out channels
+    swapped (torch weight layout is [Cin, Cout/g, k...])."""
+    rank = w.ndim - 2
+    if rank not in (1, 2, 3):
         raise UnsupportedAtenOp(
-            f"transposed convolution with {w.ndim - 2}D kernels "
-            f"(only 2D is implemented)")
+            f"transposed convolution with {rank}D kernels")
     cin = w.shape[0]
-    kh, kw = w.shape[2], w.shape[3]
-    # [Cin, Cout/g, kh, kw] -> [g, Cin/g, Cout/g, ...] -> [Cout, Cin/g, ...]
-    wg = w.reshape(groups, cin // groups, w.shape[1], kh, kw)
-    wg = jnp.swapaxes(wg, 1, 2).reshape(groups * w.shape[1],
-                                        cin // groups, kh, kw)
-    wg = jnp.flip(wg, axis=(2, 3))
+    ks = w.shape[2:]
+    stride = _ntuple(stride, rank)
+    padding = _ntuple(padding, rank)
+    dilation = _ntuple(dilation, rank)
+    output_padding = _ntuple(output_padding, rank)
+    # [Cin, Cout/g, k...] -> [g, Cin/g, Cout/g, ...] -> [Cout, Cin/g, ...]
+    wg = w.reshape((groups, cin // groups, w.shape[1]) + ks)
+    wg = jnp.swapaxes(wg, 1, 2).reshape(
+        (groups * w.shape[1], cin // groups) + ks)
+    wg = jnp.flip(wg, axis=tuple(range(2, 2 + rank)))
     pads = []
-    for k, p, d, op in zip((kh, kw), padding, dilation, output_padding):
+    for k, p, d, op in zip(ks, padding, dilation, output_padding):
         eff = d * (k - 1)
         pads.append((eff - p, eff - p + op))
     out = jax.lax.conv_general_dilated(
-        x, wg, (1, 1), pads,
-        lhs_dilation=tuple(stride),
-        rhs_dilation=tuple(dilation),
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        x, wg, (1,) * rank, pads,
+        lhs_dilation=stride,
+        rhs_dilation=dilation,
+        dimension_numbers=_conv_dims(rank),
         feature_group_count=groups)
     if bias is not None:
-        out = out + bias.reshape(1, -1, 1, 1)
+        out = out + bias.reshape((1, -1) + (1,) * rank)
     return out
 
 
+def _conv_transpose2d(x, w, bias, stride, padding, dilation, output_padding,
+                      groups):
+    return _conv_transpose_nd(x, w, bias, stride, padding, dilation,
+                              output_padding, groups)
+
+
+@register_aten("aten.conv_transpose1d.default")
 @register_aten("aten.conv_transpose2d.input")
-def _conv_transpose2d_input(x, w, bias=None, stride=(1, 1), padding=(0, 0),
-                            output_padding=(0, 0), groups=1,
-                            dilation=(1, 1)):
-    return _conv_transpose2d(x, w, bias, _pair(stride), _pair(padding),
-                             _pair(dilation), _pair(output_padding), groups)
+@register_aten("aten.conv_transpose3d.input")
+def _conv_transpose_input(x, w, bias=None, stride=1, padding=0,
+                          output_padding=0, groups=1, dilation=1):
+    return _conv_transpose_nd(x, w, bias, stride, padding, dilation,
+                              output_padding, groups)
 
 
-@register_aten("aten.conv2d.default", "aten.convolution.default")
-def _conv2d(x, w, bias=None, stride=(1, 1), padding=(0, 0), dilation=(1, 1),
-            *rest):
-    # torch NCHW / OIHW; groups is the last convolution arg when present
+@register_aten("aten.conv1d.default", "aten.conv2d.default",
+               "aten.conv3d.default", "aten.convolution.default")
+def _conv_nd(x, w, bias=None, stride=1, padding=0, dilation=1, *rest):
+    # torch NC<spatial> / OI<spatial>; groups is the last convolution arg
+    # when present.  Rank (1/2/3D) comes from the kernel.
+    rank = w.ndim - 2
     groups = 1
     transposed = False
-    output_padding = (0, 0)
+    output_padding = 0
     if rest:
         if len(rest) >= 3:  # convolution.default: transposed, output_padding, groups
             transposed = bool(rest[0])
-            output_padding = tuple(rest[1]) if rest[1] else (0, 0)
+            output_padding = tuple(rest[1]) if rest[1] else 0
             groups = rest[2]
         else:
             groups = rest[0]
-    stride, padding, dilation = _pair(stride), _pair(padding), _pair(dilation)
+    stride = _ntuple(stride, rank)
+    padding = _ntuple(padding, rank)
+    dilation = _ntuple(dilation, rank)
     if transposed:
-        return _conv_transpose2d(x, w, bias, stride, padding, dilation,
-                                 output_padding, groups)
+        return _conv_transpose_nd(x, w, bias, stride, padding, dilation,
+                                  output_padding, groups)
     out = jax.lax.conv_general_dilated(
-        x, w, tuple(stride),
+        x, w, stride,
         [(p, p) for p in padding],
-        rhs_dilation=tuple(dilation),
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        rhs_dilation=dilation,
+        dimension_numbers=_conv_dims(rank),
         feature_group_count=groups)
     if bias is not None:
-        out = out + bias.reshape(1, -1, 1, 1)
+        out = out + bias.reshape((1, -1) + (1,) * rank)
     return out
 
 
@@ -364,22 +391,59 @@ def _max_pool2d(x, kernel, stride=None, padding=(0, 0), dilation=(1, 1),
         window_dilation=(1, 1) + tuple(dilation))
 
 
+def _adaptive_weights(n, o, dtype):
+    """[o, n] row-stochastic matrix averaging torch's adaptive windows
+    (start = floor(i*n/o), end = ceil((i+1)*n/o)); static shapes, so the
+    variable windows become one small matmul — MXU-friendly."""
+    import numpy as np
+
+    m = np.zeros((o, n), dtype=np.float32)
+    for i in range(o):
+        s, e = (i * n) // o, -((-(i + 1) * n) // o)
+        m[i, s:e] = 1.0 / (e - s)
+    return jnp.asarray(m, dtype=dtype)
+
+
+def _adaptive_avg_pool_nd(x, output_size, rank):
+    out = _ntuple(tuple(output_size) if not isinstance(output_size, int)
+                  else output_size, rank)
+    spatial = x.shape[-rank:]
+    if all(o == 1 for o in out):
+        return x.mean(axis=tuple(range(x.ndim - rank, x.ndim)),
+                      keepdims=True)
+    if all(n % o == 0 for n, o in zip(spatial, out)):
+        # evenly-divisible: non-overlapping kernel = stride = n/o (torch
+        # uses the same fixed windows here)
+        ks = tuple(n // o for n, o in zip(spatial, out))
+        lead = (1,) * (x.ndim - rank)
+        summed = jax.lax.reduce_window(
+            x, 0.0, jax.lax.add, lead + ks, lead + ks,
+            [(0, 0)] * x.ndim)
+        import math
+        return summed / math.prod(ks)
+    # general case: contract each spatial dim with its window-weight matrix
+    compute = x.astype(jnp.float32) if x.dtype != jnp.float64 else x
+    for d, (n, o) in enumerate(zip(spatial, out)):
+        axis = x.ndim - rank + d
+        w = _adaptive_weights(n, o, compute.dtype)
+        compute = jnp.moveaxis(
+            jnp.tensordot(compute, w, axes=((axis,), (1,))), -1, axis)
+    return compute.astype(x.dtype)
+
+
+@register_aten("aten.adaptive_avg_pool1d.default")
+def _adaptive_avg_pool1d(x, output_size):
+    return _adaptive_avg_pool_nd(x, output_size, 1)
+
+
 @register_aten("aten.adaptive_avg_pool2d.default")
 def _adaptive_avg_pool2d(x, output_size):
-    out = _pair(tuple(output_size))
-    if out == (1, 1):
-        return x.mean(axis=(2, 3), keepdims=True)
-    if all(n % o == 0 for n, o in zip(x.shape[2:], out)):
-        # evenly-divisible case: non-overlapping kernel = stride = n/o
-        # (torch uses the same fixed windows here)
-        kh, kw = x.shape[2] // out[0], x.shape[3] // out[1]
-        summed = jax.lax.reduce_window(
-            x, 0.0, jax.lax.add, (1, 1, kh, kw), (1, 1, kh, kw),
-            [(0, 0)] * 4)
-        return summed / (kh * kw)
-    raise UnsupportedAtenOp(
-        "adaptive_avg_pool2d with non-divisible output size "
-        "(variable window sizes)")
+    return _adaptive_avg_pool_nd(x, output_size, 2)
+
+
+@register_aten("aten.adaptive_avg_pool3d.default")
+def _adaptive_avg_pool3d(x, output_size):
+    return _adaptive_avg_pool_nd(x, output_size, 3)
 
 
 @register_aten("aten.mean.dim")
@@ -463,7 +527,8 @@ def _t(x):
 
 
 @register_aten("aten.contiguous.default", "aten.clone.default",
-               "aten.detach.default", "aten.alias.default")
+               "aten.detach.default", "aten.alias.default",
+               "aten.lift_fresh_copy.default")
 def _identity(x, *a, **k):
     return x
 
@@ -555,9 +620,78 @@ def _index_select(x, dim, index):
     return jnp.take(x, index, axis=dim)
 
 
+@register_aten("aten.lt.Scalar", "aten.lt.Tensor")
+def _lt(a, b):
+    return a < b
+
+
+@register_aten("aten.le.Scalar", "aten.le.Tensor")
+def _le(a, b):
+    return a <= b
+
+
+@register_aten("aten.gt.Scalar", "aten.gt.Tensor")
+def _gt(a, b):
+    return a > b
+
+
+@register_aten("aten.ge.Scalar", "aten.ge.Tensor")
+def _ge(a, b):
+    return a >= b
+
+
+@register_aten("aten.eq.Scalar", "aten.eq.Tensor")
+def _eq(a, b):
+    return a == b
+
+
+@register_aten("aten.ne.Scalar", "aten.ne.Tensor")
+def _ne(a, b):
+    return a != b
+
+
 @register_aten("aten.masked_fill.Scalar")
 def _masked_fill(x, mask, value):
     return jnp.where(mask, jnp.array(value, x.dtype), x)
+
+
+@register_aten("aten.masked_fill.Tensor")
+def _masked_fill_tensor(x, mask, value):
+    return jnp.where(mask, value.astype(x.dtype), x)
+
+
+@register_aten("aten.index_put.default", "aten.index_put_.default")
+def _index_put(x, indices, values, accumulate=False):
+    """x[idx...] = values.  Boolean-mask writes keep static shapes
+    (x[mask] = v is a where/add — unlike boolean-mask READS, which have
+    data-dependent output shapes and stay unsupported); integer indices go
+    through scatter."""
+    values = jnp.asarray(values).astype(x.dtype)
+    masks = [i for i in indices if i is not None
+             and getattr(i, "dtype", None) == jnp.bool_]
+    if masks:
+        if len(masks) != len([i for i in indices if i is not None]):
+            raise UnsupportedAtenOp(
+                "index_put mixing boolean masks with integer indices")
+        if values.ndim > 0 and values.size > 1:
+            # torch fills selected elements in row-major SELECTION order —
+            # a data-dependent scatter; jnp.where would broadcast `values`
+            # positionally over the full tensor and silently differ
+            raise UnsupportedAtenOp(
+                "index_put with a boolean mask and a non-scalar values "
+                "tensor (selection-ordered fill is data-dependent)")
+        mask = masks[0]
+        for m in masks[1:]:
+            mask = mask & m
+        if mask.ndim < x.ndim:  # leading-dim mask broadcasts over the rest
+            mask = mask.reshape(mask.shape + (1,) * (x.ndim - mask.ndim))
+        if accumulate:
+            return x + jnp.where(mask, values, 0)
+        return jnp.where(mask, values, x)
+    idx = tuple(slice(None) if i is None else i for i in indices)
+    if accumulate:
+        return x.at[idx].add(values)
+    return x.at[idx].set(values)
 
 
 @register_aten("aten.where.self")
@@ -618,7 +752,10 @@ def _to_jax_value(val):
     import torch
 
     if isinstance(val, torch.Tensor):
-        return jnp.asarray(val.detach().cpu().numpy())
+        # jnp.array COPIES (asarray of a torch-backed numpy view is
+        # zero-copy on CPU: a later in-place torch mutation would race
+        # jax's async execution and silently corrupt results)
+        return jnp.array(val.detach().cpu().numpy())
     return val
 
 

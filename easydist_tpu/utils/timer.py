@@ -1,5 +1,15 @@
-"""Benchmark timer (reference: easydist/utils/timer.py:24-56 — cuda-event
-timing there; `block_until_ready` fencing here)."""
+"""Benchmark timing (reference: easydist/utils/timer.py:24-56 — cuda-event
+timing there).
+
+One timing discipline for every measurement in the package:
+`jax.block_until_ready` does NOT block through the axon TPU tunnel (a
+chained-matmul probe once "measured" 41,180 TFLOP/s, ~200x v5e bf16 peak —
+the round-1 benchmark anomaly), so completion is forced by reading ONE
+scalar back to the host, and every measurement is two-point —
+time(n2 calls) - time(n1 calls) over (n2 - n1) — which cancels the fixed
+dispatch + roundtrip overhead.  bench.py documents the same recipe for its
+state-threading variant.
+"""
 
 from __future__ import annotations
 
@@ -7,23 +17,51 @@ import time
 from typing import Callable
 
 import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def host_sync(out) -> None:
+    """Force completion of `out`'s dependency chain via a scalar host
+    readback (immune to the tunnel's no-op block_until_ready)."""
+    leaves = [l for l in jax.tree_util.tree_leaves(out)
+              if hasattr(l, "dtype")]
+    if leaves:
+        np.asarray(jnp.sum(leaves[-1]).astype(jnp.float32))
+
+
+def two_point_time(fn: Callable, args=(), n1: int = 3, n2: int = 12,
+                   retries: int = 2) -> float:
+    """Seconds per call of `fn(*args)`, free of fixed dispatch/roundtrip
+    overhead.  Retries an inverted sample (t2 <= t1, a tunnel hiccup)
+    rather than fabricating impossible throughput; degenerate timing falls
+    back to the bounded t2/n2."""
+    def run(n):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n):
+            out = fn(*args)
+        host_sync(out)
+        return time.perf_counter() - t0
+
+    run(2)  # warm (compile caches, allocator)
+    t1 = t2 = 0.0
+    for _ in range(retries):
+        t1, t2 = run(n1), run(n2)
+        if t2 > t1:
+            return (t2 - t1) / (n2 - n1)
+    return max(t2, 1e-9) / n2
 
 
 class EDTimer:
 
-    def __init__(self, func: Callable, trials: int = 10, warmup_trials: int = 3):
+    def __init__(self, func: Callable, trials: int = 12,
+                 warmup_trials: int = 3):
         self.func = func
         self.trials = trials
         self.warmup_trials = warmup_trials
 
     def time(self) -> float:
-        """Mean seconds per call, device-fenced."""
-        out = None
-        for _ in range(self.warmup_trials):
-            out = self.func()
-        jax.block_until_ready(out)
-        start = time.perf_counter()
-        for _ in range(self.trials):
-            out = self.func()
-        jax.block_until_ready(out)
-        return (time.perf_counter() - start) / self.trials
+        """Seconds per call (two-point host-readback; see module doc)."""
+        return two_point_time(self.func, n1=max(2, self.trials // 4),
+                              n2=self.trials)

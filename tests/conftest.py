@@ -23,3 +23,14 @@ def cpu_devices():
     devices = jax.devices()
     assert len(devices) == 8, f"expected 8 virtual CPU devices, got {len(devices)}"
     return devices
+
+
+@pytest.fixture(autouse=True)
+def _hermetic_perfdb(tmp_path, monkeypatch):
+    """Tests must never read or write the user's persistent PerfDB — a
+    calibration or op-time table from a previous run would silently change
+    solver decisions under test."""
+    from easydist_tpu import config as edconfig
+
+    monkeypatch.setattr(edconfig, "prof_db_path",
+                        str(tmp_path / "perf.db"))

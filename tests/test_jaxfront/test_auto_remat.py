@@ -1,0 +1,122 @@
+"""Compiler-chosen rematerialization under a per-device HBM cap
+(VERDICT r2 #3: the reference's memory-opt subsystem re-expressed for TPU —
+easydist/torch/compile_auto.py:353-453 profile->plan->replay becomes
+liveness-plan -> recompute-rewrite on the traced jaxpr).
+
+The remat decision composes with the solver: the ILP first tries to satisfy
+the cap by sharding (its own liveness constraint); remat closes the gap the
+sharding cannot (activation-dominated programs), and an infeasible ILP cap
+degrades to an uncapped solve + remat instead of failing.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from easydist_tpu import config as edconfig
+from easydist_tpu.jaxfront import easydist_compile, make_device_mesh
+from easydist_tpu.models import GPTConfig, make_gpt_train_step
+
+
+@pytest.fixture
+def memory_cap():
+    """Restore the cap knobs after each test."""
+    saved = edconfig.per_device_memory_cap
+    yield
+    edconfig.per_device_memory_cap = saved
+
+
+def _mlp_step(L=6, D=64, B=8192):
+    def mk():
+        return [jnp.ones((D, D)) / D * (1 + 0.1 * i) for i in range(L)]
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, D))
+
+    def step(params, x):
+        def loss_fn(ps):
+            h = x
+            for w in ps:
+                h = jnp.tanh(h @ w)
+            return jnp.mean(h ** 2)
+
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        return [p - 0.1 * gi for p, gi in zip(params, g)], loss
+
+    return step, mk, x
+
+
+@pytest.mark.world_8
+def test_auto_remat_reduces_planned_peak(cpu_devices, memory_cap):
+    """An activation-dominated train step over a cap the solver cannot
+    shard its way under: the remat pass must land the planned peak below
+    the cap and preserve the math."""
+    mesh = make_device_mesh((8,), ("dp",), devices=cpu_devices)
+    step, mk, x = _mlp_step()
+
+    edconfig.per_device_memory_cap = 0
+    r0 = easydist_compile(step, mesh=mesh).get_compiled(mk(), x)
+    out0 = r0.tree_jitted(mk(), x)
+
+    cap = 1_700_000  # base planned peak is ~2.2 MB, remat floor ~1.15 MB
+    edconfig.per_device_memory_cap = cap
+    r1 = easydist_compile(step, mesh=mesh).get_compiled(mk(), x)
+    plan = r1.remat_plan
+    assert plan is not None and plan.n_remat_vars > 0
+    assert plan.base_peak > cap
+    assert plan.predicted_peak <= cap, (plan.base_peak, plan.predicted_peak)
+
+    out1 = r1.tree_jitted(mk(), x)
+    np.testing.assert_allclose(np.asarray(out0[1]), np.asarray(out1[1]),
+                               rtol=1e-5)
+    for a, b in zip(out0[0], out1[0]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.world_8
+def test_no_remat_when_program_fits(cpu_devices, memory_cap):
+    mesh = make_device_mesh((8,), ("dp",), devices=cpu_devices)
+    step, mk, x = _mlp_step(L=2, D=32, B=64)
+    edconfig.per_device_memory_cap = 1 << 30
+    r = easydist_compile(step, mesh=mesh).get_compiled(mk(), x)
+    assert r.remat_plan is None
+
+
+@pytest.mark.world_8
+@pytest.mark.long_duration
+def test_gpt_train_under_cap_matches_uncapped(cpu_devices, memory_cap):
+    """GPT train step that would not fit the cap un-remat'd: compiles with
+    a plan under the cap, loss trajectory identical to the uncapped twin
+    (the recompute rewrite must not change the math), and the solver's
+    infeasible-cap path must not be taken (sharding still satisfies its
+    own constraint at this cap)."""
+    mesh = make_device_mesh((8,), ("dp",), devices=cpu_devices)
+    cfg = GPTConfig.tiny(seq=128, dim=64, heads=4, layers=4, vocab=256)
+    step, init = make_gpt_train_step(cfg)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (32, cfg.seq), 0,
+                             cfg.vocab)
+
+    edconfig.per_device_memory_cap = 0
+    r0 = easydist_compile(step, mesh=mesh).get_compiled(
+        init(jax.random.PRNGKey(0)), tok, tok)
+    state0 = init(jax.random.PRNGKey(0))
+    losses0 = []
+    for _ in range(2):
+        state0, l = r0.tree_jitted(state0, tok, tok)
+        losses0.append(float(l))
+
+    cap = 25_000_000  # base planned peak ~37.3 MB, remat floor ~12.8 MB
+    edconfig.per_device_memory_cap = cap
+    r1 = easydist_compile(step, mesh=mesh).get_compiled(
+        init(jax.random.PRNGKey(0)), tok, tok)
+    plan = r1.remat_plan
+    assert plan is not None and plan.base_peak > cap
+    assert plan.predicted_peak <= cap, (plan.base_peak, plan.predicted_peak)
+
+    state1 = init(jax.random.PRNGKey(0))
+    losses1 = []
+    for _ in range(2):
+        state1, l = r1.tree_jitted(state1, tok, tok)
+        losses1.append(float(l))
+    np.testing.assert_allclose(losses0, losses1, rtol=1e-5)

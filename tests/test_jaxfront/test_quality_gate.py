@@ -140,3 +140,51 @@ def test_solver_chooses_sequence_parallelism_for_long_seq(cpu_devices):
     ref = jax.jit(fwd)(params, tokens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=1e-4)
+
+
+@pytest.mark.world_8
+def test_partial_deferral_reduces_collective_bytes(cpu_devices):
+    """Global PARTIAL pools + deferred-reduction regions (VERDICT r2 #4):
+    on a pinned contracted-sharded mm -> elementwise -> mm -> sum chain the
+    emitted program must move STRICTLY fewer collective bytes than the
+    no-partial plan (the fence reduces a scalar instead of the intermediate
+    matrix), with identical numerics."""
+    from easydist_tpu import config as edconfig
+    from easydist_tpu.jaxfront.scope import fix_sharding
+    from easydist_tpu.utils.hlo import collective_summary
+
+    mesh = make_device_mesh((8,), ("tp",), devices=cpu_devices)
+    k = 512
+    x = jnp.ones((4, k))
+    w1 = jax.random.normal(jax.random.PRNGKey(0), (k, k)) / k ** 0.5
+    w2 = jax.random.normal(jax.random.PRNGKey(1), (k, k)) / k ** 0.5
+
+    def step(x, w1, w2):
+        x = fix_sharding(x, None, "tp")
+        w1 = fix_sharding(w1, "tp", None)
+        y = x @ w1
+        z = y * 2.0
+        return jnp.sum(z @ w2)
+
+    def total_bytes(summary):
+        return sum(b for _, b in summary.values())
+
+    saved = edconfig.enable_partial_pools
+    try:
+        edconfig.enable_partial_pools = False
+        r0 = easydist_compile(step, mesh=mesh, state_io={}) \
+            .get_compiled(x, w1, w2)
+        base = collective_summary(r0.executable().as_text())
+
+        edconfig.enable_partial_pools = True
+        r1 = easydist_compile(step, mesh=mesh, state_io={}) \
+            .get_compiled(x, w1, w2)
+        part = collective_summary(r1.executable().as_text())
+    finally:
+        edconfig.enable_partial_pools = saved
+
+    assert total_bytes(part) < total_bytes(base), (part, base)
+    import numpy as np
+
+    np.testing.assert_allclose(float(r0.tree_jitted(x, w1, w2)),
+                               float(r1.tree_jitted(x, w1, w2)), rtol=1e-5)

@@ -1,0 +1,95 @@
+"""Two-process DCN bring-up smoke (VERDICT r2 #10: the DCN code path had
+never executed, even in simulation).
+
+Spawns two REAL `jax.distributed` processes (CPU backend, localhost
+coordinator — the same control plane a TPU pod uses over DCN,
+reference analog easydist/jax/__init__.py:36-53), builds a hybrid
+dcn x ici mesh in each, runs one XLA collective across the process
+boundary, and one easydist auto-parallel compile + execution over the
+hybrid mesh.
+"""
+
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os, sys, json
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+coordinator, rank = sys.argv[1], int(sys.argv[2])
+from easydist_tpu.runtime.elastic import multihost_setup
+multihost_setup(coordinator=coordinator, num_processes=2, process_id=rank)
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 4, len(jax.devices())
+
+from easydist_tpu.jaxfront import easydist_compile, make_device_mesh
+mesh = make_device_mesh((2, 2), ("dcn", "ici"), dcn_axes=("dcn",))
+
+# 1. raw collective crossing the process (DCN) boundary
+from jax import shard_map
+ones = jnp.ones((4, 8))
+total = jax.jit(shard_map(
+    lambda x: jax.lax.psum(x, ("dcn", "ici")), mesh=mesh,
+    in_specs=P(("dcn", "ici")), out_specs=P(), check_vma=False))(ones)
+np.testing.assert_allclose(np.asarray(total[0, 0]), 4.0)
+
+# 2. easydist auto-parallel solve + run over the hybrid mesh; the solver
+# must price the dcn axis via its MeshAxisSpec kind
+def step(w, x):
+    return jnp.tanh(x @ w).sum()
+
+w = jnp.ones((16, 16))
+x = jnp.ones((8, 16))
+res = easydist_compile(step, mesh=mesh).get_compiled(w, x)
+out = float(res.tree_jitted(w, x))
+
+from easydist_tpu.jaxfront.mesh import get_axis_specs
+kinds = {s.name: s.kind for s in get_axis_specs(mesh)}
+assert kinds == {"dcn": "dcn", "ici": "ici"}, kinds
+
+print(json.dumps({"rank": rank, "out": out}))
+"""
+
+
+@pytest.mark.world_2
+@pytest.mark.long_duration
+def test_two_process_dcn_smoke(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coordinator = f"127.0.0.1:{port}"
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, coordinator, str(rank)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env={k: v for k, v in __import__("os").environ.items()
+                 if k != "PALLAS_AXON_POOL_IPS"})
+        for rank in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        outs.append(out.strip().splitlines()[-1])
+
+    import json
+
+    vals = [json.loads(o)["out"] for o in outs]
+    assert vals[0] == vals[1]

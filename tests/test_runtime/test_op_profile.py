@@ -1,0 +1,72 @@
+"""Measured per-op costs feeding the solver (VERDICT r2 missing #1;
+reference easydist/torch/passes/runtime_prof.py:35-150 + graph_profile_db).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from easydist_tpu import config as edconfig
+from easydist_tpu.jaxfront import easydist_compile, make_device_mesh
+from easydist_tpu.runtime.op_profile import (backend_key, load_op_times,
+                                             profile_ops)
+
+
+def _step(w, x):
+    # tanh(w) is pure per-device compute on a replicated param: the proxy
+    # prices it at bytes/hbm_bw (cheap -> replicate wins, sharding it would
+    # cost collectives downstream)
+    h = jnp.tanh(w)
+    y = x @ h
+    return jnp.sum(y * y)
+
+
+def test_profile_ops_measures_and_persists():
+    w = jnp.ones((64, 64))
+    x = jnp.ones((32, 64))
+    results = profile_ops(_step, w, x)
+    assert results and all(t >= 0 for t in results.values())
+    stored = load_op_times()
+    assert set(results) <= set(stored)
+
+
+@pytest.mark.world_8
+def test_skewed_op_cost_flips_plan(cpu_devices):
+    """An artificially enormous measured time for tanh must flip its chosen
+    placement from replicate to sharded (the solver now pays 8x the
+    measured seconds for replicated execution)."""
+    from easydist_tpu.jaxfront.inline import inline_calls
+    from easydist_tpu.jaxfront.interpreter import eqn_signature
+    from easydist_tpu.runtime.perfdb import PerfDB
+
+    mesh = make_device_mesh((8,), ("dp",), devices=cpu_devices)
+    w = jnp.ones((64, 64))
+    x = jnp.ones((256, 64))
+
+    def tanh_placement(result):
+        node = next(n for n in result.graph.all_nodes()
+                    if n.op_key == "tanh")
+        strat = result.strategies[0].get(node.name)
+        assert strat is not None
+        return [p for p in strat.out_placements if p is not None]
+
+    r0 = easydist_compile(_step, mesh=mesh).get_compiled(w, x)
+    base = tanh_placement(r0)
+    assert all(p.is_replicate() for p in base), base
+
+    # skew: record 10 wall-seconds for exactly the traced tanh signature
+    closed = inline_calls(jax.make_jaxpr(_step)(w, x))
+    eqn = next(e for e in closed.jaxpr.eqns if e.primitive.name == "tanh")
+    db = PerfDB()
+    db.record_op_perf(backend_key(), eqn_signature(eqn, None), 10.0)
+    db.persist()
+
+    r1 = easydist_compile(_step, mesh=mesh).get_compiled(w, x)
+    skewed = tanh_placement(r1)
+    assert any(not p.is_replicate() for p in skewed), (
+        f"10s measured op cost did not flip the plan: {skewed}")
+
+    # outputs unchanged either way (strategy choice never changes math)
+    np.testing.assert_allclose(np.asarray(r0.tree_jitted(w, x)),
+                               np.asarray(r1.tree_jitted(w, x)), rtol=1e-5)

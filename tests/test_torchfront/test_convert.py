@@ -306,3 +306,88 @@ def test_adaptive_avg_pool2d_divisible():
             return torch.nn.functional.adaptive_avg_pool2d(x, (4, 2))
 
     assert_matches_torch(Ada(), (torch.randn(2, 3, 8, 8),))
+
+
+@pytest.mark.parametrize("rank", [1, 3])
+def test_conv_transpose_1d_3d_matches_torch(rank):
+    """conv_transpose1d/3d (VERDICT r2 missing #4): fractionally-strided
+    conv generalized over spatial rank."""
+    torch.manual_seed(0)
+    if rank == 1:
+        m = nn.ConvTranspose1d(4, 6, 3, stride=2, padding=1,
+                               output_padding=1, groups=2).eval()
+        x = torch.randn(2, 4, 9)
+    else:
+        m = nn.ConvTranspose3d(4, 6, 2, stride=2, padding=0).eval()
+        x = torch.randn(2, 4, 3, 4, 5)
+    fn, params = torch_module_to_jax(m, (x,))
+    got = fn(params, jnp.asarray(x.numpy()))
+    want = m(x).detach().numpy()
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("rank", [1, 3])
+def test_conv_1d_3d_matches_torch(rank):
+    torch.manual_seed(1)
+    if rank == 1:
+        m = nn.Conv1d(4, 8, 3, stride=2, padding=1, dilation=2).eval()
+        x = torch.randn(2, 4, 16)
+    else:
+        m = nn.Conv3d(3, 5, 2, stride=1, padding=1).eval()
+        x = torch.randn(2, 3, 4, 5, 6)
+    fn, params = torch_module_to_jax(m, (x,))
+    got = fn(params, jnp.asarray(x.numpy()))
+    want = m(x).detach().numpy()
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("size,out", [((7, 9), (3, 4)), ((10, 10), (3, 3)),
+                                      ((5, 8), (5, 3))])
+def test_adaptive_avg_pool2d_general_matches_torch(size, out):
+    """Non-divisible adaptive pooling: torch's variable windows become one
+    static weight-matrix contraction per spatial dim."""
+
+    class M(nn.Module):
+        def forward(self, x):
+            return nn.functional.adaptive_avg_pool2d(x, out)
+
+    torch.manual_seed(2)
+    x = torch.randn(2, 3, *size)
+    m = M().eval()
+    fn, params = torch_module_to_jax(m, (x,))
+    got = fn(params, jnp.asarray(x.numpy()))
+    want = m(x).detach().numpy()
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-6)
+
+
+def test_adaptive_avg_pool1d_matches_torch():
+    class M(nn.Module):
+        def forward(self, x):
+            return nn.functional.adaptive_avg_pool1d(x, 5)
+
+    torch.manual_seed(3)
+    x = torch.randn(2, 4, 13)
+    m = M().eval()
+    fn, params = torch_module_to_jax(m, (x,))
+    got = fn(params, jnp.asarray(x.numpy()))
+    np.testing.assert_allclose(np.asarray(got), m(x).detach().numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_boolean_mask_index_put_matches_torch():
+    """x[mask] = v keeps static shapes (a where), unlike boolean-mask READS
+    (data-dependent shapes, still rejected with a clear message)."""
+
+    class M(nn.Module):
+        def forward(self, x):
+            y = x.clone()
+            y[x < 0] = 0.0
+            return y * 2
+
+    torch.manual_seed(4)
+    x = torch.randn(4, 6)
+    m = M().eval()
+    fn, params = torch_module_to_jax(m, (x,))
+    got = fn(params, jnp.asarray(x.numpy()))
+    np.testing.assert_allclose(np.asarray(got), m(x).detach().numpy(),
+                               rtol=1e-6, atol=1e-7)
