@@ -46,6 +46,10 @@ class PartialRegion:
     # region-produced vars read outside the region (fence: psum) mapped to
     # whether they are P (need the psum) at region exit
     fence_partial: Set[object] = field(default_factory=set)
+    # fence vars whose every outside consumer wants S(dim): the fence pays
+    # psum_scatter (half the wire bytes of the all_reduce) and exits
+    # sharded
+    fence_scatter: Dict[object, int] = field(default_factory=dict)
 
 
 def find_partial_regions(jaxpr, per_axis: Sequence[Dict], axis_names,
@@ -143,14 +147,28 @@ def find_partial_regions(jaxpr, per_axis: Sequence[Dict], axis_names,
                     last_strat = {}
                 last_strat.update(p_out)
             consumed_later: Set[object] = set()
+            consumer_placements: Dict[object, List] = {}
             for j in range(end + 1, len(eqns)):
+                s_j = strat(a, j)
+                pos = 0
                 for v in eqns[j].invars:
-                    if not isinstance(v, jex_core.Literal):
-                        consumed_later.add(v)
+                    if isinstance(v, jex_core.Literal):
+                        continue
+                    consumed_later.add(v)
+                    if v in produced:
+                        p = (s_j.in_placements[pos] if s_j
+                             and pos < len(s_j.in_placements) else None)
+                        consumer_placements.setdefault(v, []).append(p)
+                    pos += 1
             for v in list(produced):
                 if v in consumed_later or v in out_set:
                     if last_strat.get(v):
                         region.fence_partial.add(v)
+                        ps = consumer_placements.get(v, [])
+                        if ps and v not in out_set and all(
+                                p is not None and p.is_shard() for p in ps) \
+                                and len({p.dim for p in ps}) == 1:
+                            region.fence_scatter[v] = ps[0].dim
             regions.append(region)
     # keep non-overlapping regions only (one axis per run; first wins)
     taken: Set[int] = set()
@@ -202,6 +220,15 @@ def emit_region(region: PartialRegion, jaxpr, env, mesh):
                 outs.append(v)
 
     axis = region.axis_name
+    axis_count = mesh.shape[axis]
+    # P->S fence eligibility, decided once (body and out_specs must agree)
+    scatter_dim = {}
+    for v in outs:
+        d = region.fence_scatter.get(v)
+        if v in region.fence_partial and d is not None \
+                and d < len(v.aval.shape) \
+                and v.aval.shape[d] % axis_count == 0:
+            scatter_dim[v] = d
 
     def body(*src_vals):
         local = dict(zip(sources, src_vals))
@@ -220,7 +247,12 @@ def emit_region(region: PartialRegion, jaxpr, env, mesh):
         result = []
         for v in outs:
             val = local[v]
-            if v in region.fence_partial:
+            if v in scatter_dim:
+                # P -> S fence: half the wire bytes of the all_reduce,
+                # and the consumer wanted the shard anyway
+                val = jax.lax.psum_scatter(
+                    val, axis, scatter_dimension=scatter_dim[v], tiled=True)
+            elif v in region.fence_partial:
                 val = jax.lax.psum(val, axis)  # THE deferred reduction
             result.append(val)
         return tuple(result)
@@ -233,8 +265,16 @@ def emit_region(region: PartialRegion, jaxpr, env, mesh):
             entries[d] = axis
         return PartitionSpec(*entries)
 
+    def out_spec_for(v):
+        d = scatter_dim.get(v)
+        if d is None:
+            return PartitionSpec()
+        entries = [None] * len(v.aval.shape)
+        entries[d] = axis
+        return PartitionSpec(*entries)
+
     in_specs = tuple(spec_for(v) for v in sources)
-    out_specs = tuple(PartitionSpec() for _ in outs)
+    out_specs = tuple(out_spec_for(v) for v in outs)
     auto = frozenset(mesh.axis_names) - {axis}
     kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                   check_vma=False)

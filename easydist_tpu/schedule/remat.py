@@ -404,12 +404,15 @@ def plan_remat(closed_jaxpr, names, per_axis: Sequence[Dict],
 
 
 def resolve_memory_cap(mesh) -> int:
-    """Per-device HBM budget in bytes.  Config wins when set (>0); 0
-    disables; the default (-1) asks the real device (TPU memory_stats
-    bytes_limit).  Unknown (CPU virtual meshes) -> uncapped."""
+    """Per-device HBM budget in bytes, with `memory_ratio` headroom
+    applied uniformly (the solver's liveness constraint scales the same
+    way — an explicit cap without the ratio would ship programs with none
+    of the allocator headroom the ratio exists to provide).  Config wins
+    when set (>0); 0 disables; the default (-1) asks the real device (TPU
+    memory_stats bytes_limit).  Unknown (CPU virtual meshes) -> uncapped."""
     cap = edconfig.per_device_memory_cap
     if cap >= 0:
-        return cap
+        return int(cap * edconfig.memory_ratio) if cap > 0 else 0
     try:
         dev = np.asarray(mesh.devices).flat[0]
         stats = dev.memory_stats()

@@ -314,12 +314,6 @@ def _conv_transpose_nd(x, w, bias, stride, padding, dilation, output_padding,
     return out
 
 
-def _conv_transpose2d(x, w, bias, stride, padding, dilation, output_padding,
-                      groups):
-    return _conv_transpose_nd(x, w, bias, stride, padding, dilation,
-                              output_padding, groups)
-
-
 @register_aten("aten.conv_transpose1d.default")
 @register_aten("aten.conv_transpose2d.input")
 @register_aten("aten.conv_transpose3d.input")
@@ -680,11 +674,20 @@ def _index_put(x, indices, values, accumulate=False):
             raise UnsupportedAtenOp(
                 "index_put with a boolean mask and a non-scalar values "
                 "tensor (selection-ordered fill is data-dependent)")
-        mask = masks[0]
-        for m in masks[1:]:
-            mask = mask & m
-        if mask.ndim < x.ndim:  # leading-dim mask broadcasts over the rest
-            mask = mask.reshape(mask.shape + (1,) * (x.ndim - mask.ndim))
+        # a mask at index position k covers dims k..k+mask.ndim-1 (torch
+        # advanced-indexing semantics; `x[:, m]` exports as [None, m]) —
+        # place each mask's dims at its position and AND them together
+        mask = None
+        pos = 0
+        for i in indices:
+            if i is None:
+                pos += 1
+                continue
+            shape = [1] * pos + list(i.shape) \
+                + [1] * (x.ndim - pos - i.ndim)
+            m = i.reshape(shape)
+            mask = m if mask is None else mask & m
+            pos += i.ndim
         if accumulate:
             return x + jnp.where(mask, values, 0)
         return jnp.where(mask, values, x)

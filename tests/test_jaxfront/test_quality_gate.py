@@ -188,3 +188,52 @@ def test_partial_deferral_reduces_collective_bytes(cpu_devices):
 
     np.testing.assert_allclose(float(r0.tree_jitted(x, w1, w2)),
                                float(r1.tree_jitted(x, w1, w2)), rtol=1e-5)
+
+
+@pytest.mark.world_8
+def test_partial_region_psum_scatter_fence(cpu_devices):
+    """A fence whose consumers all want S(dim) pays psum_scatter (half the
+    all_reduce wire bytes) and exits sharded — exactness against the
+    unsharded program."""
+    import numpy as np
+
+    from easydist_tpu.jaxfront.inline import inline_calls
+    from easydist_tpu.jaxfront.partial_regions import (PartialRegion,
+                                                       emit_region)
+
+    mesh = make_device_mesh((8,), ("tp",), devices=cpu_devices)
+    k = 64
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, k))
+    w = jax.random.normal(jax.random.PRNGKey(1), (k, 32))
+
+    def chain(x, w):
+        y = x @ w
+        return y * 2.0
+
+    closed = inline_calls(jax.make_jaxpr(chain)(x, w))
+    jaxpr = closed.jaxpr
+    dot_eqn = next(i for i, e in enumerate(jaxpr.eqns)
+                   if e.primitive.name == "dot_general")
+    mul_eqn = next(i for i, e in enumerate(jaxpr.eqns)
+                   if e.primitive.name == "mul")
+    region = PartialRegion(start=dot_eqn, end=mul_eqn, axis_idx=0,
+                           axis_name="tp")
+    xv, wv = jaxpr.eqns[dot_eqn].invars[0], jaxpr.eqns[dot_eqn].invars[1]
+    region.source_shard_dim = {xv: 1, wv: 0}  # contracted-dim sharding
+    out_var = jaxpr.eqns[mul_eqn].outvars[0]
+    region.fence_partial = {out_var}
+    region.fence_scatter = {out_var: 0}  # consumers want row shards
+
+    def run(x, w):
+        env = {xv: x, wv: w}
+        emit_region(region, jaxpr, env, mesh)
+        return env[out_var]
+
+    jitted = jax.jit(run)
+    got = np.asarray(jitted(x, w))
+    want = np.asarray(chain(x, w))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    hlo = jitted.lower(x, w).compile().as_text()
+    assert "reduce-scatter" in hlo, "fence did not lower to reduce-scatter"
+    assert "all-reduce" not in hlo

@@ -391,3 +391,27 @@ def test_boolean_mask_index_put_matches_torch():
     got = fn(params, jnp.asarray(x.numpy()))
     np.testing.assert_allclose(np.asarray(got), m(x).detach().numpy(),
                                rtol=1e-6, atol=1e-7)
+
+
+def test_boolean_mask_index_put_non_leading_dim():
+    """A column mask `x[:, m] = 0` must zero COLUMNS (the mask's index
+    position decides the covered dims, not the leading dims)."""
+
+    class M(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.register_buffer("m", torch.tensor([True, False, True,
+                                                    False, True, False]))
+
+        def forward(self, x):
+            y = x.clone()
+            y[:, self.m] = 0.0
+            return y + 1
+
+    torch.manual_seed(5)
+    x = torch.randn(6, 6)
+    m = M().eval()
+    fn, params = torch_module_to_jax(m, (x,))
+    got = fn(params, jnp.asarray(x.numpy()))
+    np.testing.assert_allclose(np.asarray(got), m(x).detach().numpy(),
+                               rtol=1e-6, atol=1e-7)
