@@ -34,28 +34,32 @@ def adam_init(params):
 def adam_update(params, grads, state, lr=1e-3, b1=0.9, b2=0.999, eps=1e-8,
                 weight_decay=0.0, decoupled=False):
     """torch.optim.Adam semantics; `decoupled=True` gives AdamW (weight
-    decay applied directly to the parameter, not folded into the grad)."""
+    decay applied directly to the parameter, not folded into the grad).
+    `b1`/`b2` accept scalars or per-leaf trees (per-group betas)."""
     lr_t = _hyper_tree(lr, params)
     wd_t = _hyper_tree(weight_decay, params)
+    b1_t = _hyper_tree(b1, params)
+    b2_t = _hyper_tree(b2, params)
     if not decoupled:
         grads = jax.tree_util.tree_map(lambda g, p, wd: g + wd * p,
                                        grads, params, wd_t)
     count = state["count"] + 1
-    mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g,
-                                state["mu"], grads)
-    nu = jax.tree_util.tree_map(lambda v, g: b2 * v + (1 - b2) * g * g,
-                                state["nu"], grads)
-    c1 = 1 - b1 ** count.astype(jnp.float32)
-    c2 = 1 - b2 ** count.astype(jnp.float32)
+    fcount = count.astype(jnp.float32)
+    mu = jax.tree_util.tree_map(lambda m, g, b1_: b1_ * m + (1 - b1_) * g,
+                                state["mu"], grads, b1_t)
+    nu = jax.tree_util.tree_map(lambda v, g, b2_: b2_ * v + (1 - b2_) * g * g,
+                                state["nu"], grads, b2_t)
     if decoupled:
         new_params = jax.tree_util.tree_map(
-            lambda p, m, v, lr_, wd_: p - lr_ * (
-                (m / c1) / (jnp.sqrt(v / c2) + eps) + wd_ * p),
-            params, mu, nu, lr_t, wd_t)
+            lambda p, m, v, lr_, wd_, b1_, b2_: p - lr_ * (
+                (m / (1 - b1_ ** fcount))
+                / (jnp.sqrt(v / (1 - b2_ ** fcount)) + eps) + wd_ * p),
+            params, mu, nu, lr_t, wd_t, b1_t, b2_t)
     else:
         new_params = jax.tree_util.tree_map(
-            lambda p, m, v, lr_: p - lr_ * (m / c1) / (jnp.sqrt(v / c2) + eps),
-            params, mu, nu, lr_t)
+            lambda p, m, v, lr_, b1_, b2_: p - lr_ * (m / (1 - b1_ ** fcount))
+            / (jnp.sqrt(v / (1 - b2_ ** fcount)) + eps),
+            params, mu, nu, lr_t, b1_t, b2_t)
     return new_params, {"mu": mu, "nu": nu, "count": count}
 
 
@@ -63,6 +67,69 @@ def adamw_update(params, grads, state, lr=1e-3, b1=0.9, b2=0.999, eps=1e-8,
                  weight_decay=1e-2):
     return adam_update(params, grads, state, lr=lr, b1=b1, b2=b2, eps=eps,
                        weight_decay=weight_decay, decoupled=True)
+
+
+def rmsprop_init(params, momentum=0.0, centered=False):
+    state = {"sq": jax.tree_util.tree_map(jnp.zeros_like, params)}
+    if momentum:
+        state["buf"] = jax.tree_util.tree_map(jnp.zeros_like, params)
+    if centered:
+        state["gavg"] = jax.tree_util.tree_map(jnp.zeros_like, params)
+    return state
+
+
+def rmsprop_update(params, grads, state, lr=1e-2, alpha=0.99, eps=1e-8,
+                   weight_decay=0.0, momentum=0.0, centered=False):
+    """torch.optim.RMSprop semantics (square-avg EMA; optional heavy-ball
+    momentum on the preconditioned grad; optional centered variant)."""
+    lr_t = _hyper_tree(lr, params)
+    wd_t = _hyper_tree(weight_decay, params)
+    grads = jax.tree_util.tree_map(lambda g, p, wd: g + wd * p,
+                                   grads, params, wd_t)
+    sq = jax.tree_util.tree_map(lambda s, g: alpha * s + (1 - alpha) * g * g,
+                                state["sq"], grads)
+    new_state = {"sq": sq}
+    if centered:
+        gavg = jax.tree_util.tree_map(
+            lambda a, g: alpha * a + (1 - alpha) * g, state["gavg"], grads)
+        new_state["gavg"] = gavg
+        denom = jax.tree_util.tree_map(
+            lambda s, a: jnp.sqrt(s - a * a) + eps, sq, gavg)
+    else:
+        denom = jax.tree_util.tree_map(lambda s: jnp.sqrt(s) + eps, sq)
+    if momentum:
+        buf = jax.tree_util.tree_map(lambda b, g, d: momentum * b + g / d,
+                                     state["buf"], grads, denom)
+        new_state["buf"] = buf
+        new_params = jax.tree_util.tree_map(lambda p, b, lr_: p - lr_ * b,
+                                            params, buf, lr_t)
+    else:
+        new_params = jax.tree_util.tree_map(
+            lambda p, g, d, lr_: p - lr_ * g / d, params, grads, denom, lr_t)
+    return new_params, new_state
+
+
+def adagrad_init(params, initial_accumulator_value=0.0):
+    return {"sum": jax.tree_util.tree_map(
+                lambda p: jnp.full_like(p, initial_accumulator_value),
+                params),
+            "count": jnp.zeros((), jnp.int32)}
+
+
+def adagrad_update(params, grads, state, lr=1e-2, lr_decay=0.0, eps=1e-10,
+                   weight_decay=0.0):
+    """torch.optim.Adagrad semantics (accumulated squared grads; lr decayed
+    by 1/(1 + step*lr_decay) with step counted from 0)."""
+    lr_t = _hyper_tree(lr, params)
+    wd_t = _hyper_tree(weight_decay, params)
+    grads = jax.tree_util.tree_map(lambda g, p, wd: g + wd * p,
+                                   grads, params, wd_t)
+    acc = jax.tree_util.tree_map(lambda s, g: s + g * g, state["sum"], grads)
+    decay = 1.0 + state["count"].astype(jnp.float32) * lr_decay
+    new_params = jax.tree_util.tree_map(
+        lambda p, g, s, lr_: p - (lr_ / decay) * g / (jnp.sqrt(s) + eps),
+        params, grads, acc, lr_t)
+    return new_params, {"sum": acc, "count": state["count"] + 1}
 
 
 def sgd_init(params):

@@ -16,8 +16,9 @@ from typing import Callable, Optional
 import jax
 
 from easydist_tpu.jaxfront.api import easydist_compile
-from easydist_tpu.models.optim import (adam_init, adam_update, sgd_init,
-                                       sgd_update)
+from easydist_tpu.models.optim import (adagrad_init, adagrad_update,
+                                       adam_init, adam_update, rmsprop_init,
+                                       rmsprop_update, sgd_init, sgd_update)
 from .convert import torch_module_to_jax
 
 
@@ -33,23 +34,23 @@ def easydist_compile_torch(module, example_args, mesh=None, **kwargs):
 
 
 def _translate_torch_optimizer(optimizer, module):
-    """torch.optim instance -> ("adam"/"adamw"/"sgd", hyperparams, state
-    translator) (reference: the user's own torch optimizer captured by fx
-    tracing, torch/compile.py:25-95; here translated into the equivalent jax
-    update).
+    """torch.optim instance -> (kind, hyperparams, state translator)
+    (reference: the user's own torch optimizer captured by fx tracing,
+    torch/compile.py:25-95; here translated into the equivalent jax update).
+    Kinds: Adam, AdamW, SGD, RMSprop, Adagrad.
 
-    Multiple param groups translate into per-parameter lr/weight_decay
-    TREES (models/optim.py broadcasts them leafwise); a param absent from
-    every group gets lr 0 (torch would never step it).  Betas/eps/momentum
-    must be uniform across groups.
+    Multiple param groups translate into per-parameter lr/weight_decay (and
+    for Adam, betas) TREES (models/optim.py broadcasts them leafwise); a
+    param absent from every group gets lr 0 (torch would never step it).
+    Other hyperparameters must be uniform across groups.
     """
     name_of = {id(p): n for n, p in module.named_parameters()}
     groups = optimizer.param_groups
     kind = type(optimizer).__name__.lower()
-    if kind not in ("adam", "adamw", "sgd"):
+    if kind not in ("adam", "adamw", "sgd", "rmsprop", "adagrad"):
         raise NotImplementedError(
             f"torch optimizer {type(optimizer).__name__} not supported "
-            f"(Adam, AdamW and SGD are)")
+            f"(Adam, AdamW, SGD, RMSprop and Adagrad are)")
 
     def uniform(key, default=None):
         vals = {repr(g.get(key, default)) for g in groups}
@@ -70,54 +71,120 @@ def _translate_torch_optimizer(optimizer, module):
             lr_tree[qual] = float(g["lr"])
             wd_tree[qual] = float(g.get("weight_decay", 0.0))
     multi = len(groups) > 1
+    lr_h = lr_tree if multi else groups[0]["lr"]
+    wd_h = wd_tree if multi else groups[0].get("weight_decay", 0.0)
 
     if kind in ("adam", "adamw"):
         if uniform("amsgrad", False) or uniform("maximize", False):
             raise NotImplementedError("Adam amsgrad/maximize not supported")
-        betas = uniform("betas")
-        hyper = {"lr": lr_tree if multi else groups[0]["lr"],
-                 "b1": betas[0], "b2": betas[1], "eps": uniform("eps"),
-                 "weight_decay": wd_tree if multi
-                 else groups[0].get("weight_decay", 0.0),
-                 "decoupled": kind == "adamw"}
+        betas = {repr(g["betas"]) for g in groups}
+        if len(betas) == 1:
+            b1, b2 = groups[0]["betas"]
+        else:  # per-group betas -> per-leaf trees (default where unlisted)
+            b1 = {n: 0.9 for n in name_of.values()}
+            b2 = {n: 0.999 for n in name_of.values()}
+            for g in groups:
+                for p in g["params"]:
+                    qual = name_of[id(p)]
+                    b1[qual], b2[qual] = map(float, g["betas"])
+        hyper = {"lr": lr_h, "b1": b1, "b2": b2, "eps": uniform("eps"),
+                 "weight_decay": wd_h, "decoupled": kind == "adamw"}
+    elif kind == "rmsprop":
+        hyper = {"lr": lr_h, "alpha": float(uniform("alpha", 0.99)),
+                 "eps": float(uniform("eps", 1e-8)),
+                 "momentum": float(uniform("momentum", 0.0) or 0.0),
+                 "centered": bool(uniform("centered", False)),
+                 "weight_decay": wd_h}
+    elif kind == "adagrad":
+        adagrad_iav = float(uniform("initial_accumulator_value", 0.0))
+        hyper = {"lr": lr_h, "lr_decay": float(uniform("lr_decay", 0.0)),
+                 "eps": float(uniform("eps", 1e-10)),
+                 "weight_decay": wd_h,
+                 "initial_accumulator_value": adagrad_iav}
     else:  # sgd
-        hyper = {"lr": lr_tree if multi else groups[0]["lr"],
+        hyper = {"lr": lr_h,
                  "momentum": float(uniform("momentum", 0.0) or 0.0),
                  "nesterov": bool(uniform("nesterov", False)),
-                 "weight_decay": wd_tree if multi
-                 else groups[0].get("weight_decay", 0.0)}
+                 "weight_decay": wd_h}
 
     def translate_state(params0):
-        """Carry over a warm optimizer's exp_avg/exp_avg_sq/step (adam) or
-        momentum buffers (sgd)."""
+        """Carry over a warm optimizer's buffers: exp_avg/exp_avg_sq/step
+        (adam), momentum buffers (sgd), square_avg/grad_avg (rmsprop),
+        sum/step (adagrad)."""
         import jax.numpy as jnp
         import numpy as np
+
+        def t(tensor):
+            return jnp.array(tensor.detach().numpy())
 
         if kind == "sgd":
             if not hyper["momentum"]:
                 return None
-            opt = sgd_init({k: v for k, v in params0.items()})
+            opt = sgd_init(dict(params0))
             for p, st in optimizer.state.items():
                 qual = name_of.get(id(p))
                 if qual is None or st.get("momentum_buffer") is None:
                     continue
-                opt["buf"][qual] = jnp.array(
-                    st["momentum_buffer"].detach().numpy())
+                opt["buf"][qual] = t(st["momentum_buffer"])
             return opt
-        opt = adam_init({k: v for k, v in params0.items()})
+        if kind == "rmsprop":
+            opt = rmsprop_init(dict(params0), momentum=hyper["momentum"],
+                               centered=hyper["centered"])
+            for p, st in optimizer.state.items():
+                qual = name_of.get(id(p))
+                if qual is None or "square_avg" not in st:
+                    continue
+                opt["sq"][qual] = t(st["square_avg"])
+                if "buf" in opt and st.get("momentum_buffer") is not None:
+                    opt["buf"][qual] = t(st["momentum_buffer"])
+                if "gavg" in opt and st.get("grad_avg") is not None:
+                    opt["gavg"][qual] = t(st["grad_avg"])
+            return opt
+        if kind == "adagrad":
+            # hyper's copy is popped by _stateful_opt_fns before init runs
+            opt = adagrad_init(dict(params0),
+                               initial_accumulator_value=adagrad_iav)
+            step_count = 0
+            for p, st in optimizer.state.items():
+                qual = name_of.get(id(p))
+                if qual is None or "sum" not in st:
+                    continue
+                opt["sum"][qual] = t(st["sum"])
+                step_count = int(st["step"])
+            opt["count"] = jnp.asarray(np.int32(step_count))
+            return opt
+        opt = adam_init(dict(params0))
         step_count = 0
         for p, st in optimizer.state.items():
             qual = name_of.get(id(p))
             if qual is None or "exp_avg" not in st:
                 continue
-            opt["mu"][qual] = jnp.array(st["exp_avg"].detach().numpy())
-            opt["nu"][qual] = jnp.array(st["exp_avg_sq"].detach().numpy())
+            opt["mu"][qual] = t(st["exp_avg"])
+            opt["nu"][qual] = t(st["exp_avg_sq"])
             step_count = int(st["step"])
         opt["count"] = jnp.asarray(np.int32(step_count))
         return opt
 
     # adamw rides the adam code path (decoupled flag in hyper)
     return ("adam" if kind == "adamw" else kind), hyper, translate_state
+
+
+def _stateful_opt_fns(optimizer, hyper):
+    """(init(params), update(params, grads, state, lr, **hyper)) for the
+    stateful optimizer kinds; None for sgd (handled separately — its
+    momentum-free form is stateless)."""
+    if optimizer == "adam":
+        return adam_init, adam_update
+    if optimizer == "rmsprop":
+        mom = hyper.get("momentum", 0.0)
+        cen = hyper.get("centered", False)
+        return (lambda p: rmsprop_init(p, momentum=mom, centered=cen),
+                rmsprop_update)
+    if optimizer == "adagrad":
+        iav = hyper.pop("initial_accumulator_value", 0.0)
+        return (lambda p: adagrad_init(p, initial_accumulator_value=iav),
+                adagrad_update)
+    return None
 
 
 def make_torch_train_step(module, example_args, loss_fn: Callable,
@@ -127,9 +194,11 @@ def make_torch_train_step(module, example_args, loss_fn: Callable,
     """Build an auto-parallelized train step from a torch module.
 
     loss_fn(outputs, *targets) -> scalar jax loss.
-    optimizer: "adam" / "sgd", or a torch.optim.Adam/SGD INSTANCE built on
-    this module — its hyperparameters and (for a warm Adam) its
-    exp_avg/exp_avg_sq/step state are translated into the jax update.
+    optimizer: "adam" / "sgd" / "rmsprop" / "adagrad", or a torch.optim
+    Adam/AdamW/SGD/RMSprop/Adagrad INSTANCE built on this module — its
+    hyperparameters (incl. per-group lr/weight_decay/betas) and warm
+    buffers (exp_avg/exp_avg_sq/step, momentum, square_avg, sum) are
+    translated into the jax update.
     parallel_mode: "auto" (solver-chosen SPMD, the default) or the manual
     modes "ddp" / "zero2" / "zero3" (reference torch/api.py parallel_mode
     kwarg, compile_dp.py) — manual modes shard the batch over the mesh's
@@ -204,11 +273,14 @@ def make_torch_train_step(module, example_args, loss_fn: Callable,
             return step, lambda: init_state3(params0)
         raise ValueError(f"unknown parallel_mode {parallel_mode!r}")
 
-    if optimizer == "adam":
+    opt_fns = _stateful_opt_fns(optimizer, hyper)
+    if opt_fns is not None:
+        opt_init, opt_update = opt_fns
+
         def init_state():
             opt = translate_state(trainable0) if translate_state else None
             return (params0,
-                    opt if opt is not None else adam_init(trainable0))
+                    opt if opt is not None else opt_init(trainable0))
 
         def step(state, inputs, *targets):
             params, opt = state
@@ -220,8 +292,8 @@ def make_torch_train_step(module, example_args, loss_fn: Callable,
                 return loss_fn(fwd({**tp, **buffers}, inputs), *targets)
 
             loss, grads = jax.value_and_grad(objective)(trainable)
-            new_tp, new_opt = adam_update(trainable, grads, opt, lr=lr,
-                                          **hyper)
+            new_tp, new_opt = opt_update(trainable, grads, opt, lr=lr,
+                                         **hyper)
             return ({**new_tp, **buffers}, new_opt), loss
     elif optimizer == "sgd" and hyper.get("momentum"):
         def init_state():
@@ -273,11 +345,14 @@ def _make_train_mode_step(module, example_args, loss_fn, optimizer, lr,
                   if k not in buffer_names}
     buffers0 = {k: v for k, v in params0.items() if k in buffer_names}
 
-    if optimizer == "adam":
+    opt_fns = _stateful_opt_fns(optimizer, hyper)
+    if opt_fns is not None:
+        opt_init, opt_update = opt_fns
+
         def init_state():
             opt = translate_state(trainable0) if translate_state else None
             return ((trainable0, buffers0),
-                    opt if opt is not None else adam_init(trainable0))
+                    opt if opt is not None else opt_init(trainable0))
 
         def step(state, rng, inputs, *targets):
             (trainable, buffers), opt = state
@@ -288,8 +363,8 @@ def _make_train_mode_step(module, example_args, loss_fn, optimizer, lr,
 
             (loss, new_buf), grads = jax.value_and_grad(
                 objective, has_aux=True)(trainable)
-            new_tp, new_opt = adam_update(trainable, grads, opt, lr=lr,
-                                          **hyper)
+            new_tp, new_opt = opt_update(trainable, grads, opt, lr=lr,
+                                         **hyper)
             return ((new_tp, {**buffers, **new_buf}), new_opt), loss
     elif optimizer == "sgd" and hyper.get("momentum"):
         def init_state():

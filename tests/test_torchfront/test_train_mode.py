@@ -130,8 +130,8 @@ def test_torch_adam_instance_translation(cpu_devices):
 
 def test_unsupported_torch_optimizer_raises():
     module = nn.Linear(4, 4)
-    opt = torch.optim.RMSprop(module.parameters())
-    with pytest.raises(NotImplementedError, match="RMSprop"):
+    opt = torch.optim.Adadelta(module.parameters())
+    with pytest.raises(NotImplementedError, match="Adadelta"):
         make_torch_train_step(module.eval(), (torch.randn(2, 4),), _mse,
                               optimizer=opt,
                               mesh=make_device_mesh((8,), ("d",)))
@@ -177,6 +177,108 @@ def test_torch_adamw_two_groups_translation(cpu_devices):
         {"params": decay, "weight_decay": 0.1, "lr": 3e-3},
         {"params": no_decay, "weight_decay": 0.0, "lr": 1e-3},
     ], betas=(0.85, 0.97), eps=1e-7)
+
+    step, init_state = make_torch_train_step(
+        module, (x,), _mse, optimizer=opt, mesh=mesh, donate_state=False)
+    state = init_state()
+    jx, jy = jnp.asarray(x.numpy()), jnp.asarray(y.numpy())
+    for _ in range(5):
+        state, loss = step(state, jx, jy)
+        opt.zero_grad()
+        ((module(x) - y) ** 2).mean().backward()
+        opt.step()
+
+    params, _ = state
+    ref_sd = {k: v.detach().numpy() for k, v in module.state_dict().items()}
+    for k, v in params.items():
+        np.testing.assert_allclose(np.asarray(v), ref_sd[k],
+                                   rtol=2e-4, atol=1e-5, err_msg=k)
+
+
+@pytest.mark.world_8
+def test_torch_rmsprop_translation(cpu_devices):
+    """RMSprop (centered, with momentum and weight decay), including WARM
+    square-avg/momentum/grad-avg buffers, matches eager torch."""
+    mesh = make_device_mesh((8,), ("d",))
+    torch.manual_seed(4)
+    module = nn.Sequential(nn.Linear(10, 6), nn.Tanh(),
+                           nn.Linear(6, 4)).eval()
+    x = torch.randn(16, 10)
+    y = torch.randn(16, 4)
+    opt = torch.optim.RMSprop(module.parameters(), lr=4e-3, alpha=0.95,
+                              eps=1e-7, momentum=0.8, centered=True,
+                              weight_decay=0.02)
+    for _ in range(2):  # warm the buffers
+        opt.zero_grad()
+        ((module(x) - y) ** 2).mean().backward()
+        opt.step()
+
+    step, init_state = make_torch_train_step(
+        module, (x,), _mse, optimizer=opt, mesh=mesh, donate_state=False)
+    state = init_state()
+    jx, jy = jnp.asarray(x.numpy()), jnp.asarray(y.numpy())
+    for _ in range(4):
+        state, loss = step(state, jx, jy)
+        opt.zero_grad()
+        ((module(x) - y) ** 2).mean().backward()
+        opt.step()
+
+    params, _ = state
+    ref_sd = {k: v.detach().numpy() for k, v in module.state_dict().items()}
+    for k, v in params.items():
+        np.testing.assert_allclose(np.asarray(v), ref_sd[k],
+                                   rtol=2e-4, atol=1e-5, err_msg=k)
+
+
+@pytest.mark.world_8
+def test_torch_adagrad_translation(cpu_devices):
+    """Adagrad with lr_decay + weight decay + nonzero initial accumulator,
+    warm sum/step state, matches eager torch."""
+    mesh = make_device_mesh((8,), ("d",))
+    torch.manual_seed(5)
+    module = nn.Sequential(nn.Linear(8, 8), nn.Tanh()).eval()
+    x = torch.randn(16, 8)
+    y = torch.randn(16, 8)
+    opt = torch.optim.Adagrad(module.parameters(), lr=5e-2, lr_decay=0.01,
+                              weight_decay=0.03,
+                              initial_accumulator_value=0.1)
+    for _ in range(2):  # warm the accumulators
+        opt.zero_grad()
+        ((module(x) - y) ** 2).mean().backward()
+        opt.step()
+
+    step, init_state = make_torch_train_step(
+        module, (x,), _mse, optimizer=opt, mesh=mesh, donate_state=False)
+    state = init_state()
+    jx, jy = jnp.asarray(x.numpy()), jnp.asarray(y.numpy())
+    for _ in range(4):
+        state, loss = step(state, jx, jy)
+        opt.zero_grad()
+        ((module(x) - y) ** 2).mean().backward()
+        opt.step()
+
+    params, _ = state
+    ref_sd = {k: v.detach().numpy() for k, v in module.state_dict().items()}
+    for k, v in params.items():
+        np.testing.assert_allclose(np.asarray(v), ref_sd[k],
+                                   rtol=2e-4, atol=1e-5, err_msg=k)
+
+
+@pytest.mark.world_8
+def test_torch_adam_per_group_betas(cpu_devices):
+    """Per-group betas translate into per-leaf b1/b2 trees (ROADMAP #4)."""
+    mesh = make_device_mesh((8,), ("d",))
+    torch.manual_seed(6)
+    module = nn.Sequential(nn.Linear(12, 8), nn.Tanh(),
+                           nn.Linear(8, 4)).eval()
+    x = torch.randn(16, 12)
+    y = torch.randn(16, 4)
+    weights = [p for n, p in module.named_parameters() if "weight" in n]
+    biases = [p for n, p in module.named_parameters() if "bias" in n]
+    opt = torch.optim.Adam([
+        {"params": weights, "betas": (0.8, 0.95), "lr": 2e-3},
+        {"params": biases, "betas": (0.95, 0.999), "lr": 1e-3},
+    ])
 
     step, init_state = make_torch_train_step(
         module, (x,), _mse, optimizer=opt, mesh=mesh, donate_state=False)
