@@ -8,7 +8,13 @@ by `comm_overlap_ratio`.
 
 The closure is a dense numpy bool matrix (row i = descendants of op i;
 column i = its ancestors), built in one reverse-topological vectorized
-sweep; per-edge independent FLOPs are then single vectorized masks."""
+sweep; per-edge independent peer time is then a single vectorized mask.
+
+Op time model: MXU-bound ops (dots/convs) are priced FLOPs/peak_flops;
+everything else is memory-bound on TPU, priced bytes_touched/hbm_bandwidth —
+a flat FLOP count at MXU peak would under-state elementwise/reduce time by
+~100x and starve the overlap discount of precisely the ops that pipeline
+best with collectives."""
 
 from __future__ import annotations
 
@@ -17,6 +23,7 @@ from typing import Dict
 
 import numpy as np
 
+from easydist_tpu import config as edconfig
 from easydist_tpu.metashard.metair import MetaGraph, MetaNode
 
 _HEAVY_OPS = {"dot_general", "conv_general_dilated", "matmul", "mm", "bmm",
@@ -34,6 +41,16 @@ def _node_flops(node: MetaNode) -> float:
     return 2.0 * out_elems * min(k, in_elems)
 
 
+def _node_seconds(node: MetaNode) -> float:
+    """Estimated single-device run time of one op."""
+    flops = _node_flops(node)
+    if flops > 0.0:
+        return flops / edconfig.peak_flops
+    nbytes = sum(v.size_bytes() for v in node.invars if v is not None) \
+        + sum(v.size_bytes() for v in node.outvars if v is not None)
+    return nbytes / edconfig.hbm_bandwidth
+
+
 class ReachabilityMap:
     """Transitive closure over graph ops + per-edge independent peer FLOPs."""
 
@@ -42,6 +59,7 @@ class ReachabilityMap:
         n = len(ops)
         self.index: Dict[str, int] = {op.name: i for i, op in enumerate(ops)}
         self.flops = np.array([_node_flops(op) for op in ops])
+        self.seconds = np.array([_node_seconds(op) for op in ops])
 
         reach = np.zeros((n, n), dtype=bool)
         for i in reversed(range(n)):
@@ -56,14 +74,24 @@ class ReachabilityMap:
         self.reach = reach
         self.n = n
 
+    def _independent_mask(self, producer: str, consumer: str):
+        i = self.index.get(producer)
+        j = self.index.get(consumer)
+        if i is None or j is None or self.n == 0:
+            return None
+        return ~(self.reach[i] | self.reach[j]
+                 | self.reach[:, i] | self.reach[:, j])
+
     def independent_peer_flops(self, producer: str, consumer: str) -> float:
         """FLOPs of ops independent of both endpoints (neither ancestor nor
         descendant of either) — work a collective between them could hide
         behind."""
-        i = self.index.get(producer)
-        j = self.index.get(consumer)
-        if i is None or j is None or self.n == 0:
-            return 0.0
-        related = (self.reach[i] | self.reach[j]
-                   | self.reach[:, i] | self.reach[:, j])
-        return float(self.flops[~related].sum())
+        mask = self._independent_mask(producer, consumer)
+        return 0.0 if mask is None else float(self.flops[mask].sum())
+
+    def independent_peer_seconds(self, producer: str, consumer: str) -> float:
+        """Estimated seconds of independent peer work (MXU ops at
+        peak_flops, memory-bound ops at hbm_bandwidth) — the time budget a
+        collective between producer and consumer can hide inside."""
+        mask = self._independent_mask(producer, consumer)
+        return 0.0 if mask is None else float(self.seconds[mask].sum())

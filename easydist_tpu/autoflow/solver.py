@@ -218,16 +218,24 @@ class SpmdSolver:
                     comm[i, j] = resharding_cost(size, pu, pd, self.axis)
                     mem[i, j] = (placement_bytes(size, pu, self.axis.size)
                                  + placement_bytes(size, pd, self.axis.size))
+                    # a P edge carries an unrealized reduction: when a
+                    # deferred plan is comm-byte-NEUTRAL (psum at the fence
+                    # costs what the immediate psum did), prefer the
+                    # immediate one — full-size partials inflate liveness
+                    # and block remat for no wire saving.  Epsilon-scale so
+                    # it can never flip a genuinely byte-saving deferral.
+                    if (pu is not None and pu.is_partial()) \
+                            or (pd is not None and pd.is_partial()):
+                        mem[i, j] += 1e-3 * size
             if self.reachability is not None and edconfig.predict_comm_overlap:
                 # overlap-capable collectives cost less — but only as much
                 # as the independent compute can actually hide (the
                 # reference's flat discount, adjust_resharding_cost
                 # solver.py:79-84, fires on ANY parallel flops; here the
                 # hideable seconds bound the reduction per edge)
-                peer = self.reachability.independent_peer_flops(
+                hideable = self.reachability.independent_peer_seconds(
                     e.up_node.name, e.down_node.name)
-                if peer > 0:
-                    hideable = peer / edconfig.peak_flops  # seconds
+                if hideable > 0:
                     comm = comm - edconfig.comm_overlap_ratio * \
                         np.minimum(comm, hideable)
             e.comm, e.mem = comm, mem
@@ -287,8 +295,8 @@ class SpmdSolver:
         pick: Dict[int, int] = {}
         for c in self.clusters:
             for s in range(c.strategy_count()):
-                if all(repr(c.strategies[s][uid][1])
-                       == repr(chosen.get(c.nodes[uid].name))
+                if all(c.strategies[s][uid][1]
+                       == chosen.get(c.nodes[uid].name)
                        for uid in c.strategies[s]):
                     pick[c.cid] = s
                     break
@@ -458,6 +466,12 @@ class SpmdSolver:
                     "%s; re-solving uncapped (auto-remat takes over)",
                     edconfig.per_device_memory_cap * edconfig.memory_ratio
                     / 2**30, self.axis.name)
+                # the capped model ran untied (only non-uniform assignments
+                # can dodge a cap); the uncapped fallback must re-tie, or
+                # the larger untied ILP lands on a different near-tie than
+                # the cap-0 solve and the remat planner sees a worse plan
+                if edconfig.solver_cluster_dedup:
+                    self._compute_tie_groups()
                 return self._ilp_solve(apply_memory_cap=False)
             raise RuntimeError(f"MILP failed: status={res.status} {res.message}")
         logger.info("[SpmdSolver] axis=%s clusters=%d (%d tied) edges=%d "
@@ -477,7 +491,8 @@ class SpmdSolver:
         # leave on the table (the gap tolerance is orders of magnitude
         # larger than the scaled memory term).  Strictly monotone in the
         # untied objective.
-        picks = self._refine(picks)
+        picks = self._refine(picks, capped=(
+            apply_memory_cap and edconfig.per_device_memory_cap > 0))
 
         chosen: Dict[str, NodeStrategy] = {}
         for c in self.clusters:
@@ -485,13 +500,15 @@ class SpmdSolver:
                 chosen[c.nodes[uid].name] = strat
         return chosen
 
-    def _refine(self, picks: Dict[int, int],
-                max_sweeps: int = 10) -> Dict[int, int]:
+    def _refine(self, picks: Dict[int, int], max_sweeps: int = 10,
+                capped: bool = False) -> Dict[int, int]:
         """Coordinate descent on the full (untied) model: re-pick each
         cluster's strategy given its neighbors until a fixed point."""
-        if edconfig.per_device_memory_cap > 0:
+        if capped:
             # a local move could break the per-liveness-step cap the ILP
-            # enforced; keep the capped solution as-is
+            # enforced; keep the capped solution as-is.  (The uncapped
+            # FALLBACK solve does refine — its model has no cap to break,
+            # and skipping left a memory-worse near-tie for remat.)
             return picks
         in_edges: Dict[int, List[_Edge]] = {}
         out_edges: Dict[int, List[_Edge]] = {}

@@ -28,6 +28,11 @@ log_level = getattr(logging, os.environ.get("EASYDIST_LOGLEVEL", "INFO").upper()
 dump_dir = os.environ.get("EASYDIST_DUMP_DIR", None)
 dump_strategy = _env_bool("EASYDIST_DUMP_STRATEGY", True)
 dump_cluster = _env_bool("EASYDIST_DUMP_CLUSTER", False)
+# graphviz DOT of the MetaIR graph with chosen placements (resharding
+# edges highlighted) — reference DUMP_FX_GRAPH, compile_auto.py:487-508
+dump_graphviz = _env_bool("EASYDIST_DUMP_GRAPHVIZ", True)
+# optimized-HLO text of each compiled executable (what GSPMD emitted)
+dump_hlo = _env_bool("EASYDIST_DUMP_HLO", False)
 
 # ---------------- compile cache ----------------
 enable_compile_cache = _env_bool("EASYDIST_COMPILE_CACHE", False)
@@ -64,7 +69,14 @@ all_to_all_punish_factor = _env_float("EASYDIST_ALL_TO_ALL_PUNISH", 3.0)
 allow_repeated_axis_strategy = _env_bool("EASYDIST_ALLOW_REPEATED_AXIS_STRATEGY", False)
 # discount resharding cost when independent compute can hide the collective
 # (reference predict_comm_overlap + comm_overlap_ratio, solver.py:74-84);
-# the discount is bounded by the hideable seconds = peer_flops / peak_flops
+# the discount is bounded by the hideable seconds of independent peer work
+# (MXU ops at peak_flops, memory-bound ops at hbm_bandwidth) per edge.
+# Off by default DELIBERATELY: the discount lets the ILP trade wire bytes
+# for assumed overlap, and on GPT dp x tp it picks plans moving ~1.5x the
+# collective bytes of the byte-minimal plan (fails the hand-GSPMD quality
+# gate).  Until overlap is validated against measured step time on real
+# hardware, byte-minimal is the safer default; enable per-compile when the
+# graph has wide independent branches.
 predict_comm_overlap = _env_bool("EASYDIST_PREDICT_COMM_OVERLAP", False)
 comm_overlap_ratio = _env_float("EASYDIST_COMM_OVERLAP_RATIO", 0.5)
 # device peak FLOP/s for overlap bounding (v5e bf16 ~197e12; f32 ~49e12)

@@ -12,15 +12,21 @@ local shards (values inside are partial-by-construction), and ONE
 exactly the reference's global-partial deferral (metair.py:376-481)
 re-expressed with XLA collectives.
 
-v1 scope: single-axis regions (the run's equations must be unsharded on
-every other mesh axis), flat primitives only.
+Scope: one deferred axis per region, flat primitives only.  Other mesh
+axes may carry SHARD placements (hybrid dp x tp): the region is emitted
+with EVERY axis manual, using the solved placements as in/out specs, so
+GSPMD gets no freedom to re-layout inside (an `auto`-axes variant measured
+2 MiB of involuntary-rematerialization all-gathers).  That requires the
+run to be sync-free on the other axes — each in-run consumer's placement
+must equal its producer's — and excludes runs carrying another axis's
+PARTIAL (two simultaneous deferred reductions would need coupled fences).
 """
 
 from __future__ import annotations
 
 import logging
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Dict, List, Sequence, Set
 
 logger = logging.getLogger(__name__)
 
@@ -33,6 +39,16 @@ _REGION_PRIMS = frozenset((
     "neg", "rev", "slice", "copy", "mul", "div", "add_any",
 ))
 
+# primitives whose params bake in GLOBAL shapes/indices (reshape new_sizes,
+# slice start/limit, broadcast target shape) or whose local execution does
+# not commute with sharding (rev flips shard order).  Safe when every region
+# tensor is full-shape (P on the deferred axis, R elsewhere) — the v1
+# situation — but wrong on local blocks, so a run carrying another axis's
+# SHARD placements must not contain them.
+_GLOBAL_SHAPE_PRIMS = frozenset((
+    "reshape", "broadcast_in_dim", "slice", "rev",
+))
+
 
 @dataclass
 class PartialRegion:
@@ -41,19 +57,23 @@ class PartialRegion:
     end: int
     axis_idx: int
     axis_name: str
-    # var -> spec entries for sharded sources ({dim: axis_name})
-    source_shard_dim: Dict[object, int] = field(default_factory=dict)
-    # region-produced vars read outside the region (fence: psum) mapped to
-    # whether they are P (need the psum) at region exit
+    # source var -> {tensor dim: axis name} (its solved S placements, the
+    # deferred axis AND any other sharded axes)
+    source_specs: Dict[object, Dict[int, str]] = field(default_factory=dict)
+    # fence var -> {tensor dim: axis name} on the NON-deferred axes (the
+    # deferred axis exits replicated, or sharded via fence_scatter)
+    out_specs_map: Dict[object, Dict[int, str]] = field(default_factory=dict)
+    # region-produced vars read outside the region that are P at region
+    # exit (need the psum fence)
     fence_partial: Set[object] = field(default_factory=set)
-    # fence vars whose every outside consumer wants S(dim): the fence pays
-    # psum_scatter (half the wire bytes of the all_reduce) and exits
-    # sharded
+    # fence vars whose every outside consumer wants S(dim) on the deferred
+    # axis: the fence pays psum_scatter (half the all_reduce wire bytes)
+    # and exits sharded
     fence_scatter: Dict[object, int] = field(default_factory=dict)
 
 
 def find_partial_regions(jaxpr, per_axis: Sequence[Dict], axis_names,
-                         ) -> List[PartialRegion]:
+                         axis_sizes: Sequence[int]) -> List[PartialRegion]:
     from jax.extend import core as jex_core
 
     regions: List[PartialRegion] = []
@@ -64,6 +84,18 @@ def find_partial_regions(jaxpr, per_axis: Sequence[Dict], axis_names,
     def strat(a, idx):
         return per_axis[a].get(f"op{idx}")
 
+    def placement_in(a, idx, pos):
+        s = strat(a, idx)
+        if s is None or pos >= len(s.in_placements):
+            return None
+        return s.in_placements[pos]
+
+    def placement_out(a, idx, k):
+        s = strat(a, idx)
+        if s is None or k >= len(s.out_placements):
+            return None
+        return s.out_placements[k]
+
     def carries_p(a, idx):
         s = strat(a, idx)
         if s is None:
@@ -72,18 +104,26 @@ def find_partial_regions(jaxpr, per_axis: Sequence[Dict], axis_names,
                    for p in s.out_placements)
 
     def clean_other_axes(a, idx):
+        # other-axis SHARD is fine (emitted manual with the solved specs);
+        # other-axis PARTIAL would need a second fence
         for b in range(n_axes):
             if b == a:
                 continue
             s = strat(b, idx)
             if s is None:
                 continue
-            if any(p is not None and not p.is_replicate()
+            if any(p is not None and p.is_partial()
                    for p in list(s.out_placements) + list(s.in_placements)):
                 return False
         return True
 
+    def divisible(v, dim, axis):
+        shape = getattr(v.aval, "shape", ())
+        return dim < len(shape) and shape[dim] % axis_sizes[axis] == 0
+
     eqns = jaxpr.eqns
+    out_set = {v for v in jaxpr.outvars
+               if not isinstance(v, jex_core.Literal)}
     for a in range(n_axes):
         idx = 0
         while idx < len(eqns):
@@ -106,69 +146,156 @@ def find_partial_regions(jaxpr, per_axis: Sequence[Dict], axis_names,
 
             region = PartialRegion(start, end, a, str(axis_names[a]))
             produced: Set[object] = set()
+            producer_out: Dict[object, Dict[int, object]] = {}
+            source_placements: Dict[object, tuple] = {}
             ok = True
             for j in range(start, end + 1):
                 eqn = eqns[j]
-                s = strat(a, j)
                 pos = 0
                 for v in eqn.invars:
                     if isinstance(v, jex_core.Literal):
                         continue
-                    if v not in produced:
-                        p = (s.in_placements[pos]
-                             if s and pos < len(s.in_placements) else None)
-                        if p is not None and p.is_shard():
-                            prev = region.source_shard_dim.get(v)
-                            if prev is not None and prev != p.dim:
-                                ok = False  # conflicting source shardings
-                            region.source_shard_dim[v] = p.dim
-                        elif p is not None and p.is_partial() \
-                                and v not in produced:
-                            ok = False  # P flowing in from outside the run
+                    if v in produced:
+                        # sync-free requirement on EVERY axis (including
+                        # the deferred one): the consumer must take the
+                        # producer's placement as-is.  On axis `a` this
+                        # rejects runs where the solver priced a mid-chain
+                        # psum (producer P, consumer expecting R/S) — a
+                        # region would silently skip that reduction.
+                        for b in range(n_axes):
+                            pin = placement_in(b, j, pos)
+                            pout = producer_out.get(v, {}).get(b)
+                            pin_r = pin is None or pin.is_replicate()
+                            pout_r = pout is None or pout.is_replicate()
+                            if pin_r != pout_r or (
+                                    not pin_r and (pin.kind != pout.kind
+                                                   or pin.dim != pout.dim)):
+                                ok = False
+                    else:
+                        spec = region.source_specs.setdefault(v, {})
+                        # every consuming eqn must read this source with
+                        # the SAME per-axis placement: the shard_map slices
+                        # the source once, so S-here-R-there (a reshard
+                        # edge the solver prices between consumers) cannot
+                        # be honored inside one region
+                        placements = tuple(placement_in(b, j, pos)
+                                           for b in range(n_axes))
+                        prev_pl = source_placements.get(v)
+                        if prev_pl is None:
+                            source_placements[v] = placements
+                        elif prev_pl != placements:
+                            ok = False
+                        for b, p in enumerate(placements):
+                            if p is None:
+                                continue
+                            if p.is_partial():
+                                ok = False  # P flowing in from outside
+                            elif p.is_shard():
+                                prev = spec.get(p.dim)
+                                if prev is not None \
+                                        and prev != str(axis_names[b]):
+                                    ok = False  # two axes on one dim
+                                elif not divisible(v, p.dim, b):
+                                    ok = False
+                                else:
+                                    spec[p.dim] = str(axis_names[b])
+                        # conflicting sharding of the same source between
+                        # two consuming eqns (same axis, different dim)
+                        for d1, n1 in list(spec.items()):
+                            for d2, n2 in spec.items():
+                                if n1 == n2 and d1 != d2:
+                                    ok = False
                     pos += 1
-                for v in eqn.outvars:
+                for k, v in enumerate(eqn.outvars):
                     produced.add(v)
+                    producer_out[v] = {b: placement_out(b, j, k)
+                                       for b in range(n_axes)}
             if not ok:
                 continue
 
             # fences: region-produced vars read after the region (or
-            # returned); record whether they exit as P
-            out_set = {v for v in jaxpr.outvars
-                       if not isinstance(v, jex_core.Literal)}
-            last_strat = None
-            for j in range(start, end + 1):
-                p_out = {}
-                s = strat(a, j)
-                for k, v in enumerate(eqns[j].outvars):
-                    p = (s.out_placements[k]
-                         if s and k < len(s.out_placements) else None)
-                    p_out[v] = p is not None and p.is_partial()
-                if last_strat is None:
-                    last_strat = {}
-                last_strat.update(p_out)
+            # returned); record whether they exit as P on the deferred axis
+            # and their S dims on the other axes
             consumed_later: Set[object] = set()
             consumer_placements: Dict[object, List] = {}
             for j in range(end + 1, len(eqns)):
-                s_j = strat(a, j)
                 pos = 0
                 for v in eqns[j].invars:
                     if isinstance(v, jex_core.Literal):
                         continue
                     consumed_later.add(v)
                     if v in produced:
-                        p = (s_j.in_placements[pos] if s_j
-                             and pos < len(s_j.in_placements) else None)
-                        consumer_placements.setdefault(v, []).append(p)
+                        consumer_placements.setdefault(v, []).append(
+                            placement_in(a, j, pos))
                     pos += 1
             for v in list(produced):
-                if v in consumed_later or v in out_set:
-                    if last_strat.get(v):
-                        region.fence_partial.add(v)
-                        ps = consumer_placements.get(v, [])
-                        if ps and v not in out_set and all(
-                                p is not None and p.is_shard() for p in ps) \
-                                and len({p.dim for p in ps}) == 1:
-                            region.fence_scatter[v] = ps[0].dim
+                if v not in consumed_later and v not in out_set:
+                    continue
+                pa = producer_out.get(v, {}).get(a)
+                spec = {}
+                for b in range(n_axes):
+                    if b == a:
+                        continue
+                    p = producer_out.get(v, {}).get(b)
+                    if p is not None and p.is_shard():
+                        if not divisible(v, p.dim, b):
+                            ok = False
+                        spec[p.dim] = str(axis_names[b])
+                region.out_specs_map[v] = spec
+                if pa is not None and pa.is_partial():
+                    region.fence_partial.add(v)
+                    ps = consumer_placements.get(v, [])
+                    if ps and v not in out_set and all(
+                            p is not None and p.is_shard() for p in ps) \
+                            and len({p.dim for p in ps}) == 1 \
+                            and ps[0].dim not in spec \
+                            and divisible(v, ps[0].dim, a):
+                        # divisibility decided HERE so the byte gate below
+                        # never credits a scatter emit_region would refuse
+                        region.fence_scatter[v] = ps[0].dim
+            if not ok:
+                continue
+            # with other-axis SHARD placements anywhere in the run, region
+            # tensors are local blocks — global-shape-param prims break
+            other_sharded = any(
+                b != a and p is not None and p.is_shard()
+                for v in produced
+                for b, p in producer_out.get(v, {}).items()) or any(
+                name != region.axis_name
+                for spec in region.source_specs.values()
+                for name in spec.values())
+            if other_sharded and any(
+                    eqns[j].primitive.name in _GLOBAL_SHAPE_PRIMS
+                    for j in range(start, end + 1)):
+                continue
+            # the region must STRICTLY beat immediate reduction: psum-ing
+            # every P-creator output (what GSPMD emits with no region)
+            # vs psum/psum_scatter at the fence.  A byte-neutral region
+            # (e.g. P riding an optimizer update: psum(p - lr*g) costs
+            # what psum(g) did) buys nothing and hurts elsewhere — its
+            # full-size partials inflate liveness and its eqns are banned
+            # from remat chains.
+            immediate = 0
+            for j in range(start, end + 1):
+                s = strat(a, j)
+                if s is None:
+                    continue
+                creates = any(p is not None and p.is_partial()
+                              for p in s.out_placements) and not any(
+                    p is not None and p.is_partial()
+                    for p in s.in_placements)
+                if creates:
+                    for k, v in enumerate(eqns[j].outvars):
+                        p = (s.out_placements[k]
+                             if k < len(s.out_placements) else None)
+                        if p is not None and p.is_partial():
+                            immediate += v.aval.size * v.aval.dtype.itemsize
+            fence = sum(
+                (v.aval.size * v.aval.dtype.itemsize)
+                // (2 if v in region.fence_scatter else 1)
+                for v in region.fence_partial)
+            if fence >= immediate:
+                continue
             regions.append(region)
     # keep non-overlapping regions only (one axis per run; first wins)
     taken: Set[int] = set()
@@ -189,7 +316,8 @@ def find_partial_regions(jaxpr, per_axis: Sequence[Dict], axis_names,
 def emit_region(region: PartialRegion, jaxpr, env, mesh):
     """Execute one region under shard_map: local chain + one psum fence.
     Reads sources from `env`, writes region outputs (post-fence, global
-    semantics) back into `env`."""
+    semantics) back into `env`.  Every mesh axis is manual — in/out specs
+    come from the solved placements, so GSPMD cannot re-layout inside."""
     import jax
     from jax import shard_map
     from jax.extend import core as jex_core
@@ -260,27 +388,25 @@ def emit_region(region: PartialRegion, jaxpr, env, mesh):
     def spec_for(v):
         nd = len(v.aval.shape)
         entries = [None] * nd
-        d = region.source_shard_dim.get(v)
-        if d is not None and d < nd:
-            entries[d] = axis
+        for d, name in region.source_specs.get(v, {}).items():
+            if d < nd:
+                entries[d] = name
         return PartitionSpec(*entries)
 
     def out_spec_for(v):
-        d = scatter_dim.get(v)
-        if d is None:
-            return PartitionSpec()
         entries = [None] * len(v.aval.shape)
-        entries[d] = axis
+        for d, name in region.out_specs_map.get(v, {}).items():
+            if d < len(entries):
+                entries[d] = name
+        d = scatter_dim.get(v)
+        if d is not None:
+            entries[d] = axis
         return PartitionSpec(*entries)
 
     in_specs = tuple(spec_for(v) for v in sources)
     out_specs = tuple(out_spec_for(v) for v in outs)
-    auto = frozenset(mesh.axis_names) - {axis}
-    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                  check_vma=False)
-    if auto:
-        kwargs["auto"] = auto
-    fn = shard_map(body, **kwargs)
+    fn = shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=False)
     results = fn(*[env[v] for v in sources])
     for v, val in zip(outs, results):
         env[v] = val

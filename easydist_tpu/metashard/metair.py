@@ -40,13 +40,26 @@ _DTYPE_BYTES = {
 
 # --------------------------------------------------------------- placements
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, eq=False)
 class Placement:
     """Placement of one tensor along ONE mesh axis."""
 
     kind: str  # "R" | "S" | "P"
     dim: int = -1  # tensor dim for S
     reduction: Optional[Reduction] = None  # for P
+
+    def _key(self):
+        # canonical identity: dim only matters for S, reduction only for P
+        # (an R built with a stray dim is still just R)
+        return (self.kind,
+                self.dim if self.kind == "S" else -1,
+                self.reduction if self.kind == "P" else None)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Placement) and self._key() == other._key()
+
+    def __hash__(self) -> int:
+        return hash(self._key())
 
     @staticmethod
     def replicate() -> "Placement":
@@ -152,6 +165,10 @@ class MetaNode:
         self.is_input = is_input
         self.cluster_id = -1
         self._pool_cache: Optional[List[NodeStrategy]] = None
+        # user-pinned strategy for this solve axis (fix_sharding): when
+        # set, the pool is exactly [pinned] — the solver prices neighbors
+        # against the pin instead of fighting it at emission
+        self.pinned: Optional[NodeStrategy] = None
 
         for idx, v in enumerate(invars):
             if v is not None:
@@ -217,6 +234,8 @@ class MetaNode:
         one per discovered shard group whose sharded dims divide evenly,
         minus `exclude` (strategies already chosen on previous mesh axes —
         reference metair.py:393-430), plus replicate as fallback."""
+        if self.pinned is not None:
+            return [self.pinned]
         if self._pool_cache is None:
             pool = []
             for group in sorted(self.recombines):
@@ -277,26 +296,69 @@ class MetaNodeCluster:
         self.nodes[node.uid] = node
         node.cluster_id = self.cid
 
-    def _back_build(self, node: MetaNode, strategy: NodeStrategy,
-                    chosen: Dict[int, Tuple[int, NodeStrategy]],
-                    axis_size: int, exclude_map) -> bool:
-        for invar_idx, invar in enumerate(node.invars):
-            if invar is None or invar.producer is None:
+    # bound on sync-free assignments enumerated per output-pool entry: the
+    # branching is tiny in practice (cones are near-trees, 1-3 matching
+    # producer strategies per edge) but a pathological cluster must not
+    # blow up the ILP
+    _BACK_BUILD_CAP = 16
+    # bound on total DFS expansions per output-pool entry: a branchy
+    # cluster whose combinations mostly DEAD-END never fills `results`,
+    # so the result cap alone would still let the tree search go
+    # multiplicative (k matches/edge over n nodes)
+    _BACK_BUILD_STEPS = 512
+
+    def _back_build_all(self, pending, chosen, axis_size, exclude_map,
+                        results, steps) -> None:
+        """Enumerate every sync-free intra-cluster assignment consistent
+        with the already-`chosen` strategies.  `pending` holds (node,
+        strategy) pairs whose in-cluster producers still need covering;
+        `steps` is a single-element work counter shared across the DFS.
+        Enumerating ALL matches (not just the first) matters: a P-chain
+        cluster has both a "create P mid-chain" and a "P rides the whole
+        chain" assignment for the same output placement, and first-match
+        back-build shadows the second."""
+        steps[0] += 1
+        if len(results) >= self._BACK_BUILD_CAP \
+                or steps[0] > self._BACK_BUILD_STEPS:
+            return
+        while pending:
+            node, strategy = pending[-1]
+            edge = None
+            for invar_idx, invar in enumerate(node.invars):
+                if invar is None or invar.producer is None:
+                    continue
+                up = invar.producer
+                if up.uid not in self.nodes:
+                    continue
+                want = strategy.in_placements[invar_idx]
+                if up.uid in chosen:
+                    # a second in-cluster consumer: sync-free requires the
+                    # already-chosen producer strategy to serve it too
+                    have = chosen[up.uid][1].out_placements[
+                        invar.producer_idx]
+                    if have != want:
+                        return  # dead end
+                    continue
+                edge = (invar_idx, invar, up)
+                break
+            if edge is None:
+                pending = pending[:-1]
                 continue
-            up = invar.producer
-            if up.uid not in self.nodes or up.uid in chosen:
-                continue
+            invar_idx, invar, up = edge
             want = strategy.in_placements[invar_idx]
             up_pool = up.strategy_pool(axis_size, exclude_map(up))
-            match = next((i for i, s in enumerate(up_pool)
-                          if s.out_placements[invar.producer_idx] == want), -1)
-            if match < 0:
-                return False
-            chosen[up.uid] = (match, up_pool[match])
-            if not self._back_build(up, up_pool[match], chosen, axis_size,
-                                    exclude_map):
-                return False
-        return True
+            for i, s in enumerate(up_pool):
+                if s.out_placements[invar.producer_idx] != want:
+                    continue
+                nxt = dict(chosen)
+                nxt[up.uid] = (i, s)
+                self._back_build_all(pending + [(up, s)], nxt, axis_size,
+                                     exclude_map, results, steps)
+                if len(results) >= self._BACK_BUILD_CAP \
+                        or steps[0] > self._BACK_BUILD_STEPS:
+                    return
+            return  # every branch of this edge explored (or none matched)
+        results.append(chosen)
 
     def finalize(self, axis_size: int, exclude_map) -> None:
         # output node: the unique node with a var consumed outside the cluster
@@ -323,15 +385,23 @@ class MetaNodeCluster:
         self.output_node = out_node
 
         self.strategies = []
+        seen = set()
         for idx, s in enumerate(out_node.strategy_pool(axis_size,
                                                        exclude_map(out_node))):
-            chosen = {out_node.uid: (idx, s)}
-            if self._back_build(out_node, s, chosen, axis_size, exclude_map):
-                if len(chosen) == len(self.nodes):
-                    self.strategies.append(chosen)
-                else:
-                    logger.debug("cluster %d: strategy %d left nodes unassigned",
-                                 self.cid, idx)
+            results: List[Dict[int, Tuple[int, NodeStrategy]]] = []
+            self._back_build_all([(out_node, s)],
+                                 {out_node.uid: (idx, s)}, axis_size,
+                                 exclude_map, results, steps=[0])
+            for chosen in results:
+                if len(chosen) != len(self.nodes):
+                    logger.debug("cluster %d: strategy %d left nodes "
+                                 "unassigned", self.cid, idx)
+                    continue
+                key = tuple(sorted((uid, i) for uid, (i, _) in chosen.items()))
+                if key in seen:
+                    continue
+                seen.add(key)
+                self.strategies.append(chosen)
         if not self.strategies:
             # fall back to all-replicate so the solver always has a choice
             chosen = {n.uid: (-1, n.replicate_strategy())

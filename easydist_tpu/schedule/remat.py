@@ -243,12 +243,40 @@ def plan_remat(closed_jaxpr, names, per_axis: Sequence[Dict],
 
     plan = RematPlan(base_peak=base_peak, predicted_peak=base_peak)
     max_chain = edconfig.remat_max_chain_len
-    # seconds proxy for chain pricing
+    # seconds proxy for chain pricing; measured per-op times (PerfDB,
+    # runtime/op_profile.py — ROADMAP #5) replace the FLOP proxy per eqn
+    # when a profile exists for the op's signature
     flops_per_s = max(edconfig.peak_flops, 1.0)
+    op_times: Dict[str, float] = {}
+    if edconfig.use_op_cost_db:
+        try:
+            from easydist_tpu.runtime.op_profile import load_op_times
+
+            op_times = load_op_times()
+        except Exception:
+            op_times = {}
+    sig_cache: Dict[int, Optional[str]] = {}
+
+    def eqn_seconds(e: int) -> float:
+        if op_times:
+            sig = sig_cache.get(e)
+            if sig is None and e not in sig_cache:
+                from easydist_tpu.jaxfront.interpreter import eqn_signature
+
+                try:
+                    sig = eqn_signature(jaxpr.eqns[e], names)
+                except Exception:
+                    sig = None
+                sig_cache[e] = sig
+            measured = op_times.get(sig) if sig else None
+            if measured is not None:
+                return measured
+        return _eqn_flops(jaxpr.eqns[e]) / flops_per_s
 
     # vars whose far consumers have been redirected (no longer readable
     # past their shortened end)
     rematted: Set[object] = set()
+    accounted_eqns: Set[int] = set()  # chain eqns already priced (unique)
 
     def build_chain(target, at: int) -> Optional[List[int]]:
         """Eqn indices (ascending = topological) whose re-execution at op
@@ -310,8 +338,7 @@ def plan_remat(closed_jaxpr, names, per_axis: Sequence[Dict],
             chain = build_chain(v, min(far))
             if not chain:
                 continue
-            cost_s = sum(_eqn_flops(jaxpr.eqns[e]) for e in chain) \
-                / flops_per_s
+            cost_s = sum(eqn_seconds(e) for e in chain)
             score = lv.size[v] / (1e-6 + cost_s)
             cands.append((score, v, t_star, chain))
             if len(cands) >= 256:
@@ -336,6 +363,7 @@ def plan_remat(closed_jaxpr, names, per_axis: Sequence[Dict],
                                for k, vv in plan.recompute.items()}
             saved_last_use = dict(plan.overlay_last_use)
             saved_seconds = plan.recompute_seconds
+            saved_accounted = set(accounted_eqns)
 
             far = [j for j in lv.consumers[v] if j > t_cut]
             near = [j for j in lv.consumers[v] if j <= t_cut]
@@ -346,8 +374,11 @@ def plan_remat(closed_jaxpr, names, per_axis: Sequence[Dict],
             for e in chain:
                 plan.overlay_last_use[e] = max(
                     plan.overlay_last_use.get(e, -1), last_far)
-                plan.recompute_seconds += \
-                    _eqn_flops(jaxpr.eqns[e]) / flops_per_s
+                # overlay sharing executes a chain equation once even when
+                # several committed vars' chains contain it — count unique
+                if e not in accounted_eqns:
+                    accounted_eqns.add(e)
+                    plan.recompute_seconds += eqn_seconds(e)
             # model: original interval ends at the last near consumer; the
             # recomputed copy lives [first_far, last_far]; chain sources
             # read at first_far stay resident through last_far.  Chain
@@ -384,6 +415,7 @@ def plan_remat(closed_jaxpr, names, per_axis: Sequence[Dict],
             plan.recompute = saved_recompute
             plan.overlay_last_use = saved_last_use
             plan.recompute_seconds = saved_seconds
+            accounted_eqns = saved_accounted
         if not committed:
             logger.info(
                 "[remat] no candidate improves the profile at peak %.2f "

@@ -75,6 +75,41 @@ def test_auto_remat_reduces_planned_peak(cpu_devices, memory_cap):
 
 
 @pytest.mark.world_8
+def test_remat_chain_cost_uses_measured_op_times(cpu_devices, memory_cap,
+                                                 monkeypatch):
+    """ROADMAP #5: with a PerfDB profile present, remat chain pricing reads
+    the measured per-op seconds instead of the FLOP proxy — a uniform
+    1s-per-op fake DB must make recompute_seconds count exactly one second
+    per recomputed equation execution."""
+    import easydist_tpu.runtime.op_profile as op_profile
+
+    mesh = make_device_mesh((8,), ("dp",), devices=cpu_devices)
+    step, mk, x = _mlp_step()
+
+    monkeypatch.setattr(op_profile, "load_op_times", lambda: _UniformTimes())
+
+    edconfig.per_device_memory_cap = 1_700_000
+    r = easydist_compile(step, mesh=mesh).get_compiled(mk(), x)
+    plan = r.remat_plan
+    assert plan is not None and plan.n_remat_vars > 0
+    # overlay sharing executes each chain equation once even when several
+    # consumers read it, so seconds count UNIQUE recomputed equations
+    n_exec = len({e for chain in plan.recompute.values() for e in chain})
+    assert plan.recompute_seconds == pytest.approx(float(n_exec)), \
+        (plan.recompute_seconds, n_exec)
+
+
+class _UniformTimes(dict):
+    """Fake op-times DB: every signature measures 1.0 s."""
+
+    def get(self, key, default=None):
+        return 1.0
+
+    def __bool__(self):
+        return True
+
+
+@pytest.mark.world_8
 def test_no_remat_when_program_fits(cpu_devices, memory_cap):
     mesh = make_device_mesh((8,), ("dp",), devices=cpu_devices)
     step, mk, x = _mlp_step(L=2, D=32, B=64)

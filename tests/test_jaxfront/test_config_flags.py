@@ -76,13 +76,20 @@ def test_dump_flags_write_files(flag, tmp_path, cpu_devices):
     flag("dump_dir", str(tmp_path))
     flag("dump_strategy", True)
     flag("dump_cluster", True)
+    flag("dump_graphviz", True)
+    flag("dump_hlo", True)
     params, x, y = _case()
     mesh = make_device_mesh((8,), ("d",))
-    easydist_compile(_step, mesh=mesh, donate_state=False).get_compiled(
-        params, x, y)
+    res = easydist_compile(_step, mesh=mesh, donate_state=False) \
+        .get_compiled(params, x, y)
     assert os.path.exists(tmp_path / "strategies.txt")
     assert os.path.exists(tmp_path / "clusters.txt")
     assert os.path.exists(tmp_path / "metair.txt")
+    dot = (tmp_path / "metair.dot").read_text()
+    assert dot.startswith("digraph") and "dot_general" in dot
+    res.executable()  # HLO dump happens at first lower+compile
+    hlo = (tmp_path / "optimized.hlo").read_text()
+    assert "HloModule" in hlo
 
 
 @pytest.mark.world_8
