@@ -17,10 +17,15 @@ roundtrip, so every measurement is two-point: time N1 and N2 chained steps
 and use (t2-t1)/(N2-N1), cancelling fixed dispatch+roundtrip overhead.
 
 Robustness: the tunnel flaps between rounds (round 2 died rc=1 at
-`jax.devices()`).  Backend availability is probed in a SUBPROCESS with
-bounded retry/backoff — a failed in-process jax init poisons the bridge
-state — and on final failure the benchmark still emits its JSON line with
-an "error" field and exits 0.
+`jax.devices()`) and can WEDGE mid-run (round 3: a readback blocked on a
+tunnel RPC that never returned; the main thread sat in a C-level futex
+wait, unreachable by any in-process signal/watchdog).  So the benchmark is
+two processes: a jax-free PARENT that enforces a wall-clock deadline and
+always emits the JSON line rc=0, and a disposable CHILD (`--child`) doing
+the actual measurement — killed and retried once if it hangs, with the jax
+persistent compilation cache warm so the retry skips recompiles.  Backend
+availability is additionally probed in a sub-subprocess with bounded
+retry/backoff (a failed in-process jax init poisons the bridge state).
 """
 
 import json
@@ -31,7 +36,9 @@ import sys
 import time
 
 logging.basicConfig(level=logging.INFO, stream=sys.stderr)
-log = lambda msg: print(msg, file=sys.stderr)
+_T0 = time.time()
+log = lambda msg: print(f"# [t+{time.time()-_T0:.0f}s] {msg.lstrip('# ')}"
+                        if msg.startswith("#") else msg, file=sys.stderr)
 
 # bf16 peak FLOP/s per chip by device kind (prefix match, lowercased)
 _PEAK_FLOPS = {
@@ -44,7 +51,7 @@ _PEAK_FLOPS = {
 }
 
 
-def _probe_backend(timeout=180):
+def _probe_backend(timeout=90):
     """Probe jax backend availability in a subprocess (a failed in-process
     init poisons xla_bridge state; a subprocess is disposable).  Returns
     (platform, n_devices, device_kind) or None."""
@@ -64,7 +71,7 @@ def _probe_backend(timeout=180):
     return None
 
 
-def _acquire_backend(max_attempts=5, backoff_s=90):
+def _acquire_backend(max_attempts=3, backoff_s=30):
     """Retry the subprocess probe with backoff until a backend answers.
     Returns (platform, n_devices, device_kind, attempts_used) — falls back
     to forcing the CPU backend if the TPU tunnel never comes up."""
@@ -106,19 +113,83 @@ def _two_point_time(jitted, init_state, tokens, targets, n1, n2, sync):
         " — tunnel too unstable to measure")
 
 
+_FALLBACK = {
+    "metric": "gpt2_train_tokens_per_sec_per_chip",
+    "value": 0.0,
+    "unit": "tokens/s/chip",
+    "vs_baseline": 0.0,
+}
+
+
 def main():
+    """Watchdog parent: run the measurement in a killable child under a
+    wall-clock deadline; one retry (compiles are cached), then a labeled
+    fallback JSON.  This process never imports jax and always exits 0."""
+    total = float(os.environ.get("EASYDIST_BENCH_DEADLINE_S", 2700))
+    deadlines = [total * 0.6, total * 0.4]
+
+    def emit_json_from(stdout) -> bool:
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode("utf-8", "replace")
+        for line in reversed((stdout or "").strip().splitlines()):
+            try:
+                json.loads(line)
+            except ValueError:
+                continue
+            print(line)
+            return True
+        return False
+
+    for attempt, deadline in enumerate(deadlines, 1):
+        log(f"# bench attempt {attempt}/{len(deadlines)}, "
+            f"deadline {deadline:.0f}s")
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child"],
+                stdout=subprocess.PIPE, timeout=deadline, text=True)
+            if emit_json_from(proc.stdout):
+                return
+            log(f"# child exited rc={proc.returncode} with no JSON line")
+        except subprocess.TimeoutExpired as e:
+            # a child that finished measuring and printed its JSON but
+            # wedged in TPU-client TEARDOWN still counts: salvage stdout
+            if emit_json_from(e.stdout):
+                log(f"# child wedged after printing its result; salvaged")
+                return
+            log(f"# child exceeded {deadline:.0f}s (tunnel wedge?); killed")
+        except Exception as e:
+            log(f"# child attempt failed: {type(e).__name__}: {e}")
+    out = dict(_FALLBACK)
+    out["error"] = "benchmark child hung or died on every attempt"
+    print(json.dumps(out))
+
+
+def child_main():
     t_start = time.time()
-    result = {
-        "metric": "gpt2_train_tokens_per_sec_per_chip",
-        "value": 0.0,
-        "unit": "tokens/s/chip",
-        "vs_baseline": 0.0,
-    }
+    result = dict(_FALLBACK)
     try:
+        # persistent XLA compilation cache: a killed-and-retried child
+        # skips the expensive GPT-2 compiles the first attempt already paid
+        try:
+            import jax as _jax_cfg
+
+            _jax_cfg.config.update("jax_compilation_cache_dir",
+                                   "/tmp/easydist_bench_jax_cache")
+            _jax_cfg.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception as e:
+            log(f"# persistent compile cache unavailable: {e}")
         got = _acquire_backend()
         if got is None:
             platform, n_chips, kind, attempts = "cpu", 1, "host cpu", -1
-            os.environ["JAX_PLATFORMS"] = "cpu"
+            # the axon plugin's sitecustomize OVERRIDES the JAX_PLATFORMS
+            # env var (measured: the env-var route still initialized axon
+            # and wedged on the dead tunnel); jax.config.update before
+            # first backend use is the only honored path
+            os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+            import jax as _jax_cpu
+
+            _jax_cpu.config.update("jax_platforms", "cpu")
             result["error"] = "tpu backend unavailable after bounded retries"
             log("# TPU never answered; falling back to CPU smoke mode")
         else:
@@ -179,6 +250,7 @@ def main():
             try:
                 import dataclasses
 
+                log("# flash attention probe starting")
                 cfg_fl = dataclasses.replace(cfg, attention="flash")
                 step_fl, init_fl = make_gpt_train_step(cfg_fl)
                 jit_fl = jax.jit(step_fl, donate_argnums=(0,))
@@ -219,6 +291,7 @@ def main():
 
         compiled = easydist_compile(step, mesh=mesh)
         compiled(fresh(), tokens, targets)  # trigger compile outside timing
+        log("# easydist compile done")
 
         # model FLOPs per step from XLA's own cost analysis (for MFU)
         flops_per_step = None
@@ -264,7 +337,7 @@ def main():
             "timing": "two-point host-readback (block_until_ready is a "
                       "no-op through the tunnel)",
         })
-        if flops_per_step:
+        if flops_per_step and on_tpu:  # MFU vs TPU peak is meaningless on CPU
             achieved = flops_per_step / t_ed
             result["mfu"] = round(achieved / (peak * n_chips), 4)
             result["achieved_tflops"] = round(achieved / 1e12, 1)
@@ -278,8 +351,13 @@ def main():
         traceback.print_exc(file=sys.stderr)
         result["error"] = f"{type(e).__name__}: {e}"
 
-    print(json.dumps(result))
+    # flush immediately: if teardown wedges on the tunnel afterwards, the
+    # parent can still salvage this line from the pipe
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        child_main()
+    else:
+        main()

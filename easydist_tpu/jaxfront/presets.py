@@ -543,6 +543,25 @@ def _create_rule(eqn, world_size):
     return {"space": ShardSpace([]), "recombines": {}}
 
 
+@register_preset("pallas_call")
+def _pallas_call_rule(eqn, world_size):
+    """Pallas kernels stay REPLICATED under the auto-solver (for now).
+
+    Execution discovery cannot verify a sharded rebinding — the traced
+    eqn's grid_mapping bakes the full-shape grid, so binding shard-sized
+    operands is structurally invalid — and GSPMD cannot partition the
+    resulting Mosaic custom call either; honoring a SHARD placement would
+    need manual shard_map re-emission with a re-traced kernel (ROADMAP).
+    Declaring replicate analytically avoids nshards x candidates of doomed
+    eager executions and the failed-discovery warning per kernel.
+    Multi-device flash attention routes through parallel/ring_attention,
+    which composes the kernels per-shard explicitly."""
+    avals = _tensor_avals(eqn)
+    return {"space": ShardSpace([[DimSharding() for _ in a.shape]
+                                 for a in avals]),
+            "recombines": {}}
+
+
 @register_preset("sharding_constraint")
 def _sharding_constraint_rule(eqn, world_size):
     """User with_sharding_constraint markers pass through the solver as

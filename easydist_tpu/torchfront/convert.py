@@ -717,8 +717,30 @@ def _arange(*args, dtype=None, layout=None, device=None, pin_memory=None):
     return jnp.arange(*args)
 
 
+def _flash_eligible(q, k, v, attn_mask, dropout_p):
+    """Kernel auto-substitution gate: the Pallas flash kernels handle
+    4D [b, h, s, d] self-attention without an explicit mask (causal rides
+    the kernel's block skipping), equal q/k seq, lane-friendly shapes."""
+    if attn_mask is not None or dropout_p:
+        return False
+    if not (q.ndim == 4 and k.ndim == 4 and v.ndim == 4):
+        return False
+    s_q, d = q.shape[-2], q.shape[-1]
+    if k.shape[-2] != s_q or v.shape[-2] != s_q:
+        return False
+    return s_q >= 256 and s_q % 128 == 0 and 8 <= d <= 256 and d % 8 == 0
+
+
 @register_aten("aten.scaled_dot_product_attention.default")
 def _sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None):
+    if _flash_eligible(q, k, v, attn_mask, dropout_p):
+        # torch.compile-style kernel substitution, TPU-native: route SDPA
+        # to the Pallas flash-attention custom-vjp (fwd+bwd kernels) so
+        # converted HF-style models train with fused attention.  Happens
+        # pre-autodiff — jax differentiates through the custom_vjp.
+        from easydist_tpu.ops import flash_attention
+
+        return flash_attention(q, k, v, causal=bool(is_causal), scale=scale)
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum("...qd,...kd->...qk", q, k) * scale
     if is_causal:

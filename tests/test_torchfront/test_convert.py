@@ -80,6 +80,40 @@ def test_attention_conversion():
     assert_matches_torch(TinyAttention(), (torch.randn(2, 8, 32),))
 
 
+def test_sdpa_flash_substitution_forward_and_grad():
+    """At flash-eligible shapes (seq >= 256), SDPA conversion substitutes
+    the Pallas flash custom-vjp (torch.compile-style kernel pick, TPU
+    flash on device / interpreter here).  Forward AND gradients must match
+    eager torch."""
+    torch.manual_seed(11)
+    module = TinyAttention().eval()
+    x = torch.randn(1, 256, 32, requires_grad=True)
+
+    # the substitution actually fires at this shape
+    import easydist_tpu.torchfront.convert as conv
+    q = jnp.zeros((1, 4, 256, 8))
+    assert conv._flash_eligible(q, q, q, None, 0.0)
+    assert not conv._flash_eligible(q[:, :, :128], q[:, :, :128],
+                                    q[:, :, :128], None, 0.0)
+
+    fn, params, jax_inputs = assert_matches_torch(
+        module, (x.detach(),), rtol=2e-4, atol=2e-5)
+
+    # grad parity through the flash custom-vjp backward kernels
+    want = module(x).square().mean()
+    want.backward()
+
+    def loss(p, xin):
+        return jnp.mean(fn(p, xin) ** 2)
+
+    grads = jax.grad(loss)(params, jax_inputs[0])
+    ref = {n: p.grad.detach().numpy()
+           for n, p in module.named_parameters()}
+    for name, g in grads.items():
+        np.testing.assert_allclose(np.asarray(g), ref[name], rtol=2e-3,
+                                   atol=2e-5, err_msg=name)
+
+
 def test_convnet_conversion():
     assert_matches_torch(TinyConvNet(), (torch.randn(2, 3, 8, 8),))
 
