@@ -120,6 +120,37 @@ _FALLBACK = {
     "vs_baseline": 0.0,
 }
 
+# Durable perf evidence (VERDICT r3 #1): a good on-TPU measurement is
+# persisted here and COMMITTED, so one bad tunnel window at snapshot time
+# no longer erases the round's perf evidence — the stale payload (clearly
+# labeled) is emitted instead of a CPU-only smoke line.
+_LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_TPU_LAST_GOOD.json")
+
+
+def _save_last_good(result):
+    try:
+        payload = dict(result)
+        payload["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                               time.gmtime())
+        with open(_LAST_GOOD_PATH, "w") as f:
+            json.dump(payload, f, indent=1)
+        log(f"# TPU result persisted to {_LAST_GOOD_PATH}")
+    except Exception as e:
+        log(f"# could not persist last-good TPU result: {e}")
+
+
+def _load_last_good(stale_reason):
+    """Last-known-good TPU payload marked stale, or None."""
+    try:
+        with open(_LAST_GOOD_PATH) as f:
+            payload = json.load(f)
+        payload["stale"] = True
+        payload["stale_reason"] = stale_reason
+        return payload
+    except Exception:
+        return None
+
 
 def main():
     """Watchdog parent: run the measurement in a killable child under a
@@ -159,8 +190,10 @@ def main():
             log(f"# child exceeded {deadline:.0f}s (tunnel wedge?); killed")
         except Exception as e:
             log(f"# child attempt failed: {type(e).__name__}: {e}")
-    out = dict(_FALLBACK)
-    out["error"] = "benchmark child hung or died on every attempt"
+    out = _load_last_good("benchmark child hung or died on every attempt")
+    if out is None:
+        out = dict(_FALLBACK)
+        out["error"] = "benchmark child hung or died on every attempt"
     print(json.dumps(out))
 
 
@@ -181,6 +214,15 @@ def child_main():
             log(f"# persistent compile cache unavailable: {e}")
         got = _acquire_backend()
         if got is None:
+            # tunnel down: last-good TPU evidence (stale-labeled) beats a
+            # CPU smoke number every time
+            stale = _load_last_good(
+                "tpu backend unavailable at snapshot time; last-good "
+                "on-TPU measurement emitted instead of a CPU smoke run")
+            if stale is not None:
+                log("# TPU never answered; emitting last-good TPU payload")
+                print(json.dumps(stale), flush=True)
+                return
             platform, n_chips, kind, attempts = "cpu", 1, "host cpu", -1
             # the axon plugin's sitecustomize OVERRIDES the JAX_PLATFORMS
             # env var (measured: the env-var route still initialized axon
@@ -346,6 +388,8 @@ def child_main():
         log(f"# easydist {ed_tps:.0f} tok/s/chip, ratio {ratio:.4f} on "
             f"{n_chips} {platform} chip(s); total bench "
             f"{time.time()-t_start:.0f}s")
+        if on_tpu and "error" not in result:
+            _save_last_good(result)
     except Exception as e:  # never die rc!=0: always land the JSON line
         import traceback
         traceback.print_exc(file=sys.stderr)

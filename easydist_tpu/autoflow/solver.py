@@ -92,10 +92,14 @@ class SpmdSolver:
     """Solve one mesh axis for a coarsened MetaGraph."""
 
     def __init__(self, graph: MetaGraph, axis: MeshAxisSpec,
-                 reachability=None):
+                 reachability=None, free_outputs: bool = False):
         self.graph = graph
         self.axis = axis
         self.reachability = reachability
+        # composite-body solves (scan/remat): graph outputs cross the
+        # composite boundary with their own recombines, so sharded/partial
+        # outputs must not be priced as if handed back replicated
+        self.free_outputs = free_outputs
         self.clusters = graph.clusters
         self.edges: List[_Edge] = []
         self._collect_edges()
@@ -166,19 +170,29 @@ class SpmdSolver:
                         n_hit += measured is not None
                     if measured is not None:
                         full_t = measured
+                    elif node.compute_proxy is not None:
+                        full_t = node.compute_proxy
                     else:
                         full_t = sum(v.size_bytes() for v in node.outvars
                                      if v is not None) * inv_hbm
-                    # only SHARD splits the compute 1/n: a contracted-dim
-                    # dot (S inputs, P output) works on 1/n slices, but a
-                    # pure P-propagating op (P in -> P out) runs full-shape
-                    # on every rank, same as replicate
-                    sharded = any(
-                        p is not None and p.is_shard()
-                        for p in list(strat.out_placements)
-                        + list(strat.in_placements))
-                    factor = (1.0 / self.axis.size) if sharded else 1.0
-                    t += factor * full_t
+                    strat_compute = getattr(strat, "compute_cost", None)
+                    if strat_compute is not None:
+                        # composite strategies price their body per-op
+                        t += strat_compute
+                    else:
+                        # only SHARD splits the compute 1/n: a contracted-dim
+                        # dot (S inputs, P output) works on 1/n slices, but a
+                        # pure P-propagating op (P in -> P out) runs
+                        # full-shape on every rank, same as replicate
+                        sharded = any(
+                            p is not None and p.is_shard()
+                            for p in list(strat.out_placements)
+                            + list(strat.in_placements))
+                        factor = (1.0 / self.axis.size) if sharded else 1.0
+                        t += factor * full_t
+                    # composite ops (scan bodies) carry their internal
+                    # per-strategy collective seconds here
+                    t += getattr(strat, "intrinsic_cost", 0.0)
                 if t > 0.0:
                     if costs is None:
                         costs = np.zeros(c.strategy_count())
@@ -190,7 +204,8 @@ class SpmdSolver:
                         n_hit, n_comp, 100.0 * n_hit / n_comp)
         state_outs = set(self.graph.state_io)
         for var in self.graph.outputs:
-            if var.name in state_outs or var.producer is None:
+            if self.free_outputs or var.name in state_outs \
+                    or var.producer is None:
                 continue
             c = by_cid[var.producer.cluster_id]
             costs = self.output_y_cost.setdefault(

@@ -104,6 +104,14 @@ solver_cluster_dedup = _env_bool("EASYDIST_SOLVER_CLUSTER_DEDUP", True)
 # defer an all-reduce across linear consumers (reference metair.py:376-481
 # carries partials globally; previously composite-rule inner solves only)
 enable_partial_pools = _env_bool("EASYDIST_PARTIAL_POOLS", True)
+# lax.scan composite discovery: cap on per-seed body ILP solves (each seed
+# dim of each scan operand costs one small ILP; real models have dozens)
+scan_max_seed_solves = _env_int("EASYDIST_SCAN_MAX_SEED_SOLVES", 48)
+# warn when more than this fraction of modeled FLOPs lands on equations
+# whose chosen strategy is all-replicate on every mesh axis — the
+# silent-zero-parallelism failure mode (a user gets 1-chip performance on
+# an 8-chip mesh with no signal)
+replicate_warn_threshold = _env_float("EASYDIST_REPLICATE_WARN_THRESHOLD", 0.5)
 
 # ---------------- mesh / comm cost model ----------------
 # per-axis link bandwidth in bytes/s used to weight collective cost between

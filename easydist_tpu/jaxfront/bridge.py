@@ -81,6 +81,19 @@ def jaxpr_to_metagraph(closed_jaxpr, rules: Dict[str, dict],
                         invars=invars, outvars=outvars,
                         space=rule["space"], recombines=rule["recombines"],
                         arg_rows=arg_rows, sig=sig)
+        if rule.get("compute") is not None:
+            node.compute_proxy = float(rule["compute"])
+        if rule.get("strategies") is not None:
+            from easydist_tpu.metashard.metair import NodeStrategy
+
+            explicit = []
+            for ins, outs, cost, *rest in rule["strategies"]:
+                s = NodeStrategy(ins, outs)
+                s.intrinsic_cost = float(cost)
+                if rest:
+                    s.compute_cost = float(rest[0])
+                explicit.append(s)
+            node.explicit_strategies = explicit
         graph.add_op(node)
 
     for v in jaxpr.outvars:

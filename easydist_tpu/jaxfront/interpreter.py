@@ -198,6 +198,22 @@ class ShardingAnalyzer:
             if rule is not None:
                 return rule
 
+        # lax.scan: recursive body analysis with carry-placement threading —
+        # without it a scan-over-layers model (the idiomatic Llama-scale
+        # form) ships fully replicated.  The reference never hits this
+        # because make_fx fully unrolls (easydist/torch/compile.py:78-83);
+        # the TPU design keeps the rolled loop (XLA compiles the body once)
+        # and instead solves the body, pricing per-iteration collectives as
+        # the scan strategy's intrinsic cost.
+        if prim_name == "scan" and self.world_size > 1:
+            try:
+                rule = self._discover_scan(eqn)
+            except Exception as e:
+                logger.warning("scan discovery failed (%s): %s", sig, e)
+                rule = None
+            if rule is not None:
+                return rule
+
         if total > edconfig.discovery_hint_numel:
             rule = self._discover_shrunk(eqn, bind_fn, bind_params,
                                          prim_name)
@@ -220,6 +236,26 @@ class ShardingAnalyzer:
             self.prompts[prim_name] = space
         return {"space": space, "recombines": recombines}
 
+    def _analyze_inner(self, inner):
+        """Normalize a call-like eqn's body jaxpr and analyze it with this
+        analyzer's caches shared.  Returns (inner ClosedJaxpr, sub analyzer,
+        rules, shape_info) or None when the body isn't analyzable."""
+        from .inline import inline_calls
+
+        if inner is None:
+            return None
+        if not hasattr(inner, "jaxpr"):  # raw Jaxpr -> ClosedJaxpr
+            if inner.constvars:
+                return None
+            inner = jex_core.ClosedJaxpr(inner, ())
+        inner = inline_calls(inner)  # bodies keep nested pjit calls
+
+        sub = ShardingAnalyzer(inner, world_size=self.world_size)
+        sub.prompts = self.prompts  # share caches with the outer analysis
+        sub.rules = self.rules
+        rules, shape_info = sub.run()
+        return inner, sub, rules, shape_info
+
     def _discover_composite(self, eqn):
         """Analytic rule for a call-like eqn (jax.checkpoint body): analyze
         the inner jaxpr recursively, then propagate each candidate input
@@ -234,21 +270,10 @@ class ShardingAnalyzer:
         from easydist_tpu.metashard.metair import Placement
         from .bridge import jaxpr_to_metagraph
 
-        inner = eqn.params.get("jaxpr")
-        if inner is None:
+        got = self._analyze_inner(eqn.params.get("jaxpr"))
+        if got is None:
             return None
-        if not hasattr(inner, "jaxpr"):  # raw Jaxpr -> ClosedJaxpr
-            if inner.constvars:
-                return None
-            inner = jex_core.ClosedJaxpr(inner, ())
-        from .inline import inline_calls
-
-        inner = inline_calls(inner)  # remat bodies keep nested pjit calls
-
-        sub = ShardingAnalyzer(inner, world_size=self.world_size)
-        sub.prompts = self.prompts  # share caches with the outer analysis
-        sub.rules = self.rules
-        rules, shape_info = sub.run()
+        inner, sub, rules, shape_info = got
 
         in_rows = [v for v in eqn.invars
                    if not isinstance(v, jex_core.Literal)]
@@ -415,6 +440,224 @@ class ShardingAnalyzer:
         logger.info("composite rule for %s: %d shard groups",
                     eqn.primitive.name, len(groups))
         return {"space": ShardSpace(table), "recombines": recombines}
+
+    def _discover_scan(self, eqn):
+        """Composite rule for `lax.scan`: analyze the body recursively, then
+        solve the body graph once per seed input-dim with the carry threaded
+        back to its init placeholder (a state_io edge prices the
+        per-iteration reshard, so e.g. megatron TP's in-loop psum is priced,
+        not forbidden).  Each surviving assignment becomes one shard group
+        of the scan eqn whose `intrinsic_cost` = length x body collective
+        seconds — the outer ILP weighs it against boundary resharding.
+
+        Dim mapping: consts and carry rows map 1:1 into the body; xs/ys lose
+        their leading scan axis (outer dim d <-> body dim d-1; dim 0 itself
+        is the loop and never shards).
+
+        Emission needs no body rewrite: constraining the outer scan operands
+        (stacked params, init carry, xs) lets XLA's GSPMD partitioner
+        propagate into the while loop and place the in-loop collectives —
+        the standard rolled-layers form (MaxText/T5X style).
+        """
+        from easydist_tpu.autoflow import MeshAxisSpec, SpmdSolver
+        from easydist_tpu.metashard.metair import Placement
+        from .bridge import jaxpr_to_metagraph
+
+        params = eqn.params
+        num_consts = int(params.get("num_consts", 0))
+        num_carry = int(params.get("num_carry", 0))
+        length = int(params.get("length", 1))
+        got = self._analyze_inner(params.get("jaxpr"))
+        if got is None:
+            return None
+        inner, sub, rules, shape_info = got
+
+        body_invars = inner.jaxpr.invars
+        if len(eqn.invars) != len(body_invars):
+            return None
+        in_names = [sub.names.name(v) for v in body_invars]
+        body_outvars = inner.jaxpr.outvars
+        out_names = [None if isinstance(v, jex_core.Literal)
+                     else sub.names.name(v) for v in body_outvars]
+
+        # carry threading: body outvar k loops back into invar num_consts+k
+        carry_io = {}
+        for k in range(num_carry):
+            if out_names[k] is not None:
+                carry_io[out_names[k]] = in_names[num_consts + k]
+
+        axis = MeshAxisSpec("_scan", self.world_size)
+        carry_names = set(in_names[num_consts:num_consts + num_carry])
+
+        def solve_with_seed(seed_name, seed_dim, carries_replicate=False):
+            """Solve the body with the seed placeholder pinned; returns
+            ({var name: Placement}, body comm seconds) or None.
+            `carries_replicate` pins every carry to R so weight seeds
+            produce tensor-parallel assignments (otherwise free R->S slices
+            let batch-sharding dominate every solve)."""
+            target = Placement.shard(seed_dim)
+            g = jaxpr_to_metagraph(inner, rules, shape_info,
+                                   world_size=self.world_size,
+                                   names=sub.names, state_io=carry_io)
+            _inject_partial_propagation(g, self.world_size)
+
+            def excl(node):
+                if node.name == seed_name:
+                    return [s for s in node.strategy_pool(self.world_size)
+                            if repr(s.out_placements[0]) != repr(target)]
+                if carries_replicate and node.name in carry_names:
+                    return [s for s in node.strategy_pool(self.world_size)
+                            if not s.is_all_replicate()]
+                return []
+
+            # level 0 (one node per cluster): cone back-build only keeps
+            # sync-free intra-cluster assignments, which would hide e.g.
+            # TP's P->R psum edge from the pricing
+            g.coarsen(self.world_size, level=0, exclude_map=excl)
+            saved_dedup = edconfig.solver_cluster_dedup
+            edconfig.solver_cluster_dedup = False
+            try:
+                solver = SpmdSolver(g, axis, free_outputs=True)
+                chosen = solver.solve()
+            except Exception:
+                return None
+            finally:
+                edconfig.solver_cluster_dedup = saved_dedup
+            got = chosen.get(seed_name)
+            if got is None or repr(got.out_placements[0]) != repr(target):
+                return None  # divisibility removed the pin
+            comm = solver.assignment_comm_cost(chosen)
+            if not np.isfinite(comm):
+                return None
+            var_p = {}
+            for node in list(g.ops) + list(g.inputs):
+                s = chosen.get(node.name)
+                if s is None:
+                    continue
+                for v, p in zip(node.outvars, s.out_placements):
+                    if v is not None and p is not None:
+                        var_p[v.name] = p
+            # per-op body compute under this assignment (the outer solver's
+            # any-S discount heuristic, applied at body-op granularity)
+            compute = 0.0
+            for node in g.ops:
+                s = chosen.get(node.name)
+                out_bytes = sum(v.size_bytes() for v in node.outvars
+                                if v is not None)
+                sharded = s is not None and any(
+                    p is not None and p.is_shard()
+                    for p in list(s.out_placements) + list(s.in_placements))
+                compute += out_bytes / edconfig.hbm_bandwidth * (
+                    1.0 / self.world_size if sharded else 1.0)
+            return var_p, comm, compute
+
+        # graph-edge rows: every non-Literal invar, in order (bridge.py
+        # builds MetaNode.invars the same way)
+        edge_invars = [i for i, v in enumerate(eqn.invars)
+                       if not isinstance(v, jex_core.Literal)]
+        n_xs_start = num_consts + num_carry
+        strategies = []  # (in_placements, out_placements, cost)
+        seen_keys = set()
+        covered = set()  # (invar idx, outer dim) already sharded by a strat
+
+        def extract(var_p):
+            """Whole-body assignment -> (outer in placements, outer out
+            placements) with xs/ys dims shifted past the scan axis."""
+            ins = []
+            for i in edge_invars:
+                p = var_p.get(in_names[i])
+                if p is None or not p.is_shard():
+                    ins.append(Placement.replicate())
+                    continue
+                outer_dim = p.dim + 1 if i >= n_xs_start else p.dim
+                shape = tuple(eqn.invars[i].aval.shape)
+                if shape[outer_dim] % self.world_size != 0:
+                    return None  # inconsistent mapping; be safe
+                ins.append(Placement.shard(outer_dim))
+            if all(p.is_replicate() for p in ins):
+                return None
+            outs = []
+            for k, name in enumerate(out_names):
+                if k < num_carry:
+                    # authoritative carry placement is the init placeholder's
+                    # (a mismatched body output pays its priced reshard
+                    # inside the loop; GSPMD converges to the same fixed
+                    # point at emission)
+                    p = var_p.get(in_names[num_consts + k])
+                    outs.append(p if p is not None and p.is_shard()
+                                else Placement.replicate())
+                else:
+                    p = var_p.get(name) if name is not None else None
+                    if p is None:
+                        outs.append(Placement.replicate())
+                    elif p.is_shard():
+                        outs.append(Placement.shard(p.dim + 1))
+                    elif p.is_partial():
+                        outs.append(Placement.partial())
+                    else:
+                        outs.append(Placement.replicate())
+            return ins, outs
+
+        n_solves = 0
+        for i in edge_invars:
+            v = eqn.invars[i]
+            shape = tuple(v.aval.shape)
+            numel = int(np.prod(shape)) if shape else 1
+            if numel < self.world_size * 64:
+                continue  # bias-sized: may ride along, never seeds
+            is_xs = i >= n_xs_start
+            is_carry = num_consts <= i < n_xs_start
+            if not (is_carry or is_xs):
+                continue  # hoisted consts ride along with carry seeds
+            dim_range = range(1, len(shape)) if is_xs else range(len(shape))
+            for outer_d in dim_range:
+                if shape[outer_d] % self.world_size != 0 \
+                        or shape[outer_d] < self.world_size:
+                    continue
+                if (i, outer_d) in covered:
+                    continue  # already sharded by an earlier strategy
+                if n_solves >= edconfig.scan_max_seed_solves:
+                    break
+                n_solves += 1
+                body_d = outer_d - 1 if is_xs else outer_d
+                res = solve_with_seed(in_names[i], body_d,
+                                      carries_replicate=is_xs)
+                if res is None:
+                    continue
+                got = extract(res[0])
+                if got is None:
+                    continue
+                ins, outs = got
+                key = (tuple(repr(p) for p in ins),
+                       tuple(repr(p) for p in outs))
+                if key in seen_keys:
+                    continue
+                seen_keys.add(key)
+                strategies.append((ins, outs, length * res[1],
+                                   length * res[2]))
+                for j, p in zip(edge_invars, ins):
+                    if p.is_shard():
+                        covered.add((j, p.dim))
+
+        if not strategies:
+            return None
+        # full-compute proxy: the scan's work is length x the body's, far
+        # more than its boundary bytes — without this the outer solver's
+        # byte proxy under-prices replication and TP's intrinsic psum cost
+        # would never be worth paying
+        body_bytes = 0.0
+        for beqn in inner.jaxpr.eqns:
+            for bv in beqn.outvars:
+                if hasattr(bv.aval, "shape"):
+                    body_bytes += (np.dtype(bv.aval.dtype).itemsize
+                                   * int(np.prod(bv.aval.shape)))
+        compute = length * body_bytes / edconfig.hbm_bandwidth
+
+        logger.info("scan rule: %d whole-body strategies (body %d eqns, "
+                    "length %d)", len(strategies), len(inner.jaxpr.eqns),
+                    length)
+        return {"space": None, "recombines": {},
+                "strategies": strategies, "compute": compute}
 
     def _discover_shrunk(self, eqn, bind_fn, bind_params, prim_name):
         """Discovery on a size-reduced instance of the eqn, or None if the

@@ -98,6 +98,15 @@ class NodeStrategy:
                  out_placements: Sequence[Optional[Placement]]):
         self.in_placements = list(in_placements)
         self.out_placements = list(out_placements)
+        # seconds of communication INSIDE the op under this strategy, priced
+        # linearly by the solver (composite ops — a TP-sharded scan body pays
+        # its per-iteration psums here; plain ops leave it 0)
+        self.intrinsic_cost: float = 0.0
+        # absolute compute seconds under this strategy (composite ops price
+        # their body per-op: a strategy sharding only a trivial input must
+        # not earn the whole body's 1/n discount); None -> the solver's
+        # any-S factor heuristic
+        self.compute_cost: Optional[float] = None
 
     def is_all_replicate(self) -> bool:
         return all(p is None or p.is_replicate() for p in self.out_placements)
@@ -161,6 +170,14 @@ class MetaNode:
         self.outvars = outvars
         self.space = space
         self.recombines = recombines or {}
+        # whole-node strategies that bypass the group table (composite ops:
+        # a scan's candidate assignments overlap on dims, which one-group-
+        # per-cell tables cannot encode).  List of NodeStrategy.
+        self.explicit_strategies: Optional[List[NodeStrategy]] = None
+        # full (unsharded) compute seconds when the node hides more work
+        # than its output bytes show (scan: length x body); None -> the
+        # solver's HBM byte proxy
+        self.compute_proxy: Optional[float] = None
         self.arg_rows = arg_rows if arg_rows is not None else list(range(len(invars)))
         self.is_input = is_input
         self.cluster_id = -1
@@ -237,12 +254,15 @@ class MetaNode:
         if self.pinned is not None:
             return [self.pinned]
         if self._pool_cache is None:
-            pool = []
-            for group in sorted(self.recombines):
-                s = self._strategy_for_group(group)
-                if s is not None:
-                    pool.append(s)
-            self._pool_cache = pool
+            if self.explicit_strategies is not None:
+                self._pool_cache = list(self.explicit_strategies)
+            else:
+                pool = []
+                for group in sorted(self.recombines):
+                    s = self._strategy_for_group(group)
+                    if s is not None:
+                        pool.append(s)
+                self._pool_cache = pool
 
         def divisible(s: NodeStrategy) -> bool:
             vars_for_in = self.outvars if self.is_input else self.invars
@@ -275,6 +295,10 @@ class MetaNode:
 
     def __repr__(self) -> str:
         return f"MetaNode({self.name}: {self.op_key})"
+
+
+# control-flow composites solved as their own cluster (see coarsen)
+_SOLO_CLUSTER_OPS = {"scan", "while", "cond"}
 
 
 # ---------------------------------------------------------------- clusters
@@ -463,6 +487,13 @@ class MetaGraph:
         find_cone_roots, metair.py:852-892)."""
         roots = []
         for node in self.ops:
+            if node.op_key in _SOLO_CLUSTER_OPS:
+                # composites must never be grown into a downstream cone:
+                # back-build would sync-free-match their many-input boundary
+                # and silently drop strategies (a single-outvar scan passes
+                # every other root test)
+                roots.append(node)
+                continue
             # externally-visible edges: every consumer, plus each dangling /
             # graph-output var (no consumers).  A cone interior node must
             # have exactly one — multi-output prims like scan whose extra
@@ -526,7 +557,15 @@ class MetaGraph:
 
         for root in roots:
             c = MetaNodeCluster(len(self.clusters))
-            grow(root, c)
+            if root.op_key in _SOLO_CLUSTER_OPS:
+                # composite ops price their internals via intrinsic_cost and
+                # have many-input boundaries; absorbing producers into their
+                # cone would DROP any strategy a producer can't serve
+                # sync-free (R->S is a free slice when priced as an edge)
+                c.add(root)
+                visited.add(root.uid)
+            else:
+                grow(root, c)
             c.finalize(axis_size, exclude_map)
             self.clusters.append(c)
 

