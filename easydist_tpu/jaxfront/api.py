@@ -86,6 +86,47 @@ def infer_state_io(args, out_shape) -> Dict[int, int]:
 
 # ------------------------------------------------------------------ emission
 
+def _emit_attention_variant(eqn, strategies, axis_names, mesh, invals):
+    """Lower an ed_attention_{fwd,bwd} eqn to the ring/Ulysses program when
+    the solver chose a seq-shard strategy (the variant rides the strategy's
+    meta, set by the preset rule).  Returns the output list, or None for
+    the generic primitive bind (batch/head strategies: GSPMD partitions the
+    lowered einsum ops via the constraints already applied)."""
+    if eqn.primitive.name not in ("ed_attention_fwd", "ed_attention_bwd"):
+        return None
+    variant = axis = None
+    for ax_name, s in zip(axis_names, strategies):
+        meta = getattr(s, "meta", None) if s is not None else None
+        if meta and meta.get("variant"):
+            variant, axis = meta["variant"], ax_name
+            break
+    if variant is None:
+        return None
+    causal = eqn.params["causal"]
+    scale = eqn.params["scale"]
+    # re-validate the variant for the ACTUAL axis (the rule priced it at
+    # the analyzer's min-axis world size): Ulysses needs head divisibility
+    # on THIS axis, and the ring/Ulysses crossover moves with axis size
+    n_axis = int(mesh.shape[axis])
+    heads = eqn.invars[0].aval.shape[1]
+    if variant == "ulysses" and heads % n_axis != 0:
+        variant = "ring"
+    if variant == "ulysses":
+        from easydist_tpu.parallel.ulysses import ulysses_attention as attn
+    else:
+        from easydist_tpu.parallel.ring_attention import ring_attention as attn
+
+    if eqn.primitive.name == "ed_attention_fwd":
+        q, k, v = invals
+        return [attn(q, k, v, mesh, axis=axis, causal=causal, scale=scale)]
+    q, k, v, dout = invals
+    # flash-style recompute backward: vjp of the SAME sequence-parallel
+    # program — no [t,t] residual, collectives exactly as priced
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attn(q_, k_, v_, mesh, axis=axis, causal=causal,
+                                scale=scale), q, k, v)
+    return list(vjp(dout))
+
 def _combined_spec(placements: List[Optional[Placement]],
                    axis_names: Sequence[str], ndim: int) -> PartitionSpec:
     """Merge per-axis placements into one PartitionSpec."""
@@ -201,9 +242,12 @@ def emit_sharded_fn(closed_jaxpr, names: VarNames,
                         val, NamedSharding(mesh, spec))
                 var_pos += 1
 
-            out = eqn.primitive.bind(*subfuns, *invals, **bind_params)
-            if not eqn.primitive.multiple_results:
-                out = [out]
+            out = _emit_attention_variant(eqn, strategies, axis_names, mesh,
+                                          invals)
+            if out is None:
+                out = eqn.primitive.bind(*subfuns, *invals, **bind_params)
+                if not eqn.primitive.multiple_results:
+                    out = [out]
             for var, val in zip(eqn.outvars, out):
                 env[var] = val
             for u in overlay_evict.pop(idx, ()):

@@ -90,8 +90,12 @@ def jaxpr_to_metagraph(closed_jaxpr, rules: Dict[str, dict],
             for ins, outs, cost, *rest in rule["strategies"]:
                 s = NodeStrategy(ins, outs)
                 s.intrinsic_cost = float(cost)
-                if rest:
+                if rest and rest[0] is not None:
                     s.compute_cost = float(rest[0])
+                if len(rest) > 1 and rest[1]:
+                    # emission metadata (e.g. attention variant ring/ulysses
+                    # — same boundary placements, different lowering)
+                    s.meta = dict(rest[1])
                 explicit.append(s)
             node.explicit_strategies = explicit
         graph.add_op(node)

@@ -571,3 +571,70 @@ def _sharding_constraint_rule(eqn, world_size):
     row = [DimSharding(group=d + 1) for d in range(aval.ndim)]
     recombines = {d + 1: _concat(d) for d in range(aval.ndim)}
     return {"space": ShardSpace([row]), "recombines": recombines}
+
+
+# ---------------------------------------------------- attention composite
+
+def _attention_strategies(eqn, world_size, backward):
+    """Explicit strategy pool for the ed_attention_{fwd,bwd} primitives
+    (SURVEY §7 step 7: ring/Ulysses as solver-visible strategies).
+
+    Rows: fwd (q, k, v) / bwd (q, k, v, dout), all [b, h, t, d].
+    batch and head sharding are comm-free; seq sharding prices the cheaper
+    of ring (ppermute) and Ulysses (all_to_all) as intrinsic cost, with the
+    winning variant recorded in strategy meta for emission."""
+    import numpy as np
+
+    from easydist_tpu import config as edconfig
+    from easydist_tpu.metashard.metair import Placement
+    from easydist_tpu.ops.attention_prim import seq_strategy_costs
+
+    q_aval = eqn.invars[0].aval
+    b, h, t, d = q_aval.shape
+    n_in = 4 if backward else 3
+    n_out = 3 if backward else 1
+    dtype_bytes = np.dtype(q_aval.dtype).itemsize
+
+    def strat(dim):
+        return ([Placement.shard(dim)] * n_in,
+                [Placement.shard(dim)] * n_out)
+
+    # MXU-bound compute proxy: 2 matmuls of 2*b*h*t^2*d flops each (the
+    # backward does ~2.5x); bytes/hbm under-prices attention by the t/d
+    # ratio at long sequence
+    flops = 4.0 * b * h * float(t) * t * d * (2.5 if backward else 1.0)
+    full_compute = flops / edconfig.peak_flops
+    shard_compute = full_compute / world_size
+
+    strategies = []
+    if b % world_size == 0:
+        ins, outs = strat(0)
+        strategies.append((ins, outs, 0.0, shard_compute, None))
+    if h % world_size == 0:
+        ins, outs = strat(1)
+        strategies.append((ins, outs, 0.0, shard_compute, None))
+    if t % world_size == 0 and world_size > 1:
+        ring, ulysses = seq_strategy_costs((b, h, t, d), dtype_bytes,
+                                           world_size, backward)
+        # Ulysses needs head divisibility for its head-shard inner compute
+        if h % world_size == 0 and ulysses < ring:
+            cost, variant = ulysses, "ulysses"
+        else:
+            cost, variant = ring, "ring"
+        ins, outs = strat(2)
+        strategies.append((ins, outs, cost, shard_compute,
+                           {"variant": variant}))
+    if not strategies:
+        return None
+    return {"space": None, "recombines": {}, "strategies": strategies,
+            "compute": full_compute}
+
+
+@register_preset("ed_attention_fwd")
+def _attention_fwd_rule(eqn, world_size):
+    return _attention_strategies(eqn, world_size, backward=False)
+
+
+@register_preset("ed_attention_bwd")
+def _attention_bwd_rule(eqn, world_size):
+    return _attention_strategies(eqn, world_size, backward=True)

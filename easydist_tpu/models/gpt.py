@@ -26,8 +26,10 @@ class GPTConfig:
     heads: int = 12
     layers: int = 12
     dtype: str = "float32"  # compute dtype; params stay float32
-    # attention backend: "einsum" (XLA), "flash" (Pallas kernel), or
-    # "ring" (sequence-parallel ring attention; needs attn_mesh + attn_axis)
+    # attention backend: "einsum" (XLA), "flash" (Pallas kernel), "ring"
+    # (sequence-parallel ring attention; needs attn_mesh + attn_axis), or
+    # "auto" (solver-visible composite — the auto-parallel ILP chooses
+    # batch/head/seq-ring/seq-Ulysses per mesh axis)
     attention: str = "einsum"
     attn_mesh: object = None
     attn_axis: str = "sp"
@@ -110,7 +112,14 @@ def _attention(x, p, cfg: "GPTConfig", dtype):
         return t_.reshape(b, t, heads, hd).transpose(0, 2, 1, 3)
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
-    if cfg.attention == "flash":
+    if cfg.attention == "auto":
+        # solver-visible composite: the auto-parallel ILP picks batch/head/
+        # sequence (ring or Ulysses) sharding per mesh axis and emission
+        # lowers accordingly (ops/attention_prim.py)
+        from easydist_tpu.ops.attention_prim import attention as ed_attention
+
+        out = ed_attention(q, k, v, causal=True)
+    elif cfg.attention == "flash":
         from easydist_tpu.ops import flash_attention
 
         out = flash_attention(q, k, v, True)

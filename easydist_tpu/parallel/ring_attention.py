@@ -129,5 +129,9 @@ def ring_attention(q, k, v, mesh, axis: str = "sp", causal: bool = True,
     fn = functools.partial(_ring_attention_local, axis=axis, causal=causal,
                            scale=scale, block_impl=block_impl)
     spec = P(None, None, axis, None)
+    # manual ONLY over `axis`: other mesh axes stay GSPMD-auto, so a batch
+    # or head sharding chosen on a sibling axis (hybrid dp x sp) survives
+    # into the block compute instead of being forced replicated
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_vma=False)(q, k, v)
+                     out_specs=spec, axis_names=frozenset({axis}),
+                     check_vma=False)(q, k, v)

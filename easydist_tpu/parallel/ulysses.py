@@ -18,14 +18,10 @@ from jax.sharding import PartitionSpec as P
 
 
 def _full_attention(q, k, v, causal: bool, scale: float):
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-    if causal:
-        t = q.shape[2]
-        qi = jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
-        ki = jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
-        s = jnp.where(ki <= qi, s, jnp.array(-1e30, s.dtype))
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    # single source of truth for the reference attention math
+    from easydist_tpu.ops.attention_prim import _einsum_attention
+
+    return _einsum_attention(q, k, v, causal, scale)
 
 
 def ulysses_attention(q, k, v, mesh, axis: str = "sp", causal: bool = True,
@@ -54,5 +50,8 @@ def ulysses_attention(q, k, v, mesh, axis: str = "sp", causal: bool = True,
         return head2seq(out)
 
     spec = P(None, None, axis, None)
+    # manual ONLY over `axis` (sibling mesh axes stay GSPMD-auto; see
+    # ring_attention)
     return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_vma=False)(q, k, v)
+                     out_specs=spec, axis_names=frozenset({axis}),
+                     check_vma=False)(q, k, v)

@@ -285,3 +285,47 @@ def test_partial_region_psum_scatter_fence(cpu_devices):
     hlo = jitted.lower(x, w).compile().as_text()
     assert "reduce-scatter" in hlo, "fence did not lower to reduce-scatter"
     assert "all-reduce" not in hlo
+
+
+@pytest.mark.world_8
+@pytest.mark.long_duration
+def test_solver_chooses_sequence_parallel_attention(cpu_devices):
+    """VERDICT r3 #3 gate: with the solver-visible attention composite
+    (attention="auto"), a long-sequence model on an sp axis must (a) have
+    the ILP CHOOSE a sequence-parallel variant (ring/Ulysses — priced
+    ppermute/all_to_all intrinsic vs compute saving), and (b) emit a
+    program moving far fewer collective bytes than the einsum path's
+    gather-KV sequence parallelism (measured r4: 8.5MB vs 276MB)."""
+    from easydist_tpu.models.gpt import GPTConfig as _Cfg
+
+    # heads (4) < axis (8): head-sharding cannot cover the axis, the
+    # regime where sequence parallelism is actually needed (with heads >=
+    # axis the solver rightly picks free head-sharding instead)
+    mesh = make_device_mesh((8,), ("sp",), devices=cpu_devices)
+    kw = dict(vocab=256, seq=8192, dim=64, heads=4, layers=1)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (1, kw["seq"]), 0, 256)
+
+    bytes_by = {}
+    res_auto = None
+    for attn in ("einsum", "auto"):
+        cfg = _Cfg(**kw, attention=attn)
+        step, init_state = make_gpt_train_step(cfg)
+        state = init_state(jax.random.PRNGKey(0))
+        res = easydist_compile(step, mesh=mesh, compile_only=True)(
+            state, tok, tok)
+        bytes_by[attn] = total_collective_bytes(
+            collective_summary(res.executable().as_text()))
+        if attn == "auto":
+            res_auto = res
+
+    # (a) the solver picked a seq-parallel variant for the attention eqns
+    attn_names = {n.name for n in res_auto.graph.ops
+                  if n.op_key.startswith("ed_attention")}
+    variants = [s.meta.get("variant")
+                for chosen in res_auto.strategies
+                for name, s in chosen.items()
+                if name in attn_names and getattr(s, "meta", None)]
+    assert variants, "no attention eqn carries a seq-parallel variant"
+    assert set(variants) <= {"ring", "ulysses"}, variants
+    # (b) half the bytes of the gather-KV plan, with huge margin
+    assert bytes_by["auto"] * 2 < bytes_by["einsum"], bytes_by
