@@ -978,14 +978,34 @@ def easydist_compile(func=None, mesh=None, state_io="auto",
                      donate_state: Optional[bool] = None,
                      compile_only: bool = False,
                      max_solver_time: Optional[float] = None,
-                     liveness_only_input: Optional[bool] = None):
-    """Decorator entrypoint (reference jax/api.py:307-323)."""
+                     liveness_only_input: Optional[bool] = None,
+                     pp_stages: Optional[int] = None,
+                     n_microbatches: Optional[int] = None,
+                     pp_axis: str = "pp", schedule: str = "gpipe",
+                     lr: float = 1e-4, optimizer: str = "adam"):
+    """Decorator entrypoint (reference jax/api.py:307-323).
+
+    With `pp_stages=` the decorated function is treated as a LOSS function
+    `loss_fn(params, *batch) -> scalar` and compiled into a hybrid
+    auto-PP x auto-SPMD train step (jaxfront/pp_compile.py — the
+    reference's schedule_cls path, compile_auto.py:683-715)."""
     if max_solver_time is not None:
         edconfig.solver_time_limit = max_solver_time
     if liveness_only_input is not None:
         edconfig.liveness_only_input = liveness_only_input
 
     def wrap(f):
+        if pp_stages is not None:
+            from .pp_compile import PPCompiledFunction
+
+            m = mesh or get_device_mesh()
+            if m is None:
+                raise ValueError("pp_stages= needs an explicit mesh")
+            return PPCompiledFunction(
+                f, m, pp_stages=pp_stages,
+                n_microbatches=n_microbatches or pp_stages * 2,
+                pp_axis=pp_axis, schedule=schedule, lr=lr,
+                optimizer=optimizer)
         return CompiledFunction(f, mesh=mesh, state_io=state_io,
                                 donate_state=donate_state,
                                 compile_only=compile_only)

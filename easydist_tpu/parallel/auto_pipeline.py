@@ -114,6 +114,7 @@ class _StagePlan:
             ends = _balanced_splits([_eqn_flops(e) for e in eqns], n_stages)
         starts = [0] + ends[:-1]
         self.stage_eqns = [eqns[s:e] for s, e in zip(starts, ends)]
+        self.stage_starts = starts  # global eqn index of each stage's first
         self.n_stages = n_stages
 
         def_stage: Dict = {}
@@ -217,7 +218,10 @@ class _StagePlan:
 
 def pipeline_forward(fn: Callable, example_params, example_mb, mesh,
                      n_stages: int, n_microbatches: int, axis: str = "pp",
-                     shard_params: bool = False):
+                     shard_params: bool = False,
+                     auto_axes: bool = False,
+                     eqn_constraints=None,
+                     remat_stages: bool = False):
     """Auto-split `fn(params, mb)` into a pipelined callable.
 
     Stages split at user `split_point` markers when present, else at
@@ -230,6 +234,15 @@ def pipeline_forward(fn: Callable, example_params, example_mb, mesh,
     memory ~1/n_stages); leaves used across stages stay replicated.  Call
     as pipe(pack_params(params), microbatches); the reference equivalent is
     the per-stage submod params of compile_pipeline.py:762-1087.
+
+    auto_axes=True shard_maps manually over ONLY `axis`: every other mesh
+    axis stays GSPMD-auto inside the stage branches, so solver-chosen dp/tp
+    shardings apply within stages (the hybrid auto-PP x SPMD path,
+    jaxfront/pp_compile.py).  `eqn_constraints` maps a global eqn index to
+    a list of per-invar NamedShardings (None entries skipped) enforced
+    with `with_sharding_constraint` during branch replay.
+    remat_stages=True wraps each stage branch in jax.checkpoint (gpipe
+    backward holds all microbatch residuals; remat trades recompute).
     """
     closed = inline_calls(jax.make_jaxpr(fn)(example_params, example_mb))
     plan = _StagePlan(closed, n_stages)
@@ -269,11 +282,20 @@ def pipeline_forward(fn: Callable, example_params, example_mb, mesh,
             def read(v):
                 return v.val if isinstance(v, jex_core.Literal) else env[v]
 
-            for eqn in plan.stage_eqns[s]:
+            for local_i, eqn in enumerate(plan.stage_eqns[s]):
                 subfuns, bind_params = eqn.primitive.get_bind_params(eqn.params)
-                out = eqn.primitive.bind(*subfuns,
-                                         *[read(v) for v in eqn.invars],
-                                         **bind_params)
+                invals = [read(v) for v in eqn.invars]
+                specs = eqn_constraints.get(plan.stage_starts[s] + local_i) \
+                    if eqn_constraints else None
+                if specs:
+                    # solver-chosen dp/tp shardings inside the stage (legal
+                    # because those axes stay GSPMD-auto under auto_axes)
+                    for j, sp in enumerate(specs):
+                        if sp is not None and hasattr(invals[j], "ndim") \
+                                and invals[j].ndim > 0:
+                            invals[j] = jax.lax.with_sharding_constraint(
+                                invals[j], sp)
+                out = eqn.primitive.bind(*subfuns, *invals, **bind_params)
                 if not eqn.primitive.multiple_results:
                     out = [out]
                 for var, val in zip(eqn.outvars, out):
@@ -292,6 +314,8 @@ def pipeline_forward(fn: Callable, example_params, example_mb, mesh,
         return branch
 
     branches = [make_branch(s) for s in range(S)]
+    if remat_stages:
+        branches = [jax.checkpoint(b) for b in branches]
 
     def pipelined(params, microbatches):
         if shard_params:
@@ -307,10 +331,15 @@ def pipeline_forward(fn: Callable, example_params, example_mb, mesh,
                 f"microbatches pytree has {len(mb_leaves)} leaves; the traced "
                 f"function expects {len(data_vars)}")
 
+        sm_kwargs = dict(mesh=mesh, check_vma=False)
+        if auto_axes:
+            # manual ONLY over pp; sibling axes stay GSPMD-auto so the
+            # eqn_constraints (and jit-level data/param shardings) hold
+            sm_kwargs["axis_names"] = frozenset({axis})
+
         @lambda f: shard_map(
-            f, mesh=mesh,
-            in_specs=(param_spec, tuple(P() for _ in mb_leaves)),
-            out_specs=P(), check_vma=False)
+            f, in_specs=(param_spec, tuple(P() for _ in mb_leaves)),
+            out_specs=P(), **sm_kwargs)
         def run(param_vals, x_mb_leaves):
             if shard_params:
                 packed_local, shared_vals_l = param_vals
