@@ -982,13 +982,19 @@ def easydist_compile(func=None, mesh=None, state_io="auto",
                      pp_stages: Optional[int] = None,
                      n_microbatches: Optional[int] = None,
                      pp_axis: str = "pp", schedule: str = "gpipe",
-                     lr: float = 1e-4, optimizer: str = "adam"):
+                     lr: Optional[float] = None, optimizer="adam"):
     """Decorator entrypoint (reference jax/api.py:307-323).
 
     With `pp_stages=` the decorated function is treated as a LOSS function
-    `loss_fn(params, *batch) -> scalar` and compiled into a hybrid
-    auto-PP x auto-SPMD train step (jaxfront/pp_compile.py — the
-    reference's schedule_cls path, compile_auto.py:683-715)."""
+    `loss_fn(params, *batch) -> scalar` (mean reduction over the batch) and
+    compiled into a hybrid auto-PP x SPMD train step
+    (jaxfront/pp_compile.py — the reference's schedule_cls path,
+    compile_auto.py:683-715).  The pp path has a different contract (it
+    returns a train step with its own optimizer state, not a compiled copy
+    of `func`), so the non-pp kwargs `state_io` / `donate_state` /
+    `compile_only` are rejected loudly rather than silently ignored;
+    `optimizer` accepts "adam", "sgd", or an optax GradientTransformation.
+    """
     if max_solver_time is not None:
         edconfig.solver_time_limit = max_solver_time
     if liveness_only_input is not None:
@@ -998,6 +1004,16 @@ def easydist_compile(func=None, mesh=None, state_io="auto",
         if pp_stages is not None:
             from .pp_compile import PPCompiledFunction
 
+            dropped = [name for name, val, default in (
+                ("state_io", state_io, "auto"),
+                ("donate_state", donate_state, None),
+                ("compile_only", compile_only, False)) if val != default]
+            if dropped:
+                raise ValueError(
+                    f"easydist_compile(pp_stages=...) does not support "
+                    f"{dropped}: the hybrid path manages its own train "
+                    f"state (donated whole) and always compiles lazily on "
+                    f"the first init_state call")
             m = mesh or get_device_mesh()
             if m is None:
                 raise ValueError("pp_stages= needs an explicit mesh")
