@@ -1022,6 +1022,17 @@ def easydist_compile(func=None, mesh=None, state_io="auto",
                 n_microbatches=n_microbatches or pp_stages * 2,
                 pp_axis=pp_axis, schedule=schedule, lr=lr,
                 optimizer=optimizer)
+        pp_only = [name for name, val, default in (
+            ("n_microbatches", n_microbatches, None),
+            ("pp_axis", pp_axis, "pp"), ("schedule", schedule, "gpipe"),
+            ("lr", lr, None), ("optimizer", optimizer, "adam"))
+            if val != default]
+        if pp_only:
+            raise ValueError(
+                f"{pp_only} only apply with pp_stages=; without it the "
+                f"decorated function IS the train step (it owns its "
+                f"optimizer), so silently dropping them would change "
+                f"training behavior")
         return CompiledFunction(f, mesh=mesh, state_io=state_io,
                                 donate_state=donate_state,
                                 compile_only=compile_only)

@@ -187,6 +187,28 @@ class PPCompiledFunction:
                     "first init_state call needs an example batch: "
                     "init_state(params, *batch)")
             self._build(params, example_batch)
+            self._param_struct = jax.tree_util.tree_map(
+                lambda x: (tuple(x.shape), jnp.result_type(x)), params)
+            return self._built[1](params)
+        # re-init against the existing build: the stage plan and packed
+        # layout were traced once, so a different geometry must rebuild
+        # (a fresh instance), not silently re-pack through the stale plan
+        pstruct = jax.tree_util.tree_map(
+            lambda x: (tuple(x.shape), jnp.result_type(x)), params)
+        if pstruct != self._param_struct:
+            raise ValueError(
+                "params shape/dtype signature differs from the one this "
+                "step was built with; build a new "
+                "easydist_compile(pp_stages=...) instance")
+        if example_batch:
+            bstruct = jax.tree_util.tree_map(
+                lambda x: (tuple(x.shape), jnp.result_type(x)),
+                example_batch)
+            if bstruct != self._batch_struct:
+                raise ValueError(
+                    f"batch signature {bstruct} differs from the build's "
+                    f"{self._batch_struct}; build a new "
+                    f"easydist_compile(pp_stages=...) instance")
         return self._built[1](params)
 
     def __call__(self, state, *batch):

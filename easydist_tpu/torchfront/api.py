@@ -226,12 +226,10 @@ def make_torch_train_step(module, example_args, loss_fn: Callable,
         hyper, translate_state = {}, None
 
     if train:
-        if parallel_mode != "auto":
-            raise NotImplementedError(
-                "training-mode export supports parallel_mode='auto'")
         return _make_train_mode_step(module, example_args, loss_fn,
                                      optimizer, lr, hyper, translate_state,
-                                     mesh, **kwargs)
+                                     mesh, parallel_mode=parallel_mode,
+                                     **kwargs)
 
     fwd, params0 = torch_module_to_jax(module, example_args)
     # buffers (batch-norm running stats etc.) are not weights: keep them out
@@ -335,10 +333,20 @@ def make_torch_train_step(module, example_args, loss_fn: Callable,
 
 
 def _make_train_mode_step(module, example_args, loss_fn, optimizer, lr,
-                          hyper, translate_state, mesh, **kwargs):
+                          hyper, translate_state, mesh,
+                          parallel_mode: str = "auto", **kwargs):
     """Training-mode export: dropout rng threading + batch-norm running
     stats in the state.  state = ((trainable, buffers), opt_state);
-    step(state, rng, inputs, *targets) -> (state, loss)."""
+    step(state, rng, inputs, *targets) -> (state, loss).
+
+    parallel_mode "ddp"/"zero2"/"zero3" (reference torch/api.py +
+    compile_dp.py) is expressed TPU-style: one jit with pinned GSPMD
+    placements instead of per-rank NCCL programs — batch sharded over the
+    mesh's first axis (GSPMD inserts the grad all-reduce), optimizer
+    moments flat-sharded over it for zero2, parameters too for zero3
+    (GSPMD all-gathers weights at use — the ZeRO-3 gather).  Batch-norm
+    statistics stay GLOBAL-batch exact (single-process eager semantics;
+    torch DDP's unsynced per-rank BN is weaker)."""
     fwd, params0 = torch_module_to_jax(module, example_args, train=True)
     buffer_names = fwd.buffer_names
     trainable0 = {k: v for k, v in params0.items()
@@ -402,4 +410,66 @@ def _make_train_mode_step(module, example_args, loss_fn, optimizer, lr,
     else:
         raise ValueError(f"unknown optimizer {optimizer!r}")
 
-    return easydist_compile(step, mesh=mesh, **kwargs), init_state
+    if parallel_mode == "auto":
+        return easydist_compile(step, mesh=mesh, **kwargs), init_state
+    if parallel_mode not in ("ddp", "zero2", "zero3"):
+        raise ValueError(f"unknown parallel_mode {parallel_mode!r}")
+
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from easydist_tpu.jaxfront.mesh import get_device_mesh
+
+    mesh = mesh or get_device_mesh()
+    if mesh is None:
+        raise ValueError(f"parallel_mode={parallel_mode!r} needs a mesh")
+    axis = mesh.axis_names[0]
+    n_dp = mesh.shape[axis]
+    repl = NamedSharding(mesh, P())
+
+    def _flat_place(tree):
+        """dim-0 flat sharding over the dp axis when divisible (the ZeRO
+        placement); indivisible/scalar leaves stay replicated."""
+        return jax.tree_util.tree_map(
+            lambda v: NamedSharding(mesh, P(axis))
+            if getattr(v, "ndim", 0) > 0 and v.shape[0] % n_dp == 0
+            else repl, tree)
+
+    def _state_shardings(state):
+        (tp, buf), opt = state
+        tp_s = _flat_place(tp) if parallel_mode == "zero3" \
+            else jax.tree_util.tree_map(lambda _: repl, tp)
+        buf_s = jax.tree_util.tree_map(lambda _: repl, buf)
+        opt_s = _flat_place(opt) if parallel_mode in ("zero2", "zero3") \
+            else jax.tree_util.tree_map(lambda _: repl, opt)
+        return ((tp_s, buf_s), opt_s)
+
+    def _shard_batch(t):
+        return jax.tree_util.tree_map(
+            lambda v: jax.lax.with_sharding_constraint(
+                v, NamedSharding(mesh, P(axis)))
+            if getattr(v, "ndim", 0) > 0 and v.shape[0] % n_dp == 0 else v,
+            t)
+
+    def manual_step(state, rng, inputs, *targets):
+        new_state, loss = step(state, rng, _shard_batch(inputs),
+                               *_shard_batch(targets))
+        new_state = jax.lax.with_sharding_constraint(
+            new_state, _state_shardings(new_state))
+        return new_state, loss
+
+    unsupported = set(kwargs) - {"donate_state"}
+    if unsupported:
+        raise ValueError(
+            f"{sorted(unsupported)} are not supported with "
+            f"parallel_mode={parallel_mode!r} train-mode export (the "
+            f"manual modes bypass easydist_compile; only donate_state "
+            f"applies)")
+    donate = (0,) if kwargs.get("donate_state", True) else ()
+    jitted = jax.jit(manual_step, donate_argnums=donate)
+
+    def placed_init_state():
+        state = init_state()
+        return jax.device_put(state, _state_shardings(state))
+
+    return jitted, placed_init_state

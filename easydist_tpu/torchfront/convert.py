@@ -522,7 +522,11 @@ def _t(x):
 
 @register_aten("aten.contiguous.default", "aten.clone.default",
                "aten.detach.default", "aten.alias.default",
-               "aten.lift_fresh_copy.default")
+               "aten.lift_fresh_copy.default",
+               # export-time metadata assertion (emitted for .to() calls):
+               # shapes/dtypes are static under jax tracing, so it holds
+               # by construction
+               "aten._assert_tensor_metadata.default")
 def _identity(x, *a, **k):
     return x
 
@@ -539,6 +543,11 @@ def _squeeze(x, dim):
 
 @register_aten("aten.cat.default")
 def _cat(tensors, dim=0):
+    # torch.cat accepts zero-element 1-D tensors whatever the target rank
+    # (the legacy empty-tensor special case) — HF attention concatenates an
+    # empty past_key_value placeholder with the fresh K/V this way
+    tensors = [t for t in tensors
+               if not (t.ndim == 1 and t.shape[0] == 0)] or tensors[:1]
     return jnp.concatenate(tensors, axis=dim)
 
 
