@@ -107,6 +107,10 @@ enable_partial_pools = _env_bool("EASYDIST_PARTIAL_POOLS", True)
 # lax.scan composite discovery: cap on per-seed body ILP solves (each seed
 # dim of each scan operand costs one small ILP; real models have dozens)
 scan_max_seed_solves = _env_int("EASYDIST_SCAN_MAX_SEED_SOLVES", 48)
+# lax.while_loop trip count is unknown at trace time; this estimate scales
+# the per-iteration collective price of a sharded loop body (solver only —
+# a wrong guess shifts the shard/replicate crossover, never correctness)
+while_trip_estimate = _env_int("EASYDIST_WHILE_TRIP_ESTIMATE", 16)
 # warn when more than this fraction of modeled FLOPs lands on equations
 # whose chosen strategy is all-replicate on every mesh axis — the
 # silent-zero-parallelism failure mode (a user gets 1-chip performance on

@@ -653,6 +653,21 @@ def _eqn_flops(eqn) -> float:
         length = eqn.params.get("length", 1)
         if inner is not None and hasattr(inner, "jaxpr"):
             return length * sum(_eqn_flops(e) for e in inner.jaxpr.eqns)
+    if prim == "cond":
+        branch_flops = [sum(_eqn_flops(e) for e in br.jaxpr.eqns)
+                        for br in eqn.params.get("branches", ())
+                        if hasattr(br, "jaxpr")]
+        if branch_flops:
+            return max(branch_flops)
+    if prim == "while":
+        per_trip = sum(
+            _eqn_flops(e)
+            for part in (eqn.params.get("body_jaxpr"),
+                         eqn.params.get("cond_jaxpr"))
+            if part is not None and hasattr(part, "jaxpr")
+            for e in part.jaxpr.eqns)
+        if per_trip:
+            return edconfig.while_trip_estimate * per_trip
     if prim in ("remat2", "remat", "checkpoint", "pjit", "custom_vjp_call",
                 "custom_jvp_call"):
         inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")

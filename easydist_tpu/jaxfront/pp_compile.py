@@ -46,6 +46,13 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
 
+
+def _struct(tree):
+    """Shape/dtype signature used to pin the build geometry."""
+    return jax.tree_util.tree_map(
+        lambda x: (tuple(x.shape), jnp.result_type(x)), tree)
+
+
 class PPCompiledFunction:
     """Hybrid-compiled train step.  Usage:
 
@@ -174,8 +181,7 @@ class PPCompiledFunction:
             return (placed, opt)
 
         self._built = (jitted, init_state, pack_params)
-        self._batch_struct = jax.tree_util.tree_map(
-            lambda x: (tuple(x.shape), jnp.result_type(x)), batch)
+        self._batch_struct = _struct(batch)
         return self._built
 
     # --------------------------------------------------------------- api
@@ -187,23 +193,19 @@ class PPCompiledFunction:
                     "first init_state call needs an example batch: "
                     "init_state(params, *batch)")
             self._build(params, example_batch)
-            self._param_struct = jax.tree_util.tree_map(
-                lambda x: (tuple(x.shape), jnp.result_type(x)), params)
+            self._param_struct = _struct(params)
             return self._built[1](params)
         # re-init against the existing build: the stage plan and packed
         # layout were traced once, so a different geometry must rebuild
         # (a fresh instance), not silently re-pack through the stale plan
-        pstruct = jax.tree_util.tree_map(
-            lambda x: (tuple(x.shape), jnp.result_type(x)), params)
+        pstruct = _struct(params)
         if pstruct != self._param_struct:
             raise ValueError(
                 "params shape/dtype signature differs from the one this "
                 "step was built with; build a new "
                 "easydist_compile(pp_stages=...) instance")
         if example_batch:
-            bstruct = jax.tree_util.tree_map(
-                lambda x: (tuple(x.shape), jnp.result_type(x)),
-                example_batch)
+            bstruct = _struct(example_batch)
             if bstruct != self._batch_struct:
                 raise ValueError(
                     f"batch signature {bstruct} differs from the build's "
@@ -217,8 +219,7 @@ class PPCompiledFunction:
         # the stage plan and transport layout were traced at the build
         # batch shape; a different (even divisible) shape would replay the
         # stale plan on phantom pad rows and return silently-wrong losses
-        struct = jax.tree_util.tree_map(
-            lambda x: (tuple(x.shape), jnp.result_type(x)), batch)
+        struct = _struct(batch)
         if struct != self._batch_struct:
             raise ValueError(
                 f"batch shape/dtype signature {struct} differs from the "

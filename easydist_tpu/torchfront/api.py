@@ -465,7 +465,12 @@ def _make_train_mode_step(module, example_args, loss_fn, optimizer, lr,
             f"parallel_mode={parallel_mode!r} train-mode export (the "
             f"manual modes bypass easydist_compile; only donate_state "
             f"applies)")
-    donate = (0,) if kwargs.get("donate_state", True) else ()
+    from easydist_tpu import config as edconfig
+
+    donate_state = kwargs.get("donate_state")
+    if donate_state is None:  # same default resolution as the auto path
+        donate_state = edconfig.enable_donation
+    donate = (0,) if donate_state else ()
     jitted = jax.jit(manual_step, donate_argnums=donate)
 
     def placed_init_state():
