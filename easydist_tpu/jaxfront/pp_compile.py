@@ -30,10 +30,11 @@ Reference equivalent: passing `schedule_cls` to the same compile entry
 processes with DTensor-sharded submodules over NCCL; here one fully-manual
 SPMD program over ICI.
 
-Schedules: "gpipe" (fill-drain + autodiff backward) and "remat" (gpipe
-with per-stage rematerialization).  True supertick 1F1B exists for
-homogeneous stage stacks (`parallel/pipeline.spmd_pipeline_grad`); the
-auto-split path raises a pointer there rather than mislabeling gpipe.
+Schedules: "gpipe" (fill-drain + autodiff backward), "remat" (gpipe with
+per-stage rematerialization) and "1f1b" (DAPPLE supertick on the
+heterogeneous switch branches, `parallel/auto_pipeline.
+pipeline_1f1b_grad` — O(n_stages) residual memory instead of gpipe's
+O(n_microbatches), gradients computed in-schedule).
 """
 
 from __future__ import annotations
@@ -66,12 +67,12 @@ class PPCompiledFunction:
                  n_microbatches: int, pp_axis: str = "pp",
                  schedule: str = "gpipe", lr: Optional[float] = None,
                  optimizer="adam"):
-        if schedule not in ("gpipe", "remat"):
+        if schedule not in ("gpipe", "remat", "1f1b"):
             raise NotImplementedError(
-                f"schedule={schedule!r} on the auto-split path; supertick "
-                f"1F1B needs homogeneous stages — use "
-                f"parallel.pipeline.spmd_pipeline_grad (or "
-                f"models.gpt.make_gpt_pipeline_step) for that")
+                f"unknown schedule {schedule!r}; auto-split supports "
+                f"'gpipe', 'remat' (gpipe + per-stage rematerialization) "
+                f"and '1f1b' (DAPPLE supertick, O(n_stages) residual "
+                f"memory)")
         self.loss_fn = loss_fn
         self.mesh = mesh
         self.pp_stages = pp_stages
@@ -130,11 +131,21 @@ class PPCompiledFunction:
         def loss_flat_mb(p, mb_tuple):
             return self.loss_fn(p, *mb_tuple)
 
-        pipe, pack_params = pipeline_forward(
-            loss_flat_mb, params, mb_local, mesh,
-            n_stages=self.pp_stages, n_microbatches=M, axis=pp_axis,
-            shard_params=True, manual_siblings=True,
-            remat_stages=(self.schedule == "remat"))
+        if self.schedule == "1f1b":
+            from easydist_tpu.parallel.auto_pipeline import (
+                pipeline_1f1b_grad)
+
+            pipe_grad, pack_params = pipeline_1f1b_grad(
+                loss_flat_mb, params, mb_local, mesh,
+                n_stages=self.pp_stages, n_microbatches=M, axis=pp_axis)
+            pipe = None
+        else:
+            pipe, pack_params = pipeline_forward(
+                loss_flat_mb, params, mb_local, mesh,
+                n_stages=self.pp_stages, n_microbatches=M, axis=pp_axis,
+                shard_params=True, manual_siblings=True,
+                remat_stages=(self.schedule == "remat"))
+            pipe_grad = None
 
         # storage shardings: packed stage rows split over pp AND, flat,
         # over every sibling axis (params/device ~ total/n_devices); this
@@ -154,11 +165,14 @@ class PPCompiledFunction:
             mbs = tuple(jax.tree_util.tree_map(to_mb, b)
                         for b in batch_args)
 
-            def loss_of(pr):
-                losses = pipe(pr, mbs)  # [M] sibling-averaged scalars
-                return jnp.mean(losses)
+            if pipe_grad is not None:  # 1f1b computes grads in-schedule
+                loss, grads = pipe_grad(params_repr, mbs)
+            else:
+                def loss_of(pr):
+                    losses = pipe(pr, mbs)  # [M] sibling-averaged scalars
+                    return jnp.mean(losses)
 
-            loss, grads = jax.value_and_grad(loss_of)(params_repr)
+                loss, grads = jax.value_and_grad(loss_of)(params_repr)
             if self._is_optax:
                 updates, new_opt = opt_update(grads, opt, params_repr)
                 new_repr = jax.tree_util.tree_map(

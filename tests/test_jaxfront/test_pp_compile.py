@@ -120,6 +120,41 @@ def test_remat_schedule_parity(cpu_devices):
     _run_parity(mesh, pp_stages=4, schedule="remat")
 
 
+def test_1f1b_schedule_parity(cpu_devices):
+    """DAPPLE supertick on auto-split stages: same 3-step Adam parity gate
+    as gpipe, on the pp x dp mesh (VERDICT r4 #5)."""
+    mesh = Mesh(np.array(cpu_devices).reshape(4, 2), ("pp", "dp"))
+    _run_parity(mesh, pp_stages=4, schedule="1f1b")
+
+
+def test_1f1b_pp_dp_tp_parity(cpu_devices):
+    mesh = Mesh(np.array(cpu_devices).reshape(2, 2, 2), ("pp", "dp", "tp"))
+    _run_parity(mesh, pp_stages=2, schedule="1f1b")
+
+
+@pytest.mark.long_duration
+def test_1f1b_peak_memory_below_gpipe(cpu_devices):
+    """1F1B's point: O(n_stages) residual ring vs gpipe's O(M) stash.
+    At M=16 >> 2S-1=7 the compiled temp footprint must be smaller."""
+    mesh = Mesh(np.array(cpu_devices).reshape(4, 2), ("pp", "dp"))
+    key = jax.random.PRNGKey(0)
+    params = _make_params(key)
+    x, y = _batch(jax.random.PRNGKey(1), n=128)
+
+    temps = {}
+    for sched in ("gpipe", "1f1b"):
+        compiled = easydist_compile(_loss_fn, mesh=mesh, pp_stages=4,
+                                    n_microbatches=16, schedule=sched)
+        state = compiled.init_state(params, x, y)
+        jitted = compiled._built[0]
+        lowered = jitted.lower(state, x, y)
+        mem = lowered.compile().memory_analysis()
+        temps[sched] = int(getattr(mem, "temp_size_in_bytes", 0))
+    assert temps["1f1b"] > 0 and temps["gpipe"] > 0, temps
+    assert temps["1f1b"] < temps["gpipe"], \
+        f"1f1b should hold fewer residuals than gpipe: {temps}"
+
+
 def test_optax_optimizer(cpu_devices):
     optax = pytest.importorskip("optax")
     mesh = Mesh(np.array(cpu_devices).reshape(4, 2), ("pp", "dp"))
