@@ -243,11 +243,10 @@ def _prepare_pipeline(fn, example_params, example_mb, mesh, n_stages,
     S = n_stages
 
     prep = _PipelinePrep()
-    prep.closed, prep.plan = closed, plan
-    prep.n_param_leaves = len(jax.tree_util.tree_leaves(example_params))
-    param_vars = jaxpr.invars[:prep.n_param_leaves]
-    data_vars = jaxpr.invars[prep.n_param_leaves:]
-    prep.param_vars, prep.data_vars = param_vars, data_vars
+    prep.plan = plan
+    n_param_leaves = len(jax.tree_util.tree_leaves(example_params))
+    param_vars = jaxpr.invars[:n_param_leaves]
+    data_vars = jaxpr.invars[n_param_leaves:]
     prep.sib_axes = tuple(n for n in mesh.axis_names if n != axis) \
         if manual_siblings else ()
 
@@ -262,8 +261,6 @@ def _prepare_pipeline(fn, example_params, example_mb, mesh, n_stages,
             n_sib = math.prod(mesh.shape[n] for n in mesh.axis_names
                               if n != axis)
             stage_param_elems = -(-stage_param_elems // n_sib) * n_sib
-    prep.stage_layouts, prep.shared_pos = stage_layouts, shared_pos
-    prep.stage_param_elems = stage_param_elems
 
     def make_branch(s: int):
         def branch(buf_in, param_vals, data_vals):
@@ -319,7 +316,7 @@ def _prepare_pipeline(fn, example_params, example_mb, mesh, n_stages,
         (or let the pipelined jit's constraint do it) so each device holds
         only its slice of its stage's parameters."""
         leaves = jax.tree_util.tree_leaves(params)
-        if len(leaves) != prep.n_param_leaves:
+        if len(leaves) != n_param_leaves:
             raise ValueError("params pytree does not match the example")
         rows = [plan.pack([leaves[i] for i in lay], stage_param_elems)
                 for lay in stage_layouts]

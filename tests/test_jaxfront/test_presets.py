@@ -120,6 +120,7 @@ def test_conv_rule_batch_and_channels():
     assert ((3, 2), (("reduce", None, "sum"),)) in s  # in channels partial
 
 
+@pytest.mark.long_duration
 def test_gather_embedding_rule():
     emb = jnp.ones((128, 32))
     tok = jnp.zeros((8, 16), jnp.int32)

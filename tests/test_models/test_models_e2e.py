@@ -108,6 +108,7 @@ def test_vit_tiny(mesh):
 
 
 @pytest.mark.world_8
+@pytest.mark.long_duration
 def test_gat_tiny(mesh):
     from easydist_tpu.models import GATConfig, gat_init, make_gat_train_step
 
@@ -128,6 +129,7 @@ def test_gat_tiny(mesh):
 
 
 @pytest.mark.world_8
+@pytest.mark.long_duration
 def test_gpt_flash_attention_matches_einsum(mesh):
     cfg_e = GPTConfig.tiny()
     cfg_f = GPTConfig.tiny(attention="flash")
@@ -227,6 +229,7 @@ def test_gpt_1f1b_hybrid_pp_dp_matches_plain(cpu_devices):
     _tree_allclose(new_params, ref_params, rtol=1e-3, atol=1e-5)
 
 
+@pytest.mark.long_duration
 def test_gpt_gpipe_interleaved_matches_plain(cpu_devices):
     """gpipe + n_virtual: the interleaved forward pipeline differentiates
     through the scan, so even the gpipe-grad path interleaves."""

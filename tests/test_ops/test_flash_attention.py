@@ -37,6 +37,7 @@ def test_flash_uneven_blocks():
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.long_duration
 def test_flash_gradients():
     q, k, v = make_qkv(jax.random.PRNGKey(2), t=32, d=16)
 
@@ -55,6 +56,7 @@ def test_flash_gradients():
 
 
 @pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.long_duration
 def test_flash_fused_backward_matches_reference(causal):
     """The Pallas backward kernels (dq + dkdv, lse/delta recompute) must
     reproduce einsum-attention gradients, including uneven tail blocks."""
@@ -104,6 +106,7 @@ def test_flash_backward_has_no_quadratic_residual():
     assert not found, f"quadratic intermediates: {found}"
 
 
+@pytest.mark.long_duration
 def test_flash_long_context_streams_kv():
     """Long-context exactness (VERDICT r2 #6): with K/V streamed through the
     grid, a 4k sequence runs with the same per-program VMEM as a 256-token
@@ -116,6 +119,7 @@ def test_flash_long_context_streams_kv():
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.long_duration
 def test_flash_vmem_budget_seq_independent(monkeypatch):
     """Per-program VMEM residency must not grow with sequence length and
     must stay under the ~16 MiB TPU VMEM budget at seq 32k (the regime

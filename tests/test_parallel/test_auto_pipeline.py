@@ -63,6 +63,7 @@ def test_auto_pipeline_residual_crossing(mesh_pp):
 
 
 @pytest.mark.world_8
+@pytest.mark.long_duration
 def test_auto_pipeline_gradients(mesh_pp):
     d, M, mb = 8, 4, 2
     params = make_model(jax.random.PRNGKey(4), d, n_layers=4)
@@ -149,6 +150,7 @@ def test_split_point_markers_control_stages(mesh_pp):
 
 
 @pytest.mark.world_8
+@pytest.mark.long_duration
 def test_shard_params_matches_and_shrinks_memory(mesh_pp):
     """shard_params=True: per-stage params live only on their stage's
     device; output still exact and per-device argument bytes shrink ~1/pp
@@ -184,6 +186,7 @@ def test_shard_params_matches_and_shrinks_memory(mesh_pp):
 
 
 @pytest.mark.world_8
+@pytest.mark.long_duration
 def test_bf16_boundaries_ride_bf16_wire(mesh_pp):
     """All-bf16 boundaries rotate in bf16 (half the ICI bytes)."""
     from easydist_tpu.parallel.auto_pipeline import _StagePlan

@@ -57,6 +57,7 @@ def test_ulysses_matches_full(mesh_sp, causal):
 
 
 @pytest.mark.world_8
+@pytest.mark.long_duration
 def test_ring_attention_grads(mesh_sp):
     q, k, v = make_qkv(jax.random.PRNGKey(2))
 
@@ -87,6 +88,7 @@ def test_ring_attention_flash_blocks_match_dense(cpu_devices):
 
 
 @pytest.mark.world_8
+@pytest.mark.long_duration
 def test_ring_attention_flash_blocks_gradients(cpu_devices):
     """Differentiating through ring attention with flash block compute
     (the TPU default) must match dense-attention gradients — the lse

@@ -14,7 +14,21 @@ def mesh_ep(cpu_devices):
     return make_device_mesh((4,), ("ep",), devices=cpu_devices[:4])
 
 
+def test_moe_fast_smoke(mesh_ep):
+    """Fast-tier gate: tiny expert-parallel layer matches the dense
+    reference (the large-shape + gradient gates live in long_duration)."""
+    cfg = MoEConfig(n_experts=4, d_model=4, d_ff=8, capacity_factor=2.0)
+    params = moe_init(cfg, jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, cfg.d_model))
+    y, aux = moe_layer(params, x, mesh_ep, cfg)
+    y_ref, aux_ref = moe_reference(params, x, cfg, n_devices=4)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-5)
+
+
 @pytest.mark.world_8
+@pytest.mark.long_duration
 def test_moe_matches_reference(mesh_ep):
     cfg = MoEConfig(n_experts=8, d_model=16, d_ff=32, capacity_factor=2.0)
     params = moe_init(cfg, jax.random.PRNGKey(0))
@@ -27,6 +41,7 @@ def test_moe_matches_reference(mesh_ep):
 
 
 @pytest.mark.world_8
+@pytest.mark.long_duration
 def test_moe_gradients_flow(mesh_ep):
     cfg = MoEConfig(n_experts=4, d_model=8, d_ff=16, capacity_factor=2.0)
     params = moe_init(cfg, jax.random.PRNGKey(2))
@@ -44,6 +59,7 @@ def test_moe_gradients_flow(mesh_ep):
 
 
 @pytest.mark.world_8
+@pytest.mark.long_duration
 def test_moe_top2_matches_reference(cpu_devices):
     """GShard-style top-2 routing with renormalized gates and shared
     capacity accounting across slots."""

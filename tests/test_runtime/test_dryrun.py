@@ -16,6 +16,7 @@ def test_dryrun_multichip_8(cpu_devices):
     __graft_entry__.dryrun_multichip(8)
 
 
+@pytest.mark.long_duration
 def test_entry_compiles_abstractly():
     """entry() must stay jittable: abstract trace only (no device compute
     — the full single-chip compile is the driver's job)."""

@@ -80,6 +80,7 @@ def test_attention_conversion():
     assert_matches_torch(TinyAttention(), (torch.randn(2, 8, 32),))
 
 
+@pytest.mark.long_duration
 def test_sdpa_flash_substitution_forward_and_grad():
     """At flash-eligible shapes (seq >= 256), SDPA conversion substitutes
     the Pallas flash custom-vjp (torch.compile-style kernel pick, TPU

@@ -104,6 +104,7 @@ def _train_parity(module, wrapper, example, targets, loss_fn, torch_loss,
     return state
 
 
+@pytest.mark.long_duration
 def test_hf_gpt2_train_parity_auto(cpu_devices):
     """Real HF GPT-2 class + torch AdamW: 3-step parity on the 8-dev mesh."""
     mesh = make_device_mesh((8,), ("dp",))
@@ -114,6 +115,7 @@ def test_hf_gpt2_train_parity_auto(cpu_devices):
     _train_parity(model, wrapper, ids, tgt, _xent, _torch_xent, opt, mesh)
 
 
+@pytest.mark.long_duration
 def test_hf_resnet_train_parity_auto(cpu_devices):
     """Real HF ResNet class (BN running stats) + torch SGD momentum."""
     mesh = make_device_mesh((8,), ("dp",))
