@@ -34,21 +34,26 @@ def _node_flops(node: MetaNode) -> float:
     if node.op_key not in _HEAVY_OPS:
         return 0.0
     out_elems = sum(math.prod(v.shape) for v in node.outvars if v is not None)
-    # contraction length ~ largest input size over output size
-    in_elems = max((math.prod(v.shape) for v in node.invars if v is not None),
-                   default=0)
-    k = max(in_elems / max(out_elems, 1), 1.0)
-    return 2.0 * out_elems * min(k, in_elems)
+    ins = [math.prod(v.shape) for v in node.invars if v is not None]
+    if len(ins) >= 2 and out_elems > 0:
+        # contraction length from the two operands: for (M,K)x(K,N)->(M,N)
+        # in0*in1/out = K^2 exactly; for convs it recovers C*sqrt(kh*kw)
+        # (a mild underestimate).  The old max(in)/out heuristic lost the
+        # batch/row factor and under-counted matmuls by ~K/8 (r5 review).
+        k = math.sqrt(max(ins[0], 1) * max(ins[1], 1) / out_elems)
+    else:
+        k = max(max(ins, default=0) / max(out_elems, 1), 1.0)
+    return 2.0 * out_elems * max(k, 1.0)
 
 
 def _node_seconds(node: MetaNode) -> float:
-    """Estimated single-device run time of one op."""
-    flops = _node_flops(node)
-    if flops > 0.0:
-        return flops / edconfig.peak_flops
+    """Estimated single-device run time of one op: the roofline
+    max(MXU time, HBM time) — a small matmul is bandwidth-bound even
+    though it runs on the MXU, and a big one is FLOPs-bound."""
     nbytes = sum(v.size_bytes() for v in node.invars if v is not None) \
         + sum(v.size_bytes() for v in node.outvars if v is not None)
-    return nbytes / edconfig.hbm_bandwidth
+    return max(_node_flops(node) / edconfig.peak_flops,
+               nbytes / edconfig.hbm_bandwidth)
 
 
 class ReachabilityMap:

@@ -467,8 +467,9 @@ class ShardingAnalyzer:
         `replicate_names` additionally pins those placeholders to R.
         `state_io` threads loop carries (out -> init placeholder) so
         per-iteration reshards are priced, not forbidden.  Returns
-        ({var name: Placement}, comm seconds, compute seconds) or None
-        (infeasible, or divisibility removed a pin)."""
+        ({var name: Placement}, comm seconds, compute seconds,
+        full-price compute seconds) or None (infeasible, or divisibility
+        removed a pin)."""
         from easydist_tpu.autoflow import MeshAxisSpec, SpmdSolver
         from .bridge import jaxpr_to_metagraph
 
@@ -517,19 +518,27 @@ class ShardingAnalyzer:
             for v, p in zip(node.outvars, s.out_placements):
                 if v is not None and p is not None:
                     var_p[v.name] = p
-        # per-op body compute under this assignment (the outer solver's
-        # any-S discount heuristic, applied at body-op granularity)
-        compute = 0.0
+        # per-op body compute under this assignment: the same op-time model
+        # the overlap engine uses (MXU ops at peak_flops, memory-bound ops
+        # at hbm_bandwidth — VERDICT r4 weak #7: a bytes-only proxy
+        # under-prices MXU-bound transformer bodies by ~D/245 at f32),
+        # with the outer solver's any-S 1/world discount per op
+        from easydist_tpu.autoflow.reachability import _node_seconds
+
+        compute = full_compute = 0.0
         for node in g.ops:
             s = chosen.get(node.name)
-            out_bytes = sum(v.size_bytes() for v in node.outvars
-                            if v is not None)
             sharded = s is not None and any(
                 p is not None and p.is_shard()
                 for p in list(s.out_placements) + list(s.in_placements))
-            compute += out_bytes / edconfig.hbm_bandwidth * (
-                1.0 / self.world_size if sharded else 1.0)
-        return var_p, comm, compute
+            sec = _node_seconds(node)
+            full_compute += sec
+            compute += sec * (1.0 / self.world_size if sharded else 1.0)
+        # full_compute is the SAME-BASIS replicate price: the outer solver
+        # compares strat.compute_cost against the node's compute_proxy, so
+        # both must come from one op-time model or replication wins by
+        # accounting artifact alone
+        return var_p, comm, compute, full_compute
 
     def _discover_scan(self, eqn):
         """Composite rule for `lax.scan`: analyze the body recursively, then
@@ -640,6 +649,7 @@ class ShardingAnalyzer:
             return ins, outs
 
         n_solves = 0
+        full_body_compute = 0.0
         for i in edge_invars:
             v = eqn.invars[i]
             shape = tuple(v.aval.shape)
@@ -665,6 +675,7 @@ class ShardingAnalyzer:
                                       carries_replicate=is_xs)
                 if res is None:
                     continue
+                full_body_compute = res[3]
                 got = extract(res[0])
                 if got is None:
                     continue
@@ -686,20 +697,14 @@ class ShardingAnalyzer:
         # more than its boundary bytes — without this the outer solver's
         # byte proxy under-prices replication and TP's intrinsic psum cost
         # would never be worth paying
-        compute = length * self._body_bytes(inner) / edconfig.hbm_bandwidth
+        # same-basis replicate price (see _solve_body_pinned)
+        compute = length * full_body_compute
 
         logger.info("scan rule: %d whole-body strategies (body %d eqns, "
                     "length %d)", len(strategies), len(inner.jaxpr.eqns),
                     length)
         return {"space": None, "recombines": {},
                 "strategies": strategies, "compute": compute}
-
-    @staticmethod
-    def _body_bytes(inner) -> float:
-        return float(sum(
-            np.dtype(bv.aval.dtype).itemsize * int(np.prod(bv.aval.shape))
-            for beqn in inner.jaxpr.eqns for bv in beqn.outvars
-            if hasattr(bv.aval, "shape")))
 
     def _discover_cond(self, eqn):
         """Composite rule for `lax.cond`/`lax.switch`: every branch body is
@@ -738,6 +743,7 @@ class ShardingAnalyzer:
         seen_keys = set()
         covered = set()
         n_solves = 0
+        full_branch_compute = 0.0
 
         def branch_extract(inner_b, sub_b, var_p):
             in_names_b = [sub_b.names.name(v) for v in inner_b.jaxpr.invars]
@@ -788,6 +794,8 @@ class ShardingAnalyzer:
                         pins={seed: Placement.shard(d)})
                     if res is None:
                         break
+                    full_branch_compute = max(full_branch_compute,
+                                              res[3])
                     got = branch_extract(inner_b, sub_b, res[0])
                     if got is None:
                         break
@@ -815,9 +823,7 @@ class ShardingAnalyzer:
 
         if not strategies:
             return None
-        compute = max(self._body_bytes(inner_b)
-                      for inner_b, _, _, _ in analyzed) \
-            / edconfig.hbm_bandwidth
+        compute = full_branch_compute
         logger.info("cond rule: %d whole-eqn strategies (%d branches)",
                     len(strategies), len(branches))
         return {"space": None, "recombines": {},
@@ -868,6 +874,7 @@ class ShardingAnalyzer:
         seen_keys = set()
         covered = set()
         n_solves = 0
+        full_loop_compute = 0.0
 
         for k in range(n_carry):
             i = n_cc + n_bc + k  # absolute eqn invar index
@@ -891,7 +898,8 @@ class ShardingAnalyzer:
                     state_io=carry_io)
                 if res is None:
                     continue
-                var_p, body_comm, body_compute = res
+                var_p, body_comm, body_compute, body_full = res
+                full_loop_compute = body_full
 
                 def carry_placement(kk):
                     p = var_p.get(in_names[n_bc + kk])
@@ -949,7 +957,7 @@ class ShardingAnalyzer:
 
         if not strategies:
             return None
-        compute = trips * self._body_bytes(inner) / edconfig.hbm_bandwidth
+        compute = trips * full_loop_compute
         logger.info("while rule: %d whole-loop strategies (body %d eqns, "
                     "trip estimate %g)", len(strategies),
                     len(inner.jaxpr.eqns), trips)

@@ -190,17 +190,22 @@ def test_overlap_discount_is_bounded_by_peer_compute():
         g.outputs.append(vj)
         return g
 
-    def edge_cost_sum(peak):
-        saved = (edconfig.predict_comm_overlap, edconfig.peak_flops)
-        edconfig.predict_comm_overlap, edconfig.peak_flops = True, peak
+    def edge_cost_sum(speedup):
+        # op time is the roofline max(flops/peak, bytes/hbm): scale BOTH
+        # terms so "fast hardware" really makes peer compute unhideable
+        saved = (edconfig.predict_comm_overlap, edconfig.peak_flops,
+                 edconfig.hbm_bandwidth)
+        edconfig.predict_comm_overlap = True
+        edconfig.peak_flops = speedup
+        edconfig.hbm_bandwidth = speedup
         try:
             g = build()
             g.coarsen(AXIS.size, level=0)
             solver = SpmdSolver(g, AXIS, reachability=ReachabilityMap(g))
             return sum(float(e.comm.sum()) for e in solver.edges)
         finally:
-            (edconfig.predict_comm_overlap,
-             edconfig.peak_flops) = saved
+            (edconfig.predict_comm_overlap, edconfig.peak_flops,
+             edconfig.hbm_bandwidth) = saved
 
     full = edge_cost_sum(1e30)       # nothing hideable: ~undiscounted
     heavy = edge_cost_sum(1.0)       # everything hideable: full ratio
