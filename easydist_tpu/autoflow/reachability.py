@@ -33,13 +33,15 @@ _HEAVY_OPS = {"dot_general", "conv_general_dilated", "matmul", "mm", "bmm",
 def _node_flops(node: MetaNode) -> float:
     if node.op_key not in _HEAVY_OPS:
         return 0.0
+    if node.flops is not None:
+        return node.flops  # exact MACs recorded by the bridge
     out_elems = sum(math.prod(v.shape) for v in node.outvars if v is not None)
     ins = [math.prod(v.shape) for v in node.invars if v is not None]
     if len(ins) >= 2 and out_elems > 0:
-        # contraction length from the two operands: for (M,K)x(K,N)->(M,N)
-        # in0*in1/out = K^2 exactly; for convs it recovers C*sqrt(kh*kw)
-        # (a mild underestimate).  The old max(in)/out heuristic lost the
-        # batch/row factor and under-counted matmuls by ~K/8 (r5 review).
+        # fallback for synthetic nodes (no recorded flops): for an
+        # unbatched (M,K)x(K,N)->(M,N), in0*in1/out = K^2 exactly; batched
+        # dots are ambiguous from shapes alone, which is why the bridge
+        # records exact MACs for real graphs (r5 review #3)
         k = math.sqrt(max(ins[0], 1) * max(ins[1], 1) / out_elems)
     else:
         k = max(max(ins, default=0) / max(out_elems, 1), 1.0)

@@ -156,6 +156,23 @@ class SpmdSolver:
         # (reference runtime_prof.py:35-150 -> solver costs)
         op_times = _cached_op_times() if edconfig.use_op_cost_db else {}
         n_comp = n_hit = 0
+        # strategy-independent per-node numbers, computed once (the cost
+        # loop runs per cluster x strategy x node and dominates solve prep)
+        from .reachability import _node_flops
+
+        _node_cache: Dict[int, tuple] = {}
+
+        def node_numbers(node):
+            got = _node_cache.get(id(node))
+            if got is None:
+                got = (_node_flops(node),
+                       [v.size_bytes() if v is not None else 0
+                        for v in node.invars],
+                       [v.size_bytes() if v is not None else 0
+                        for v in node.outvars])
+                _node_cache[id(node)] = got
+            return got
+
         for c in self.clusters:
             costs = None
             for s in range(c.strategy_count()):
@@ -168,28 +185,58 @@ class SpmdSolver:
                     if s == 0:
                         n_comp += 1
                         n_hit += measured is not None
-                    if measured is not None:
-                        full_t = measured
-                    elif node.compute_proxy is not None:
-                        full_t = node.compute_proxy
-                    else:
-                        full_t = sum(v.size_bytes() for v in node.outvars
-                                     if v is not None) * inv_hbm
                     strat_compute = getattr(strat, "compute_cost", None)
                     if strat_compute is not None:
                         # composite strategies price their body per-op
                         t += strat_compute
-                    else:
-                        # only SHARD splits the compute 1/n: a contracted-dim
-                        # dot (S inputs, P output) works on 1/n slices, but a
-                        # pure P-propagating op (P in -> P out) runs
-                        # full-shape on every rank, same as replicate
+                    elif measured is not None or \
+                            node.compute_proxy is not None:
+                        full_t = measured if measured is not None \
+                            else node.compute_proxy
+                        # scalar time sources: only SHARD splits the work
+                        # 1/n (a pure P-propagating op runs full-shape on
+                        # every rank, same as replicate)
                         sharded = any(
                             p is not None and p.is_shard()
                             for p in list(strat.out_placements)
                             + list(strat.in_placements))
                         factor = (1.0 / self.axis.size) if sharded else 1.0
                         t += factor * full_t
+                    else:
+                        n = self.axis.size
+                        flops, in_b, out_b = node_numbers(node)
+                        sharded = any(
+                            p is not None and p.is_shard()
+                            for p in list(strat.out_placements)
+                            + list(strat.in_placements))
+                        if flops > 0.0:
+                            # MXU ops: per-strategy roofline at LOCAL
+                            # sizes, discounting only the vars the
+                            # strategy actually shards.  This is what
+                            # makes weight-stationary TP visible — an
+                            # output-bytes proxy hides the weight-read
+                            # half of its savings (r5 Phase B).
+                            nbytes = sum(
+                                b / n if (p is not None and p.is_shard())
+                                else b for b, p in
+                                zip(in_b, strat.in_placements))
+                            nbytes += sum(
+                                b / n if (p is not None and p.is_shard())
+                                else b for b, p in
+                                zip(out_b, strat.out_placements))
+                            if sharded:
+                                flops /= n  # any sharded dim splits MACs
+                            t += max(flops / edconfig.peak_flops,
+                                     nbytes / edconfig.hbm_bandwidth)
+                        else:
+                            # memory-bound ops keep the conservative
+                            # output-bytes proxy: pricing their input
+                            # traffic too makes the ILP chase ZeRO-style
+                            # param scatter at toy scale, where the per-
+                            # collective alpha dwarfs the savings (the
+                            # dp x tp never-costlier gate pins this)
+                            full_t = sum(out_b) * inv_hbm
+                            t += full_t * ((1.0 / n) if sharded else 1.0)
                     # composite ops (scan bodies) carry their internal
                     # per-strategy collective seconds here
                     t += getattr(strat, "intrinsic_cost", 0.0)

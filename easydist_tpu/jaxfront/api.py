@@ -33,7 +33,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 from easydist_tpu import config as edconfig
 from easydist_tpu.autoflow import SpmdSolver
 from easydist_tpu.metashard.metair import NodeStrategy, Placement
-from .bridge import jaxpr_to_metagraph
+from .bridge import _eqn_flops, jaxpr_to_metagraph
 from .interpreter import ShardingAnalyzer, VarNames
 from .mesh import get_axis_specs, get_device_mesh, make_device_mesh
 
@@ -631,53 +631,6 @@ def compile_step(func, args, kwargs, mesh=None, state_io="auto",
                            in_tree, out_tree, state_pairs, donate_state)
 
 
-def _eqn_flops(eqn) -> float:
-    """Rough FLOP estimate for replication accounting: exact-ish for
-    dot_general/conv, length x body for scan, output numel otherwise."""
-    import math
-
-    prim = eqn.primitive.name
-    if prim == "dot_general":
-        (lhs_c, _), (lhs_b, _) = eqn.params["dimension_numbers"]
-        lhs = eqn.invars[0].aval
-        out = eqn.outvars[0].aval
-        k = math.prod(lhs.shape[d] for d in lhs_c) if lhs_c else 1
-        return 2.0 * math.prod(out.shape) * k
-    if prim in ("conv_general_dilated",):
-        out = eqn.outvars[0].aval
-        rhs = eqn.invars[1].aval
-        return 2.0 * math.prod(out.shape) * math.prod(rhs.shape[2:]) \
-            * rhs.shape[1]
-    if prim == "scan":
-        inner = eqn.params.get("jaxpr")
-        length = eqn.params.get("length", 1)
-        if inner is not None and hasattr(inner, "jaxpr"):
-            return length * sum(_eqn_flops(e) for e in inner.jaxpr.eqns)
-    if prim == "cond":
-        branch_flops = [sum(_eqn_flops(e) for e in br.jaxpr.eqns)
-                        for br in eqn.params.get("branches", ())
-                        if hasattr(br, "jaxpr")]
-        if branch_flops:
-            return max(branch_flops)
-    if prim == "while":
-        per_trip = sum(
-            _eqn_flops(e)
-            for part in (eqn.params.get("body_jaxpr"),
-                         eqn.params.get("cond_jaxpr"))
-            if part is not None and hasattr(part, "jaxpr")
-            for e in part.jaxpr.eqns)
-        if per_trip:
-            return edconfig.while_trip_estimate * per_trip
-    if prim in ("remat2", "remat", "checkpoint", "pjit", "custom_vjp_call",
-                "custom_jvp_call"):
-        inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
-        if inner is not None:
-            body = inner.jaxpr if hasattr(inner, "jaxpr") else inner
-            return sum(_eqn_flops(e) for e in getattr(body, "eqns", []))
-    return float(sum(math.prod(v.aval.shape) for v in eqn.outvars
-                     if hasattr(v.aval, "shape")))
-
-
 def _replicated_flops_fraction(jaxpr, per_axis_final, axis_specs) -> float:
     """Fraction of modeled FLOPs in eqns whose chosen strategy is
     all-replicate on every multi-device mesh axis (VERDICT r3 weak #3: the
@@ -997,7 +950,8 @@ def easydist_compile(func=None, mesh=None, state_io="auto",
                      pp_stages: Optional[int] = None,
                      n_microbatches: Optional[int] = None,
                      pp_axis: str = "pp", schedule: str = "gpipe",
-                     lr: Optional[float] = None, optimizer="adam"):
+                     lr: Optional[float] = None, optimizer="adam",
+                     tp_axes=None):
     """Decorator entrypoint (reference jax/api.py:307-323).
 
     With `pp_stages=` the decorated function is treated as a LOSS function
@@ -1036,11 +990,12 @@ def easydist_compile(func=None, mesh=None, state_io="auto",
                 f, m, pp_stages=pp_stages,
                 n_microbatches=n_microbatches or pp_stages * 2,
                 pp_axis=pp_axis, schedule=schedule, lr=lr,
-                optimizer=optimizer)
+                optimizer=optimizer, tp_axes=tp_axes)
         pp_only = [name for name, val, default in (
             ("n_microbatches", n_microbatches, None),
             ("pp_axis", pp_axis, "pp"), ("schedule", schedule, "gpipe"),
-            ("lr", lr, None), ("optimizer", optimizer, "adam"))
+            ("lr", lr, None), ("optimizer", optimizer, "adam"),
+            ("tp_axes", tp_axes, None))
             if val != default]
         if pp_only:
             raise ValueError(

@@ -17,9 +17,57 @@ from typing import Dict, Optional, Tuple
 import jax
 from jax.extend import core as jex_core
 
+from easydist_tpu import config as edconfig
 from easydist_tpu.metashard import view_rule
 from easydist_tpu.metashard.metair import MetaGraph, MetaNode, MetaVar
 from .interpreter import VarNames, eqn_signature
+
+
+def _eqn_flops(eqn) -> float:
+    """Rough FLOP estimate for replication accounting: exact-ish for
+    dot_general/conv, length x body for scan, output numel otherwise."""
+    import math
+
+    prim = eqn.primitive.name
+    if prim == "dot_general":
+        (lhs_c, _), (lhs_b, _) = eqn.params["dimension_numbers"]
+        lhs = eqn.invars[0].aval
+        out = eqn.outvars[0].aval
+        k = math.prod(lhs.shape[d] for d in lhs_c) if lhs_c else 1
+        return 2.0 * math.prod(out.shape) * k
+    if prim in ("conv_general_dilated",):
+        out = eqn.outvars[0].aval
+        rhs = eqn.invars[1].aval
+        return 2.0 * math.prod(out.shape) * math.prod(rhs.shape[2:]) \
+            * rhs.shape[1]
+    if prim == "scan":
+        inner = eqn.params.get("jaxpr")
+        length = eqn.params.get("length", 1)
+        if inner is not None and hasattr(inner, "jaxpr"):
+            return length * sum(_eqn_flops(e) for e in inner.jaxpr.eqns)
+    if prim == "cond":
+        branch_flops = [sum(_eqn_flops(e) for e in br.jaxpr.eqns)
+                        for br in eqn.params.get("branches", ())
+                        if hasattr(br, "jaxpr")]
+        if branch_flops:
+            return max(branch_flops)
+    if prim == "while":
+        per_trip = sum(
+            _eqn_flops(e)
+            for part in (eqn.params.get("body_jaxpr"),
+                         eqn.params.get("cond_jaxpr"))
+            if part is not None and hasattr(part, "jaxpr")
+            for e in part.jaxpr.eqns)
+        if per_trip:
+            return edconfig.while_trip_estimate * per_trip
+    if prim in ("remat2", "remat", "checkpoint", "pjit", "custom_vjp_call",
+                "custom_jvp_call"):
+        inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+        if inner is not None:
+            body = inner.jaxpr if hasattr(inner, "jaxpr") else inner
+            return sum(_eqn_flops(e) for e in getattr(body, "eqns", []))
+    return float(sum(math.prod(v.aval.shape) for v in eqn.outvars
+                     if hasattr(v.aval, "shape")))
 
 
 def jaxpr_to_metagraph(closed_jaxpr, rules: Dict[str, dict],
@@ -81,6 +129,11 @@ def jaxpr_to_metagraph(closed_jaxpr, rules: Dict[str, dict],
                         invars=invars, outvars=outvars,
                         space=rule["space"], recombines=rule["recombines"],
                         arg_rows=arg_rows, sig=sig)
+        if eqn.primitive.name in ("dot_general", "conv_general_dilated"):
+            # exact MACs from dimension_numbers, recorded while we still
+            # have the eqn: shape-only recovery of the contraction length
+            # is ambiguous (square matmuls vs batched dots, r5 review #3)
+            node.flops = _eqn_flops(eqn)
         if rule.get("compute") is not None:
             node.compute_proxy = float(rule["compute"])
         if rule.get("strategies") is not None:

@@ -174,6 +174,9 @@ class MetaNode:
         # a scan's candidate assignments overlap on dims, which one-group-
         # per-cell tables cannot encode).  List of NodeStrategy.
         self.explicit_strategies: Optional[List[NodeStrategy]] = None
+        # exact MACs recorded by the bridge for dot/conv eqns (shape-only
+        # recovery of the contraction length is ambiguous)
+        self.flops: Optional[float] = None
         # full (unsharded) compute seconds when the node hides more work
         # than its output bytes show (scan: length x body); None -> the
         # solver's HBM byte proxy

@@ -233,11 +233,69 @@ class _PipelinePrep:
     per-stage param packing layout, and the heterogeneous stage branches."""
 
 
+def _tp_convert(val, cur, want, tp_axis: str, tp_size: int):
+    """Move a branch-local value between tp placements with explicit
+    manual collectives.  Legal inside the divergent stage switch because
+    every participant group lies within one pp coordinate (all its members
+    run the same branch) — unlike GSPMD-inserted collectives, whose groups
+    span the mesh (the r4 deadlock)."""
+    from easydist_tpu.metashard.metair import Placement
+
+    cur = cur or Placement.replicate()
+    if want is None or want.is_partial():
+        want = Placement.replicate()
+    if repr(cur) == repr(want):
+        return val
+    if cur.is_shard():  # S -> R (and S -> S' via R)
+        val = jax.lax.all_gather(val, tp_axis, axis=cur.dim, tiled=True)
+    if want.is_shard():
+        size = val.shape[want.dim]
+        if size % tp_size != 0:
+            # the solver guarantees divisibility at traced shapes; reaching
+            # this means a plan/trace mismatch — failing loudly here beats
+            # binding a full-size operand where a 1/n slice was expected
+            # (a distant shape error at best, silent garbage at worst)
+            raise ValueError(
+                f"tp plan wants dim {want.dim} of shape {val.shape} "
+                f"sharded {tp_size}-way but it does not divide")
+        shard = size // tp_size
+        idx = jax.lax.axis_index(tp_axis)
+        val = jax.lax.dynamic_slice_in_dim(val, idx * shard, shard,
+                                           want.dim)
+    return val
+
+
+def _grad_scale(x, factor: float):
+    """Identity forward, cotangent scaled by `factor` on the backward.
+
+    Used on params consumed REPLICATED under a tp axis: every tp lane then
+    computes the identical full gradient, and the shard_map-level psum
+    over the siblings would multiply it by n_tp — scaling each lane's
+    cotangent by 1/n_tp makes that psum a mean for these params while
+    tp-SHARDED params keep the plain sum their complementary weight-shard
+    contributions need (r5 review #1)."""
+    @jax.custom_vjp
+    def f(v):
+        return v
+
+    f.defvjp(lambda v: (v, None), lambda _, g: (g * factor,))
+    return f(x)
+
+
 def _prepare_pipeline(fn, example_params, example_mb, mesh, n_stages,
-                      axis, shard_params, manual_siblings, remat_stages):
+                      axis, shard_params, manual_siblings, remat_stages,
+                      tp_plan=None, tp_axis=None, closed=None):
     if manual_siblings and not shard_params:
         raise ValueError("manual_siblings=True requires shard_params=True")
-    closed = inline_calls(jax.make_jaxpr(fn)(example_params, example_mb))
+    if tp_plan and (tp_axis is None or not manual_siblings):
+        raise ValueError("tp_plan needs tp_axis and manual_siblings=True")
+    if tp_plan is not None and not tp_plan:
+        raise ValueError(
+            "empty tp_plan: drop the tp axis instead (an idle tp axis "
+            "would silently duplicate gradients across its lanes)")
+    if closed is None:
+        closed = inline_calls(jax.make_jaxpr(fn)(example_params,
+                                                 example_mb))
     plan = _StagePlan(closed, n_stages)
     jaxpr = closed.jaxpr
     S = n_stages
@@ -249,6 +307,38 @@ def _prepare_pipeline(fn, example_params, example_mb, mesh, n_stages,
     data_vars = jaxpr.invars[n_param_leaves:]
     prep.sib_axes = tuple(n for n in mesh.axis_names if n != axis) \
         if manual_siblings else ()
+    # batch parallelism lives on the non-tp siblings; a tp axis replicates
+    # the data and splits tensors inside stages per tp_plan
+    prep.batch_axes = tuple(n for n in prep.sib_axes
+                            if tp_plan is None or n != tp_axis)
+
+    # gradient-reduction class per param under tp: params whose EVERY use
+    # is tp-sharded contribute complementary weight-shard grads (sum over
+    # tp is exact); any replicated use means the lanes compute identical
+    # grads and the sibling psum must average instead.  Mixed-use params
+    # are forced fully replicated for consistency.
+    mean_params = set()
+    if tp_plan:
+        param_set = set(param_vars)
+        sharded_use, repl_use = set(), set()
+        for idx, eqn in enumerate(jaxpr.eqns):
+            strat = tp_plan.get(idx)
+            var_pos = 0
+            for v in eqn.invars:
+                if isinstance(v, jex_core.Literal):
+                    continue
+                want = None
+                if strat is not None \
+                        and var_pos < len(strat.in_placements):
+                    want = strat.in_placements[var_pos]
+                var_pos += 1
+                if v in param_set:
+                    if want is not None and want.is_shard():
+                        sharded_use.add(v)
+                    else:
+                        repl_use.add(v)
+        mean_params = {v for v in param_vars
+                       if v in repl_use or v not in sharded_use}
 
     stage_layouts = shared_pos = stage_param_elems = None
     if shard_params:
@@ -262,9 +352,12 @@ def _prepare_pipeline(fn, example_params, example_mb, mesh, n_stages,
                               if n != axis)
             stage_param_elems = -(-stage_param_elems // n_sib) * n_sib
 
+    tp_size = mesh.shape[tp_axis] if tp_axis else 1
+
     def make_branch(s: int):
         def branch(buf_in, param_vals, data_vals):
             env = {}
+            place = {}  # var -> tp Placement (absent/None = replicated)
             if shard_params:
                 local_buf, shared_vals = param_vals
                 env.update(plan.unpack(
@@ -281,25 +374,84 @@ def _prepare_pipeline(fn, example_params, example_mb, mesh, n_stages,
             if s > 0:
                 env.update(plan.unpack(buf_in, plan.boundaries[s - 1]))
 
+            if tp_plan and mean_params:
+                inv_t = 1.0 / tp_size
+                for v in list(env):
+                    if v in mean_params:
+                        env[v] = _grad_scale(env[v], inv_t)
+
             def read(v):
                 return v.val if isinstance(v, jex_core.Literal) else env[v]
 
-            for eqn in plan.stage_eqns[s]:
+            def read_tp(v, want):
+                """Value converted to the strategy's tp placement."""
+                if isinstance(v, jex_core.Literal):
+                    return v.val
+                if want is not None and want.is_shard() \
+                        and v in mean_params:
+                    want = None  # mixed-use params stay fully replicated
+                return _tp_convert(env[v], place.get(v), want, tp_axis,
+                                   tp_size)
+
+            for local_i, eqn in enumerate(plan.stage_eqns[s]):
                 subfuns, bind_params = eqn.primitive.get_bind_params(eqn.params)
-                invals = [read(v) for v in eqn.invars]
+                strat = tp_plan.get(plan.stage_starts[s] + local_i) \
+                    if tp_plan else None
+                if strat is None:
+                    invals = [read_tp(v, None) if tp_plan else read(v)
+                              for v in eqn.invars]
+                    out_places = None
+                else:
+                    invals, var_pos = [], 0
+                    for v in eqn.invars:
+                        if isinstance(v, jex_core.Literal):
+                            invals.append(v.val)
+                            continue
+                        want = strat.in_placements[var_pos] \
+                            if var_pos < len(strat.in_placements) else None
+                        invals.append(read_tp(v, want))
+                        var_pos += 1
+                    out_places = list(strat.out_placements)
                 out = eqn.primitive.bind(*subfuns, *invals, **bind_params)
                 if not eqn.primitive.multiple_results:
                     out = [out]
-                for var, val in zip(eqn.outvars, out):
+                for k, (var, val) in enumerate(zip(eqn.outvars, out)):
+                    p = out_places[k] if out_places \
+                        and k < len(out_places) else None
+                    if p is not None and p.is_partial():
+                        # partial CREATED here (contracted sharded dim):
+                        # resolve with one psum over tp.  A solver P that
+                        # merely PROPAGATED an upstream partial was already
+                        # resolved at its creation, so the local value is
+                        # full and must not be summed again.
+                        created = not any(
+                            q is not None and q.is_partial()
+                            for q in (strat.in_placements if strat else ()))
+                        if created:
+                            val = jax.lax.psum(val, tp_axis)
+                        p = None
                     env[var] = val
+                    if p is not None and p.is_shard():
+                        place[var] = p
+
+            def read_full(v):
+                """Boundary/output values always cross stages replicated
+                over tp (the transport layout is traced at full-tp shape)."""
+                if isinstance(v, jex_core.Literal):
+                    return v.val
+                if tp_plan:
+                    return _tp_convert(env[v], place.get(v), None, tp_axis,
+                                       tp_size)
+                return env[v]
 
             if s < S - 1:
-                buf_out = plan.pack([env[v] for v in plan.boundaries[s]],
-                                    plan.buf_elems, plan.wire_dtype)
+                buf_out = plan.pack(
+                    [read_full(v) for v in plan.boundaries[s]],
+                    plan.buf_elems, plan.wire_dtype)
                 out_pack = jnp.zeros((plan.out_elems,), jnp.float32)
             else:
                 buf_out = jnp.zeros((plan.buf_elems,), plan.wire_dtype)
-                out_pack = plan.pack([read(v) for v in plan.out_vars],
+                out_pack = plan.pack([read_full(v) for v in plan.out_vars],
                                      plan.out_elems)
             return buf_out, out_pack
 
@@ -325,8 +477,9 @@ def _prepare_pipeline(fn, example_params, example_mb, mesh, n_stages,
     prep.pack_params = pack_params if shard_params else None
 
     # shard_map front matter shared by the gpipe and 1f1b builders:
-    # data rides [M, batch, ...] with batch split over the siblings
-    prep.data_spec = P(None, prep.sib_axes) if prep.sib_axes else P()
+    # data rides [M, batch, ...] with batch split over the BATCH siblings
+    # (a tp axis sees the full batch and splits tensors inside stages)
+    prep.data_spec = P(None, prep.batch_axes) if prep.batch_axes else P()
 
     def param_specs(shared_vals):
         return (P(axis, prep.sib_axes or None),
@@ -348,7 +501,8 @@ def pipeline_forward(fn: Callable, example_params, example_mb, mesh,
                      n_stages: int, n_microbatches: int, axis: str = "pp",
                      shard_params: bool = False,
                      manual_siblings: bool = False,
-                     remat_stages: bool = False):
+                     remat_stages: bool = False,
+                     tp_plan=None, tp_axis: str = None, closed=None):
     """Auto-split `fn(params, mb)` into a pipelined callable.
 
     Stages split at user `split_point` markers when present, else at
@@ -376,7 +530,8 @@ def pipeline_forward(fn: Callable, example_params, example_mb, mesh,
     """
     prep = _prepare_pipeline(fn, example_params, example_mb, mesh,
                              n_stages, axis, shard_params, manual_siblings,
-                             remat_stages)
+                             remat_stages, tp_plan=tp_plan, tp_axis=tp_axis,
+                             closed=closed)
     plan, branches, sib_axes = prep.plan, prep.branches, prep.sib_axes
     S, M = n_stages, n_microbatches
 
@@ -432,11 +587,15 @@ def pipeline_forward(fn: Callable, example_params, example_mb, mesh,
             outputs = jax.lax.psum(
                 jnp.where(stage_id == S - 1, outputs, jnp.zeros_like(outputs)),
                 axis)
-            if sib_axes:
-                # sibling lanes each pipelined their own batch shard; the
+            if prep.batch_axes:
+                # batch lanes each pipelined their own batch shard; the
                 # mean-loss contract makes the global value their average
-                # (uniform point; backward = the 1/n-scaled psum of dp)
-                outputs = jax.lax.pmean(outputs, sib_axes)
+                # (uniform point; backward = the 1/n-scaled psum of dp).
+                # tp lanes already hold identical psum-resolved outputs —
+                # averaging over tp would scale their complementary
+                # weight-shard grads by 1/n_tp on the backward, so the tp
+                # axis is deliberately NOT reduced here.
+                outputs = jax.lax.pmean(outputs, prep.batch_axes)
             return outputs
 
         packed = run(param_arg, tuple(mb_leaves))  # [M, out_elems]
@@ -457,7 +616,8 @@ def pipeline_forward(fn: Callable, example_params, example_mb, mesh,
 
 
 def pipeline_1f1b_grad(fn: Callable, example_params, example_mb, mesh,
-                       n_stages: int, n_microbatches: int, axis: str = "pp"):
+                       n_stages: int, n_microbatches: int, axis: str = "pp",
+                       tp_plan=None, tp_axis: str = None, closed=None):
     """DAPPLE 1F1B on AUTO-SPLIT heterogeneous stages (VERDICT r4 #5).
 
     The gpipe auto-split path differentiates through the forward pipeline
@@ -483,7 +643,9 @@ def pipeline_1f1b_grad(fn: Callable, example_params, example_mb, mesh,
 
     prep = _prepare_pipeline(fn, example_params, example_mb, mesh,
                              n_stages, axis, shard_params=True,
-                             manual_siblings=True, remat_stages=False)
+                             manual_siblings=True, remat_stages=False,
+                             tp_plan=tp_plan, tp_axis=tp_axis,
+                             closed=closed)
     plan, sib_axes = prep.plan, prep.sib_axes
     # Residual-memory policy: the vjp residuals of a raw branch include the
     # weight tensors UNPACKED from the packed row (slice+reshape+cast per
@@ -505,7 +667,9 @@ def pipeline_1f1b_grad(fn: Callable, example_params, example_mb, mesh,
             or tuple(plan.out_vars[0].aval.shape) != ():
         raise NotImplementedError(
             "1f1b auto-split supports a single scalar (mean) loss output")
-    n_sib = math.prod(mesh.shape[n] for n in sib_axes) if sib_axes else 1
+    batch_axes = prep.batch_axes
+    n_batch = math.prod(mesh.shape[n] for n in batch_axes) \
+        if batch_axes else 1
 
     tables = _1f1b_schedule_tables(S, 1, M)  # V=1: no virtual chunks here
     U, R = tables["n_superticks"], tables["ring"]
@@ -612,15 +776,21 @@ def pipeline_1f1b_grad(fn: Callable, example_params, example_mb, mesh,
             d_row, d_shared = dacc
             # shared leaves: every stage contributes -> sum over pp
             d_shared = tuple(jax.lax.psum(d, axis) for d in d_shared)
+            if batch_axes:
+                # global loss is the BATCH-lane mean (tp lanes hold
+                # identical psum-resolved values, so reducing over them
+                # would be a no-op forward but wrongly implies 1/n_tp on
+                # the backward)
+                loss = jax.lax.pmean(loss, batch_axes)
             if sib_axes:
-                # global loss is the sibling mean; grads scale by 1/n_sib.
-                # packed rows were all-gathered -> the transpose is a
-                # reduce-scatter back to each lane's stored slice
-                loss = jax.lax.pmean(loss, sib_axes)
+                # grads: mean over batch lanes (1/n_batch), SUM over tp
+                # lanes (complementary weight-shard contributions); the
+                # packed rows were all-gathered -> reduce-scatter back to
+                # each lane's stored slice
                 d_row = jax.lax.psum_scatter(
                     d_row, sib_axes, scatter_dimension=0,
-                    tiled=True) / n_sib
-                d_shared = tuple(jax.lax.pmean(d, sib_axes)
+                    tiled=True) / n_batch
+                d_shared = tuple(jax.lax.psum(d, sib_axes) / n_batch
                                  for d in d_shared)
             return loss, (d_row[None, :], d_shared)
 

@@ -155,6 +155,132 @@ def test_1f1b_peak_memory_below_gpipe(cpu_devices):
         f"1f1b should hold fewer residuals than gpipe: {temps}"
 
 
+def _wide_loss(params, x, y):
+    """4 layers at D=1024: wide enough that the tp solver shards (weight
+    HBM/MXU savings beat the psum launch at T=2)."""
+    h = x
+    for i in range(4):
+        h = jnp.tanh(h @ params[f"w{i}"])
+    return jnp.mean((h - y) ** 2)
+
+
+def _run_tp_parity(mesh, pp_stages, schedule="gpipe"):
+    D = 1024
+    ks = jax.random.split(jax.random.PRNGKey(0), 6)
+    params = {f"w{i}": jax.random.normal(ks[i], (D, D)) * 0.02
+              for i in range(4)}
+    x = jax.random.normal(ks[4], (8, D))
+    y = jax.random.normal(ks[5], (8, D))
+    lr = 1e-2
+
+    opt = adam_init(params)
+    p = dict(params)
+    eager = []
+
+    @jax.jit
+    def estep(p, o):
+        loss, g = jax.value_and_grad(_wide_loss)(p, x, y)
+        p2, o2 = adam_update(p, g, o, lr=lr)
+        return p2, o2, loss
+
+    compiled = easydist_compile(_wide_loss, mesh=mesh, pp_stages=pp_stages,
+                                n_microbatches=2, lr=lr, tp_axes=("tp",),
+                                schedule=schedule)
+    state = compiled.init_state(params, x, y)
+    ours = []
+    for _ in range(3):
+        state, loss = compiled(state, x, y)
+        ours.append(float(loss))
+        p, opt, el = estep(p, opt)
+        eager.append(float(el))
+    # tp psums reorder f32 reductions vs the eager single-device sums;
+    # D=1024 contractions accumulate ~1e-4 relative drift over 3 steps
+    np.testing.assert_allclose(ours, eager, rtol=8e-4, atol=5e-5)
+    assert compiled._tp_plan, "tp solver produced an empty plan"
+    sharded = [s for s in compiled._tp_plan.values()
+               if any(q is not None and q.is_shard()
+                      for q in list(s.in_placements) + list(s.out_placements))]
+    assert sharded, f"no sharded tp strategies chosen: {compiled._tp_plan}"
+
+
+@pytest.mark.world_8
+def test_hybrid_tp_inside_stages_parity(cpu_devices):
+    """Phase B of the hybrid (VERDICT row 30's full promise): the tp mesh
+    axis runs SOLVER-CHOSEN tensor parallelism inside auto-split stages —
+    weights sliced per the per-axis ILP, partials psum'd with manual
+    collectives inside the divergent switch branches — while dp batch-
+    parallelises and pp pipelines.  3-step Adam parity vs eager."""
+    mesh = Mesh(np.array(cpu_devices).reshape(2, 2, 2), ("pp", "dp", "tp"))
+    _run_tp_parity(mesh, pp_stages=2)
+
+
+@pytest.mark.world_8
+@pytest.mark.long_duration
+def test_hybrid_tp_1f1b_parity(cpu_devices):
+    mesh = Mesh(np.array(cpu_devices).reshape(2, 2, 2), ("pp", "dp", "tp"))
+    _run_tp_parity(mesh, pp_stages=2, schedule="1f1b")
+
+
+@pytest.mark.world_8
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_hybrid_tp_mixed_replicated_weight_grads(cpu_devices, schedule):
+    """r5 review #1: a weight the tp solver REPLICATES (here a narrow
+    head, too small to pay for a psum) must not get its gradient summed
+    across tp lanes — every lane computes the identical full gradient and
+    the sibling reduction has to average it while still SUMMING the
+    complementary shard gradients of the wide (sharded) layers.  3-step
+    Adam parity vs eager catches the 2x inflation immediately."""
+    D, H = 1024, 8
+    ks = jax.random.split(jax.random.PRNGKey(3), 6)
+    params = {"w0": jax.random.normal(ks[0], (D, D)) * 0.02,
+              "w1": jax.random.normal(ks[1], (D, D)) * 0.02,
+              "head": jax.random.normal(ks[2], (D, H)) * 0.02}
+
+    def loss(p, x, y):
+        h = jnp.tanh(x @ p["w0"])
+        h = jnp.tanh(h @ p["w1"])
+        return jnp.mean((h @ p["head"] - y) ** 2)
+
+    x = jax.random.normal(ks[3], (8, D))
+    y = jax.random.normal(ks[4], (8, H))
+    lr = 1e-2
+    mesh = Mesh(np.array(cpu_devices).reshape(2, 2, 2), ("pp", "dp", "tp"))
+
+    opt = adam_init(params)
+    p = dict(params)
+    eager = []
+
+    @jax.jit
+    def estep(p, o):
+        lv, g = jax.value_and_grad(loss)(p, x, y)
+        p2, o2 = adam_update(p, g, o, lr=lr)
+        return p2, o2, lv
+
+    compiled = easydist_compile(loss, mesh=mesh, pp_stages=2,
+                                n_microbatches=2, lr=lr, tp_axes=("tp",),
+                                schedule=schedule)
+    state = compiled.init_state(params, x, y)
+    ours = []
+    for _ in range(3):
+        state, lv = compiled(state, x, y)
+        ours.append(float(lv))
+        p, opt, el = estep(p, opt)
+        eager.append(float(el))
+    np.testing.assert_allclose(ours, eager, rtol=8e-4, atol=5e-5)
+    # the scenario must actually exercise BOTH grad classes: some matmuls
+    # tp-sharded, but NOT all three (the narrow head stays replicated)
+    sharded = any(
+        any(q is not None and q.is_shard()
+            for q in list(s.in_placements) + list(s.out_placements))
+        for s in compiled._tp_plan.values())
+    n_dots_planned = sum(1 for s in compiled._tp_plan.values()
+                         if len(s.in_placements) == 2)
+    assert sharded and n_dots_planned < 3, \
+        f"expected wide layers sharded AND the head replicated: " \
+        f"{compiled._tp_plan}"
+
+
+@pytest.mark.long_duration
 def test_optax_optimizer(cpu_devices):
     optax = pytest.importorskip("optax")
     mesh = Mesh(np.array(cpu_devices).reshape(4, 2), ("pp", "dp"))

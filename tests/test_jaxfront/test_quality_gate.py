@@ -154,10 +154,17 @@ def test_partial_deferral_reduces_collective_bytes(cpu_devices):
     from easydist_tpu.utils.hlo import collective_summary
 
     mesh = make_device_mesh((8,), ("tp",), devices=cpu_devices)
-    k = 512
-    x = jnp.ones((4, k))
+    # Geometry matters: deferral must be the unambiguous optimum.  The
+    # deferred all-reduce (y, B*k*4 bytes) has to dwarf both one psum
+    # launch AND whatever compute the roofline solver could save by
+    # resolving early (or reduce-scattering) and sharding the DOWNSTREAM
+    # ops — so the batch is large (big y) and the second matmul is narrow
+    # (little downstream compute to shard).  At B=4/k2=k both trades tie
+    # and the gate would pin a coin flip.
+    k, k2 = 512, 64
+    x = jnp.ones((256, k))
     w1 = jax.random.normal(jax.random.PRNGKey(0), (k, k)) / k ** 0.5
-    w2 = jax.random.normal(jax.random.PRNGKey(1), (k, k)) / k ** 0.5
+    w2 = jax.random.normal(jax.random.PRNGKey(1), (k, k2)) / k ** 0.5
 
     def step(x, w1, w2):
         x = fix_sharding(x, None, "tp")
