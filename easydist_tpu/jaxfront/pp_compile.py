@@ -123,8 +123,27 @@ class PPCompiledFunction:
                 f"expected pp_stages={self.pp_stages}")
         sib_axes = tuple(n for n in mesh.axis_names if n != pp_axis)
 
+        # non-float param leaves (bool masks, int tables — e.g. HF GPT-2's
+        # causal-mask buffers) cannot ride the float transport or the adam
+        # update: bake them into the traced closure as constants and
+        # pipeline only the differentiable leaves
+        all_leaves, pdef = jax.tree_util.tree_flatten(params)
+        diff_idx = [i for i, l in enumerate(all_leaves)
+                    if jnp.issubdtype(jnp.result_type(l), jnp.inexact)]
+        const_vals = {i: l for i, l in enumerate(all_leaves)
+                      if i not in set(diff_idx)}
+        self._diff_idx, self._params_treedef = diff_idx, pdef
+
+        def merge(diff_leaves):
+            out = list(const_vals.get(i) for i in range(len(all_leaves)))
+            for i, l in zip(diff_idx, diff_leaves):
+                out[i] = l
+            return jax.tree_util.tree_unflatten(pdef, out)
+
+        diff_example = [all_leaves[i] for i in diff_idx]
+
         def loss_flat_mb(p, mb_tuple):
-            return self.loss_fn(p, *mb_tuple)
+            return self.loss_fn(merge(p), *mb_tuple)
 
         from easydist_tpu.jaxfront.inline import inline_calls
 
@@ -149,8 +168,8 @@ class PPCompiledFunction:
 
             mb_local = tuple(jax.tree_util.tree_map(to_local_mb, b)
                              for b in batch)
-            closed = inline_calls(jax.make_jaxpr(loss_flat_mb)(params,
-                                                               mb_local))
+            closed = inline_calls(jax.make_jaxpr(loss_flat_mb)(
+                diff_example, mb_local))
             return to_mb, mb_local, closed
 
         to_mb, mb_local, closed = batch_division(self.tp_axes)
@@ -160,24 +179,32 @@ class PPCompiledFunction:
             tp_plan = self._solve_tp(closed, tp_axis, mesh.shape[tp_axis])
             self._tp_plan = tp_plan
             if not tp_plan:
-                # nothing profitable to tensor-shard: the tp axis reverts
-                # to batch parallelism (leaving it idle would silently
-                # DUPLICATE gradients across its lanes — r5 review #1)
-                tp_plan = tp_axis = None
-                to_mb, mb_local, closed = batch_division(())
+                # Nothing profitable to tensor-shard: the tp axis runs
+                # IDLE (replicated compute; gradients lane-averaged by the
+                # mean-class machinery) rather than re-tracing with tp as
+                # a batch axis — a torch-exported loss has concrete view
+                # shapes baked in and cannot re-trace at a different local
+                # batch (r5 review #2).  Warn: dropping tp_axes (or adding
+                # it to the batch axes) is strictly more efficient.
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "[pp-hybrid] tp solver found nothing profitable to "
+                    "shard; axis %r runs idle — drop tp_axes= for batch "
+                    "parallelism instead", tp_axis)
 
         if self.schedule == "1f1b":
             from easydist_tpu.parallel.auto_pipeline import (
                 pipeline_1f1b_grad)
 
             pipe_grad, pack_params = pipeline_1f1b_grad(
-                loss_flat_mb, params, mb_local, mesh,
+                loss_flat_mb, diff_example, mb_local, mesh,
                 n_stages=self.pp_stages, n_microbatches=M, axis=pp_axis,
                 tp_plan=tp_plan, tp_axis=tp_axis, closed=closed)
             pipe = None
         else:
             pipe, pack_params = pipeline_forward(
-                loss_flat_mb, params, mb_local, mesh,
+                loss_flat_mb, diff_example, mb_local, mesh,
                 n_stages=self.pp_stages, n_microbatches=M, axis=pp_axis,
                 shard_params=True, manual_siblings=True,
                 remat_stages=(self.schedule == "remat"),
@@ -225,7 +252,8 @@ class PPCompiledFunction:
         jitted = jax.jit(step, donate_argnums=(0,))
 
         def init_state(raw_params):
-            repr_ = pack_params(raw_params)
+            raw_leaves = jax.tree_util.tree_leaves(raw_params)
+            repr_ = pack_params([raw_leaves[i] for i in diff_idx])
             packed, shared = repr_
             placed = (jax.device_put(packed, packed_sharding), shared)
             opt = opt_init(placed) if opt_init is not None else ()

@@ -108,7 +108,8 @@ def _balanced_splits(flops: Sequence[float], n: int) -> List[int]:
 
 
 class _StagePlan:
-    def __init__(self, closed_jaxpr, n_stages: int):
+    def __init__(self, closed_jaxpr, n_stages: int,
+                 n_param_leaves: int = 0):
         jaxpr = closed_jaxpr.jaxpr
         self.closed = closed_jaxpr
         eqns = jaxpr.eqns
@@ -150,16 +151,67 @@ class _StagePlan:
             if not isinstance(v, jex_core.Literal):
                 last_use[v] = self.n_stages - 1
 
+        # non-float values cannot ride the float transport; when such a
+        # value derives from invars/consts through a SHORT chain (causal
+        # masks, index tables), consuming stages recompute it locally
+        # instead of shipping it.  self.remat_chains: var -> topo-ordered
+        # eqns rebuilding it from stage-locally-available inputs.
+        producer_of = {}
+        for e in eqns:
+            for v in e.outvars:
+                producer_of[v] = e
+        # roots a stage branch is guaranteed to hold: DATA inputs (passed
+        # to every branch) and consts — NOT params, which may be packed
+        # onto a different stage (r5 review #3)
+        always_avail = set(jaxpr.invars[n_param_leaves:]) \
+            | set(jaxpr.constvars)
+        self.remat_chains: Dict = {}
+
+        def const_chain(v, budget=32):
+            """Topo eqn chain computing v from data/consts through CHEAP
+            ops only, or None (rooted at a param, passes real compute, or
+            too long) — consuming stages re-run the chain, so duplicating
+            a matmul would defeat the FLOP balance (r5 review #4)."""
+            chain, seen = [], set()
+
+            def visit(u):
+                if u in always_avail or isinstance(u, jex_core.Literal):
+                    return True
+                e = producer_of.get(u)
+                if e is None:
+                    return False  # param invar or unknown
+                if id(e) in seen:
+                    return True
+                if len(chain) >= budget or e.primitive.name in _HEAVY:
+                    return False
+                if not all(visit(w) for w in e.invars
+                           if not isinstance(w, jex_core.Literal)):
+                    return False
+                seen.add(id(e))
+                chain.append(e)
+                return True
+
+            return chain if visit(v) else None
+
         # boundary b carries vars defined at stage <= b, used at stage > b
         self.boundaries: List[List] = []
         for b in range(n_stages - 1):
-            live = [v for v, d in def_stage.items()
-                    if 0 <= d <= b and last_use.get(v, -1) > b]
-            for v in live:
-                if not jnp.issubdtype(v.aval.dtype, jnp.floating):
-                    raise NotImplementedError(
-                        f"non-float value {v.aval} crosses a pipeline "
-                        f"boundary; place the split elsewhere")
+            live = []
+            for v, d in def_stage.items():
+                if not (0 <= d <= b and last_use.get(v, -1) > b):
+                    continue
+                if jnp.issubdtype(v.aval.dtype, jnp.floating):
+                    live.append(v)
+                    continue
+                if v not in self.remat_chains:
+                    chain = const_chain(v)
+                    if chain is None:
+                        raise NotImplementedError(
+                            f"non-float value {v.aval} crosses a pipeline "
+                            f"boundary and does not derive from "
+                            f"params/data by a short chain; place the "
+                            f"split elsewhere")
+                    self.remat_chains[v] = chain
             self.boundaries.append(live)
 
         self.out_vars = [v for v in jaxpr.outvars]
@@ -289,20 +341,16 @@ def _prepare_pipeline(fn, example_params, example_mb, mesh, n_stages,
         raise ValueError("manual_siblings=True requires shard_params=True")
     if tp_plan and (tp_axis is None or not manual_siblings):
         raise ValueError("tp_plan needs tp_axis and manual_siblings=True")
-    if tp_plan is not None and not tp_plan:
-        raise ValueError(
-            "empty tp_plan: drop the tp axis instead (an idle tp axis "
-            "would silently duplicate gradients across its lanes)")
     if closed is None:
         closed = inline_calls(jax.make_jaxpr(fn)(example_params,
                                                  example_mb))
-    plan = _StagePlan(closed, n_stages)
+    n_param_leaves = len(jax.tree_util.tree_leaves(example_params))
+    plan = _StagePlan(closed, n_stages, n_param_leaves=n_param_leaves)
     jaxpr = closed.jaxpr
     S = n_stages
 
     prep = _PipelinePrep()
     prep.plan = plan
-    n_param_leaves = len(jax.tree_util.tree_leaves(example_params))
     param_vars = jaxpr.invars[:n_param_leaves]
     data_vars = jaxpr.invars[n_param_leaves:]
     prep.sib_axes = tuple(n for n in mesh.axis_names if n != axis) \
@@ -318,27 +366,56 @@ def _prepare_pipeline(fn, example_params, example_mb, mesh, n_stages,
     # grads and the sibling psum must average instead.  Mixed-use params
     # are forced fully replicated for consistency.
     mean_params = set()
-    if tp_plan:
+    if tp_plan is not None:
+        # An EMPTY plan still needs the mean treatment: the tp lanes then
+        # run fully replicated, so every param's identical lane gradients
+        # must average, not sum.  Mixed-use params (one tp-sharded use,
+        # one replicated) are forced fully replicated — feeding a forced-
+        # replicated input to an eqn whose OTHER operands stay sharded
+        # would bind mismatched shapes, so such plan entries are dropped
+        # to a fixed point (r5 review #1).
+        tp_plan = dict(tp_plan)
         param_set = set(param_vars)
-        sharded_use, repl_use = set(), set()
-        for idx, eqn in enumerate(jaxpr.eqns):
-            strat = tp_plan.get(idx)
-            var_pos = 0
-            for v in eqn.invars:
-                if isinstance(v, jex_core.Literal):
+        while True:
+            sharded_use, repl_use = set(), set()
+            for idx, eqn in enumerate(jaxpr.eqns):
+                strat = tp_plan.get(idx)
+                var_pos = 0
+                for v in eqn.invars:
+                    if isinstance(v, jex_core.Literal):
+                        continue
+                    want = None
+                    if strat is not None \
+                            and var_pos < len(strat.in_placements):
+                        want = strat.in_placements[var_pos]
+                    var_pos += 1
+                    if v in param_set:
+                        if want is not None and want.is_shard():
+                            sharded_use.add(v)
+                        else:
+                            repl_use.add(v)
+            mean_params = {v for v in param_vars
+                           if v in repl_use or v not in sharded_use}
+            drop = []
+            for idx, eqn in enumerate(jaxpr.eqns):
+                strat = tp_plan.get(idx)
+                if strat is None:
                     continue
-                want = None
-                if strat is not None \
-                        and var_pos < len(strat.in_placements):
-                    want = strat.in_placements[var_pos]
-                var_pos += 1
-                if v in param_set:
-                    if want is not None and want.is_shard():
-                        sharded_use.add(v)
-                    else:
-                        repl_use.add(v)
-        mean_params = {v for v in param_vars
-                       if v in repl_use or v not in sharded_use}
+                var_pos = 0
+                for v in eqn.invars:
+                    if isinstance(v, jex_core.Literal):
+                        continue
+                    want = strat.in_placements[var_pos] \
+                        if var_pos < len(strat.in_placements) else None
+                    var_pos += 1
+                    if v in mean_params and want is not None \
+                            and want.is_shard():
+                        drop.append(idx)
+                        break
+            if not drop:
+                break
+            for idx in drop:
+                del tp_plan[idx]
 
     stage_layouts = shared_pos = stage_param_elems = None
     if shard_params:
@@ -373,8 +450,29 @@ def _prepare_pipeline(fn, example_params, example_mb, mesh, n_stages,
                 env[var] = val
             if s > 0:
                 env.update(plan.unpack(buf_in, plan.boundaries[s - 1]))
+                # rebuild constant-derived non-float values this stage
+                # consumes (they don't ride the float transport)
+                needed = [v for v in plan.remat_chains
+                          if v not in env and any(
+                              v in e2.invars
+                              for e2 in plan.stage_eqns[s])]
+                done = set()
+                for v in needed:
+                    for e2 in plan.remat_chains[v]:
+                        if id(e2) in done or all(o in env
+                                                 for o in e2.outvars):
+                            continue
+                        done.add(id(e2))
+                        sub2, bp2 = e2.primitive.get_bind_params(e2.params)
+                        iv2 = [w.val if isinstance(w, jex_core.Literal)
+                               else env[w] for w in e2.invars]
+                        o2 = e2.primitive.bind(*sub2, *iv2, **bp2)
+                        if not e2.primitive.multiple_results:
+                            o2 = [o2]
+                        for var2, val2 in zip(e2.outvars, o2):
+                            env[var2] = val2
 
-            if tp_plan and mean_params:
+            if tp_plan is not None and mean_params:
                 inv_t = 1.0 / tp_size
                 for v in list(env):
                     if v in mean_params:

@@ -9,4 +9,5 @@ CUDA/NCCL runtime entirely.
 """
 
 from .convert import torch_module_to_jax  # noqa: F401
-from .api import easydist_compile_torch, make_torch_train_step  # noqa: F401
+from .api import (easydist_compile_torch, make_torch_pp_train_step,  # noqa: F401
+                  make_torch_train_step)

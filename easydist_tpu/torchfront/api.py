@@ -478,3 +478,89 @@ def _make_train_mode_step(module, example_args, loss_fn, optimizer, lr,
         return jax.device_put(state, _state_shardings(state))
 
     return jitted, placed_init_state
+
+
+def make_torch_pp_train_step(module, example_args, loss_fn: Callable,
+                             mesh, pp_stages: int,
+                             n_microbatches: Optional[int] = None,
+                             lr: Optional[float] = None,
+                             optimizer: str = "adam",
+                             schedule: str = "gpipe", tp_axes=None,
+                             train: bool = False):
+    """Pipeline-parallel training for a torch module — the torch frontend
+    entry to the hybrid auto-PP x SPMD compile (reference:
+    easydist/torch/experimental/pp/api.py:13-105, where per-rank processes
+    run ScheduleGPipe/DAPPLE over NCCL; here the converted module is
+    auto-split into stages of ONE fully-manual SPMD program,
+    jaxfront/pp_compile.py).
+
+    Returns (compiled, params0):
+        state = compiled.init_state(params0, inputs, *targets)
+        state, loss = compiled(state, inputs, *targets)
+
+    loss_fn(outputs, *targets) -> scalar jax loss (mean reduction).
+    train=True exports training-mode semantics; modules with stateful
+    buffers (batch-norm running stats) or active dropout are rejected —
+    their updates do not thread through pipeline stages yet (use
+    parallel_mode='auto' in make_torch_train_step for those).
+    optimizer: 'adam' or 'sgd' (the pp path runs its optimizer on the
+    packed stage rows; torch.optim instances with per-group
+    hyperparameters do not map onto that flat representation).
+    """
+    if not isinstance(optimizer, str):
+        raise NotImplementedError(
+            "torch.optim instances are not supported with pp_stages: the "
+            "pipeline optimizer runs on packed flat stage rows, which "
+            "per-parameter-group hyperparameters cannot address; pass "
+            "optimizer='adam'/'sgd' + lr=")
+    # torch.export bakes concrete sizes into view/reshape params, and the
+    # pipeline replays stages at BATCH-LOCAL microbatch shape — so the
+    # module must be exported at exactly that shape
+    M = n_microbatches or pp_stages * 2
+    batch_axes = [a for a in mesh.axis_names
+                  if a != "pp" and a not in (tp_axes or ())]
+    import math as _math
+
+    n_batch = _math.prod(int(mesh.shape[a]) for a in batch_axes)
+    div = M * n_batch
+
+    def _shrink(x):
+        if x.shape[0] % div != 0:
+            raise ValueError(
+                f"example batch dim {x.shape[0]} not divisible by "
+                f"n_microbatches*batch-siblings = {M}*{n_batch}")
+        return x[: x.shape[0] // div]
+
+    local_args = tuple(_shrink(a) for a in example_args)
+    fwd, params0 = torch_module_to_jax(module, local_args, train=train)
+    if getattr(fwd, "mutated_buffer_names", None):
+        raise NotImplementedError(
+            "modules that MUTATE buffers (batch-norm running stats) "
+            "cannot pipeline yet — buffer updates do not thread through "
+            "stage boundaries; use make_torch_train_step(..., "
+            "parallel_mode='auto').  Constant buffers (masks) are fine.")
+
+    if train:
+        import jax as _jax
+
+        _fixed_rng = _jax.random.PRNGKey(0)
+
+        def loss(params, inputs, *targets):
+            out, _ = fwd(params, _fixed_rng, inputs)
+            return loss_fn(out, *targets)
+
+        # a fixed rng would silently freeze dropout masks across steps
+        if any("dropout" in op for op in getattr(fwd, "aten_ops", ())):
+            raise NotImplementedError(
+                "active dropout cannot pipeline yet (the step-invariant "
+                "rng would freeze masks); export with p=0 or use "
+                "parallel_mode='auto'")
+    else:
+        def loss(params, inputs, *targets):
+            return loss_fn(fwd(params, inputs), *targets)
+
+    compiled = easydist_compile(loss, mesh=mesh, pp_stages=pp_stages,
+                                n_microbatches=M, lr=lr,
+                                optimizer=optimizer, schedule=schedule,
+                                tp_axes=tp_axes)
+    return compiled, params0

@@ -914,6 +914,14 @@ def torch_module_to_jax(module, example_args, train: bool = False):
     fn.buffer_names = frozenset(
         (sig.inputs_to_buffers or {}).values()) | frozenset(
         (getattr(sig, "inputs_to_lifted_tensor_constants", {}) or {}).values())
+    # the aten surface of the exported graph, for capability checks (e.g.
+    # the torch pp path rejects active dropout)
+    fn.aten_ops = frozenset(str(n.target) for n in node_list
+                            if n.op == "call_function")
+    # buffers the module MUTATES (batch-norm running stats) vs constant
+    # buffers (causal masks etc) — only the former block pipelining
+    fn.mutated_buffer_names = frozenset(mutated.values()) if train \
+        else frozenset()
     return fn, params
 
 

@@ -326,3 +326,21 @@ def test_indivisible_batch_raises(cpu_devices):
     x, y = _batch(jax.random.PRNGKey(1), n=16)  # 16 % (3*2) != 0
     with pytest.raises(ValueError, match="not divisible"):
         compiled.init_state(params, x, y)
+
+
+@pytest.mark.world_8
+def test_tp_axis_idles_when_nothing_profitable(cpu_devices):
+    """r5 review #2: at tiny dims the tp solver finds nothing worth a psum
+    launch — the axis must run IDLE with lane-averaged gradients (exact
+    parity), never silently duplicate them, and never re-trace (a
+    torch-exported loss cannot re-trace at a different local batch)."""
+    mesh = Mesh(np.array(cpu_devices).reshape(2, 2, 2), ("pp", "dp", "tp"))
+    state = None
+    key = jax.random.PRNGKey(0)
+    params = _make_params(key)
+    batches = [_batch(jax.random.PRNGKey(10 + i)) for i in range(3)]
+    lr = 1e-2
+    eager = _eager_losses(params, batches, lr)
+    hybrid, state = _hybrid_losses(mesh, 2, params, batches, lr,
+                                   tp_axes=("tp",))
+    np.testing.assert_allclose(hybrid, eager, rtol=2e-4, atol=2e-5)
