@@ -133,6 +133,7 @@ class PPCompiledFunction:
         const_vals = {i: l for i, l in enumerate(all_leaves)
                       if i not in set(diff_idx)}
         self._diff_idx, self._params_treedef = diff_idx, pdef
+        self._const_baked = {i: l for i, l in const_vals.items()}
 
         def merge(diff_leaves):
             out = list(const_vals.get(i) for i in range(len(all_leaves)))
@@ -326,6 +327,20 @@ class PPCompiledFunction:
                 "params shape/dtype signature differs from the one this "
                 "step was built with; build a new "
                 "easydist_compile(pp_stages=...) instance")
+        # non-float leaves were baked into the trace as CONSTANTS: a
+        # re-init whose int tables/masks changed content would silently
+        # compute with the old values (r5 review #2)
+        import numpy as _np
+
+        leaves = jax.tree_util.tree_leaves(params)
+        for i, baked in self._const_baked.items():
+            if not _np.array_equal(_np.asarray(leaves[i]),
+                                   _np.asarray(baked)):
+                raise ValueError(
+                    "a non-float param leaf changed content since the "
+                    "build; non-float leaves are baked into the traced "
+                    "program as constants — build a new "
+                    "easydist_compile(pp_stages=...) instance")
         if example_batch:
             bstruct = _struct(example_batch)
             if bstruct != self._batch_struct:

@@ -539,6 +539,24 @@ def make_torch_pp_train_step(module, example_args, loss_fn: Callable,
             "cannot pipeline yet — buffer updates do not thread through "
             "stage boundaries; use make_torch_train_step(..., "
             "parallel_mode='auto').  Constant buffers (masks) are fine.")
+    # buffers are not weights: float buffers (eval-mode BN running stats)
+    # must never reach the pipeline optimizer, so close over them instead
+    # of handing them to pp_compile as trainable leaves
+    buffers0 = {k: v for k, v in params0.items() if k in fwd.buffer_names}
+    params0 = {k: v for k, v in params0.items()
+               if k not in fwd.buffer_names}
+    raw_fwd = fwd
+
+    if buffers0:
+        if train:
+            def fwd(p, rng, inputs):  # noqa: F811
+                return raw_fwd({**p, **buffers0}, rng, inputs)
+        else:
+            def fwd(p, inputs):  # noqa: F811
+                return raw_fwd({**p, **buffers0}, inputs)
+        fwd.buffer_names = raw_fwd.buffer_names
+        fwd.aten_ops = raw_fwd.aten_ops
+        fwd.stochastic_ops = raw_fwd.stochastic_ops
 
     if train:
         import jax as _jax
@@ -550,11 +568,13 @@ def make_torch_pp_train_step(module, example_args, loss_fn: Callable,
             return loss_fn(out, *targets)
 
         # a fixed rng would silently freeze dropout masks across steps
-        if any("dropout" in op for op in getattr(fwd, "aten_ops", ())):
+        # (stochastic_ops also catches sdpa's argument-carried dropout_p,
+        # which no op-NAME check can see)
+        if getattr(fwd, "stochastic_ops", ()):
             raise NotImplementedError(
-                "active dropout cannot pipeline yet (the step-invariant "
-                "rng would freeze masks); export with p=0 or use "
-                "parallel_mode='auto'")
+                f"stochastic ops {sorted(fwd.stochastic_ops)} cannot "
+                f"pipeline yet (the step-invariant rng would freeze their "
+                f"masks); export with p=0 or use parallel_mode='auto'")
     else:
         def loss(params, inputs, *targets):
             return loss_fn(fwd(params, inputs), *targets)

@@ -918,6 +918,26 @@ def torch_module_to_jax(module, example_args, train: bool = False):
     # the torch pp path rejects active dropout)
     fn.aten_ops = frozenset(str(n.target) for n in node_list
                             if n.op == "call_function")
+
+    def _is_stochastic(n):
+        t = str(n.target)
+        if "dropout" in t:
+            pval = n.args[1] if len(n.args) > 1 else 0.0
+        elif "scaled_dot_product_attention" in t:
+            # (q, k, v, attn_mask=None, dropout_p=0.0, ...)
+            pval = n.kwargs.get(
+                "dropout_p", n.args[4] if len(n.args) > 4 else 0.0)
+        else:
+            return False
+        # a non-literal p (traced tensor) is conservatively stochastic
+        return not isinstance(pval, (int, float)) or pval > 0.0
+
+    # ops that would draw randomness at runtime (dropout with p>0,
+    # sdpa with dropout_p>0) — the pp path must reject these, and a
+    # name-substring check misses sdpa's argument-carried dropout
+    fn.stochastic_ops = frozenset(
+        str(n.target) for n in node_list
+        if n.op == "call_function" and _is_stochastic(n))
     # buffers the module MUTATES (batch-norm running stats) vs constant
     # buffers (causal masks etc) — only the former block pipelining
     fn.mutated_buffer_names = frozenset(mutated.values()) if train \

@@ -341,6 +341,16 @@ def test_tp_axis_idles_when_nothing_profitable(cpu_devices):
     batches = [_batch(jax.random.PRNGKey(10 + i)) for i in range(3)]
     lr = 1e-2
     eager = _eager_losses(params, batches, lr)
-    hybrid, state = _hybrid_losses(mesh, 2, params, batches, lr,
-                                   tp_axes=("tp",))
+    compiled = easydist_compile(_loss_fn, mesh=mesh, pp_stages=2,
+                                n_microbatches=4, lr=lr, tp_axes=("tp",))
+    x0, y0 = batches[0]
+    state = compiled.init_state(params, x0, y0)
+    hybrid = []
+    for x, y in batches:
+        state, loss = compiled(state, x, y)
+        hybrid.append(float(loss))
     np.testing.assert_allclose(hybrid, eager, rtol=2e-4, atol=2e-5)
+    # the behavior under test IS the empty-plan idle path: pin it so a
+    # cost-model change that starts sharding here fails loudly instead of
+    # silently testing the non-idle path
+    assert compiled._tp_plan == {}, compiled._tp_plan
