@@ -264,6 +264,27 @@ class PPCompiledFunction:
         self._batch_struct = _struct(batch)
         return self._built
 
+    # --------------------------------------------------------- introspection
+
+    @property
+    def tp_plan(self):
+        """Read-only copy of the solver's tensor-parallel plan:
+        {eqn index: NodeStrategy} over the traced loss jaxpr (empty when
+        tp_axes was not given, nothing was profitable, or before the
+        first init_state builds)."""
+        return dict(self._tp_plan) if self._tp_plan else {}
+
+    def tp_summary(self):
+        """{'planned': total strategies, 'sharded': strategies that shard
+        at least one operand} — the stable way to report what the tp
+        solver decided (examples/jax/hybrid_pp_tp.py)."""
+        plan = self.tp_plan
+        sharded = sum(
+            1 for s in plan.values()
+            if any(q is not None and q.is_shard()
+                   for q in list(s.in_placements) + list(s.out_placements)))
+        return {"planned": len(plan), "sharded": sharded}
+
     # ------------------------------------------------------------ tp solve
 
     # composite / specially-lowered primitives: their solver strategies

@@ -763,6 +763,14 @@ def _sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None):
         else:
             s = s + attn_mask
     p = jax.nn.softmax(s, axis=-1)
+    if dropout_p:
+        # torch sdpa training semantics: dropout on the attention weights
+        # with 1/(1-p) rescale.  Rides the same per-site rng machinery as
+        # aten.dropout (semantically equivalent to eager torch; the masks
+        # themselves come from a different generator, like all dropout
+        # here).  Silently skipping it trained without attention dropout.
+        keep = jax.random.bernoulli(_next_rng(), 1.0 - dropout_p, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_p), 0.0).astype(p.dtype)
     return jnp.einsum("...qk,...kd->...qd", p, v)
 
 
@@ -923,6 +931,10 @@ def torch_module_to_jax(module, example_args, train: bool = False):
         t = str(n.target)
         if "dropout" in t:
             pval = n.args[1] if len(n.args) > 1 else 0.0
+            # dropout(x, p, train): train=False is eval-frozen — fully
+            # deterministic regardless of p (r5 review #1)
+            if len(n.args) > 2 and n.args[2] is False:
+                return False
         elif "scaled_dot_product_attention" in t:
             # (q, k, v, attn_mask=None, dropout_p=0.0, ...)
             pval = n.kwargs.get(

@@ -54,11 +54,8 @@ def main():
     n_dev = len(mesh.devices.flatten())
     print(f"param bytes/device: {packed.addressable_shards[0].data.nbytes}"
           f" of {packed.nbytes} total (1/{n_dev})")
-    tp_sharded = sum(
-        1 for s in (step._tp_plan or {}).values()
-        if any(p is not None and p.is_shard()
-               for p in list(s.in_placements) + list(s.out_placements)))
-    print(f"solver tensor-sharded {tp_sharded} eqns inside the stages")
+    print(f"solver tensor-sharded {step.tp_summary()['sharded']} eqns "
+          f"inside the stages")
 
     for i in range(5):
         state, loss = step(state, x, y)

@@ -91,19 +91,9 @@ def _run_parity(mesh, pp_stages, **kw):
 
 
 def test_pp_dp_parity_3step(cpu_devices):
-    """The round-4 deadlock configuration: 4 stages x dp=2."""
-    mesh = Mesh(np.array(cpu_devices).reshape(4, 2), ("pp", "dp"))
-    _run_parity(mesh, pp_stages=4)
-
-
-def test_pp_dp_tp_parity_3step(cpu_devices):
-    """3-axis mesh (2,2,2): siblings dp x tp batch-parallelise stages."""
-    mesh = Mesh(np.array(cpu_devices).reshape(2, 2, 2), ("pp", "dp", "tp"))
-    _run_parity(mesh, pp_stages=2)
-
-
-def test_param_bytes_sharded_over_all_devices(cpu_devices):
-    """Packed stage rows: per-device bytes ~ total / n_devices."""
+    """The round-4 deadlock configuration: 4 stages x dp=2 — plus the
+    ZeRO param-bytes promise on the same build (per-device bytes ~
+    total / n_devices)."""
     mesh = Mesh(np.array(cpu_devices).reshape(4, 2), ("pp", "dp"))
     state = _run_parity(mesh, pp_stages=4)
     (packed, shared), _opt = state
@@ -115,6 +105,16 @@ def test_param_bytes_sharded_over_all_devices(cpu_devices):
         f"per-device {per_dev}B vs total {total}B: rows not ZeRO-sharded"
 
 
+@pytest.mark.long_duration
+def test_pp_dp_tp_parity_3step(cpu_devices):
+    """3-axis mesh (2,2,2): siblings dp x tp batch-parallelise stages.
+    (The fast tier covers the 3-axis mesh through the stronger tp-inside-
+    stages gate.)"""
+    mesh = Mesh(np.array(cpu_devices).reshape(2, 2, 2), ("pp", "dp", "tp"))
+    _run_parity(mesh, pp_stages=2)
+
+
+@pytest.mark.long_duration
 def test_remat_schedule_parity(cpu_devices):
     mesh = Mesh(np.array(cpu_devices).reshape(4, 2), ("pp", "dp"))
     _run_parity(mesh, pp_stages=4, schedule="remat")
@@ -127,6 +127,7 @@ def test_1f1b_schedule_parity(cpu_devices):
     _run_parity(mesh, pp_stages=4, schedule="1f1b")
 
 
+@pytest.mark.long_duration
 def test_1f1b_pp_dp_tp_parity(cpu_devices):
     mesh = Mesh(np.array(cpu_devices).reshape(2, 2, 2), ("pp", "dp", "tp"))
     _run_parity(mesh, pp_stages=2, schedule="1f1b")
@@ -223,6 +224,7 @@ def test_hybrid_tp_1f1b_parity(cpu_devices):
 
 @pytest.mark.world_8
 @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+@pytest.mark.long_duration
 def test_hybrid_tp_mixed_replicated_weight_grads(cpu_devices, schedule):
     """r5 review #1: a weight the tp solver REPLICATES (here a narrow
     head, too small to pay for a psum) must not get its gradient summed

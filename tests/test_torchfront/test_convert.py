@@ -450,3 +450,26 @@ def test_boolean_mask_index_put_non_leading_dim():
     got = fn(params, jnp.asarray(x.numpy()))
     np.testing.assert_allclose(np.asarray(got), m(x).detach().numpy(),
                                rtol=1e-6, atol=1e-7)
+
+
+def test_sdpa_dropout_draws_randomness(cpu_devices):
+    """r5 review: sdpa's argument-carried dropout_p must apply attention
+    dropout on the train path (it was silently dropped), riding the same
+    per-site rng as aten.dropout."""
+    import numpy as np
+
+    class M(torch.nn.Module):
+        def forward(self, q):
+            return torch.nn.functional.scaled_dot_product_attention(
+                q, q, q, dropout_p=0.5)
+
+    m = M().train()
+    q = torch.randn(1, 2, 8, 4)
+    fwd, params = torch_module_to_jax(m, (q,), train=True)
+    jq = jnp.asarray(q.numpy())
+    o1, _ = fwd(params, jax.random.PRNGKey(0), jq)
+    o2, _ = fwd(params, jax.random.PRNGKey(1), jq)
+    o1b, _ = fwd(params, jax.random.PRNGKey(0), jq)
+    assert not np.allclose(np.asarray(o1), np.asarray(o2)), \
+        "different rngs must give different attention dropout masks"
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o1b))

@@ -180,6 +180,7 @@ def test_hf_gpt2_train_parity_zero3_shards_state(cpu_devices):
 
 
 @pytest.mark.world_8
+@pytest.mark.long_duration
 def test_hf_gpt2_pipeline_parallel(cpu_devices):
     """The torch PP path (reference torch/experimental/pp/api.py): a real
     HF GPT-2 class auto-split into pipeline stages over a pp x dp mesh via
