@@ -769,6 +769,12 @@ def _sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None):
         # aten.dropout (semantically equivalent to eager torch; the masks
         # themselves come from a different generator, like all dropout
         # here).  Silently skipping it trained without attention dropout.
+        if _RNG_STATE[0] is None:
+            raise UnsupportedAtenOp(
+                "scaled_dot_product_attention with dropout_p>0 in an "
+                "EVAL-mode export has no rng to draw from; re-export "
+                "with train=True, or pass dropout_p=0.0 when the module "
+                "is not training")
         keep = jax.random.bernoulli(_next_rng(), 1.0 - dropout_p, p.shape)
         p = jnp.where(keep, p / (1.0 - dropout_p), 0.0).astype(p.dtype)
     return jnp.einsum("...qk,...kd->...qd", p, v)

@@ -197,11 +197,9 @@ def _run_tp_parity(mesh, pp_stages, schedule="gpipe"):
     # tp psums reorder f32 reductions vs the eager single-device sums;
     # D=1024 contractions accumulate ~1e-4 relative drift over 3 steps
     np.testing.assert_allclose(ours, eager, rtol=8e-4, atol=5e-5)
-    assert compiled._tp_plan, "tp solver produced an empty plan"
-    sharded = [s for s in compiled._tp_plan.values()
-               if any(q is not None and q.is_shard()
-                      for q in list(s.in_placements) + list(s.out_placements))]
-    assert sharded, f"no sharded tp strategies chosen: {compiled._tp_plan}"
+    summ = compiled.tp_summary()
+    assert summ["planned"], "tp solver produced an empty plan"
+    assert summ["sharded"], f"no sharded tp strategies chosen: {summ}"
 
 
 @pytest.mark.world_8

@@ -456,7 +456,6 @@ def test_sdpa_dropout_draws_randomness(cpu_devices):
     """r5 review: sdpa's argument-carried dropout_p must apply attention
     dropout on the train path (it was silently dropped), riding the same
     per-site rng as aten.dropout."""
-    import numpy as np
 
     class M(torch.nn.Module):
         def forward(self, q):
