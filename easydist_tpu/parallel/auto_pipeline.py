@@ -77,12 +77,14 @@ def split_point(x):
 
 
 def _eqn_flops(eqn) -> float:
-    if eqn.primitive.name not in _HEAVY:
-        return 1.0
-    out = sum(math.prod(v.aval.shape) for v in eqn.outvars)
-    inp = max((math.prod(v.aval.shape) for v in eqn.invars
-               if not isinstance(v, jex_core.Literal)), default=1)
-    return float(out) * max(inp / max(out, 1), 1.0) * 2.0
+    """Stage-balance weight: the bridge's estimator knows dot/conv
+    dimension_numbers AND composite bodies (scan = length x body,
+    cond = max branch, while = trips x body) — the old dot-only local
+    heuristic weighted a whole scan-over-layers at 1.0 and packed all
+    real compute into one stage."""
+    from easydist_tpu.jaxfront.bridge import _eqn_flops as _bridge_flops
+
+    return max(float(_bridge_flops(eqn)), 1.0)
 
 
 def _balanced_splits(flops: Sequence[float], n: int) -> List[int]:

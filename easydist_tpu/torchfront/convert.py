@@ -45,12 +45,12 @@ def _set_rng(key):
     _RNG_STATE[1] = 0
 
 
-def _next_rng():
+def _next_rng(hint: str = None):
     if _RNG_STATE[0] is None:
-        raise UnsupportedAtenOp(
+        raise UnsupportedAtenOp(hint or (
             "training-mode dropout needs an rng: convert with "
             "torch_module_to_jax(..., train=True) and call fn(params, rng, "
-            "*inputs)")
+            "*inputs)"))
     key = jax.random.fold_in(_RNG_STATE[0], _RNG_STATE[1])
     _RNG_STATE[1] += 1
     return key
@@ -769,13 +769,11 @@ def _sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None):
         # aten.dropout (semantically equivalent to eager torch; the masks
         # themselves come from a different generator, like all dropout
         # here).  Silently skipping it trained without attention dropout.
-        if _RNG_STATE[0] is None:
-            raise UnsupportedAtenOp(
-                "scaled_dot_product_attention with dropout_p>0 in an "
-                "EVAL-mode export has no rng to draw from; re-export "
-                "with train=True, or pass dropout_p=0.0 when the module "
-                "is not training")
-        keep = jax.random.bernoulli(_next_rng(), 1.0 - dropout_p, p.shape)
+        keep = jax.random.bernoulli(_next_rng(
+            hint="scaled_dot_product_attention with dropout_p>0 in an "
+                 "EVAL-mode export has no rng to draw from; re-export "
+                 "with train=True, or pass dropout_p=0.0 when the module "
+                 "is not training"), 1.0 - dropout_p, p.shape)
         p = jnp.where(keep, p / (1.0 - dropout_p), 0.0).astype(p.dtype)
     return jnp.einsum("...qk,...kd->...qd", p, v)
 
