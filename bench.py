@@ -400,8 +400,126 @@ def child_main():
     print(json.dumps(result), flush=True)
 
 
+def serve_main():
+    """Serving-latency scenario (`--serve`): synthetic open-loop load
+    against `easydist_tpu.serve.ServeEngine` over the easydist-compiled
+    GPT forward.  Prints ONE JSON line with throughput (req/s), batch
+    occupancy, and p50/p99 end-to-end latency.
+
+    Open-loop means arrivals follow a fixed schedule regardless of
+    completion times (the users-don't-wait-for-each-other model), so the
+    latency numbers include queueing under real burstiness; a full queue
+    sheds load and is reported as `rejected`, not silently absorbed."""
+    import threading
+
+    result = {"metric": "serve_gpt_p50_ms", "value": 0.0, "unit": "ms"}
+    try:
+        got = _probe_backend(timeout=60)
+        if got is not None and got[0] == "tpu":
+            platform, n_chips, kind = got
+        else:
+            os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+            import jax as _jax_cpu
+
+            _jax_cpu.config.update("jax_platforms", "cpu")
+            platform, n_chips, kind = "cpu", 1, "host cpu"
+
+        import numpy as np
+
+        import jax
+
+        from easydist_tpu.jaxfront import easydist_compile, make_device_mesh
+        from easydist_tpu.models.gpt import GPTConfig, gpt_apply, gpt_init
+        from easydist_tpu.serve import (QueueFullError, ServeConfig,
+                                        ServeEngine)
+
+        on_tpu = platform == "tpu"
+        if on_tpu:
+            cfg = GPTConfig(vocab=50304, seq=1024, dim=768, heads=12,
+                            layers=12, dtype="bfloat16")
+            seq_buckets, batch_buckets = (256, 512, 1024), (4, 8)
+            n_requests = 200
+            offered_rps = float(os.environ.get("EASYDIST_SERVE_RPS", 40.0))
+        else:  # CPU smoke: shapes sized so the scenario finishes in seconds
+            cfg = GPTConfig.tiny()
+            seq_buckets, batch_buckets = (16, 32), (4, 8)
+            n_requests = 120
+            offered_rps = float(os.environ.get("EASYDIST_SERVE_RPS", 300.0))
+
+        params = gpt_init(cfg, jax.random.PRNGKey(0))
+        mesh = make_device_mesh((len(jax.devices()),), ("d",))
+
+        def infer(p, tokens):
+            return gpt_apply(p, cfg, tokens)
+
+        compiled = easydist_compile(infer, mesh=mesh, state_io={})
+        engine = ServeEngine(
+            compiled,
+            ServeConfig(batch_buckets=batch_buckets,
+                        seq_buckets=seq_buckets, max_wait_ms=5.0,
+                        max_queue=256, default_deadline_ms=120_000.0),
+            state=params)
+        t0 = time.time()
+        warmed = engine.warmup(
+            (np.zeros((seq_buckets[0],), np.int32),))
+        log(f"# serve bench: warmed {warmed} bucket shapes in "
+            f"{time.time() - t0:.1f}s on {platform} x{n_chips}")
+
+        rng = np.random.RandomState(0)
+        lengths = rng.randint(seq_buckets[0] // 2, max(seq_buckets) + 1,
+                              size=n_requests)
+        # Poisson arrivals at the offered rate (exponential gaps)
+        gaps = rng.exponential(1.0 / offered_rps, size=n_requests)
+        futures, rejected = [], 0
+        with engine:
+            t_start = time.time()
+            for n, gap in zip(lengths, gaps):
+                time.sleep(float(gap))
+                toks = rng.randint(0, cfg.vocab, (int(n),)).astype(np.int32)
+                try:
+                    futures.append(engine.submit(toks))
+                except QueueFullError:
+                    rejected += 1
+            done = failed = 0
+            for f in futures:
+                try:
+                    f.result(timeout=300)
+                    done += 1
+                except Exception:
+                    failed += 1
+            wall = time.time() - t_start
+            stats = engine.stats()
+            engine.export_metrics(sub_key="serve_bench")
+
+        lat = stats["latency"]["e2e"]
+        result.update({
+            "value": round(1e3 * (lat.get("p50_s") or 0.0), 2),
+            "p99_ms": round(1e3 * (lat.get("p99_s") or 0.0), 2),
+            "throughput_req_s": round(done / wall, 2),
+            "offered_rps": offered_rps,
+            "requests": n_requests,
+            "completed": done,
+            "failed": failed,
+            "rejected": rejected,
+            "batch_occupancy": round(stats["batch_occupancy"] or 0.0, 4),
+            "compile_cache_hit_rate": round(
+                stats["compile_cache_hit_rate"] or 0.0, 4),
+            "distinct_executables": stats["distinct_executables"],
+            "device": kind,
+            "n_chips": n_chips,
+            "load": "open-loop poisson",
+        })
+    except Exception as e:  # always land the JSON line
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        result["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(result), flush=True)
+
+
 if __name__ == "__main__":
-    if "--child" in sys.argv:
+    if "--serve" in sys.argv:
+        serve_main()
+    elif "--child" in sys.argv:
         child_main()
     else:
         main()

@@ -872,6 +872,8 @@ class CompiledFunction:
         self._last: Optional[CompileResult] = None
         self._perfdb = None
         self._warmed: set = set()
+        self._cache_hits = 0
+        self._cache_misses = 0
         functools.update_wrapper(self, func)
 
     @staticmethod
@@ -886,14 +888,45 @@ class CompiledFunction:
         flat_args, treedef = jax.tree_util.tree_flatten((args, kwargs))
         return self._lookup(flat_args, treedef, args, kwargs)
 
+    # ------------------------------------------------------ stable surface
+    # (the serving layer keys its shape-bucketed executable cache on these;
+    # keep them additive-only)
+
+    def cache_key(self, *args, **kwargs):
+        """Stable hashable key for the compiled-result cache entry these
+        args resolve to: (input treedef, per-leaf (shape, dtype)).  Two
+        call signatures share an executable iff their keys are equal."""
+        flat_args, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        return self._signature(flat_args, treedef)
+
+    def compiled_signatures(self):
+        """Keys (see `cache_key`) of every signature compiled so far."""
+        return tuple(self._cache)
+
+    def cache_stats(self) -> Dict[str, int]:
+        """{size, hits, misses} of the compile cache.  Hits count lookups
+        that found an existing CompileResult; the `_last` fast path in
+        `__call__` bypasses lookup entirely and is not counted."""
+        return {"size": len(self._cache), "hits": self._cache_hits,
+                "misses": self._cache_misses}
+
+    def executable_for(self, *args, **kwargs):
+        """The lowered+compiled XLA executable handle for this signature
+        (compiling it first if needed) — the object carrying
+        cost_analysis()/memory_analysis()."""
+        return self.get_compiled(*args, **kwargs).executable()
+
     def _lookup(self, flat_args, treedef, args, kwargs) -> CompileResult:
         sig = self._signature(flat_args, treedef)
         result = self._cache.get(sig)
         if result is None:
+            self._cache_misses += 1
             result = compile_step(
                 self.func, args, kwargs, mesh=self.mesh,
                 state_io=self.state_io, donate_state=self.donate_state)
             self._cache[sig] = result
+        else:
+            self._cache_hits += 1
         return result
 
     def __call__(self, *args, **kwargs):

@@ -794,28 +794,32 @@ class ShardingAnalyzer:
                         pins={seed: Placement.shard(d)})
                     if res is None:
                         break
-                    full_branch_compute = max(full_branch_compute,
-                                              res[3])
                     got = branch_extract(inner_b, sub_b, res[0])
                     if got is None:
                         break
-                    per_branch.append((got, res[1], res[2]))
+                    per_branch.append((got, res[1], res[2], res[3]))
                 if len(per_branch) != len(analyzed):
                     continue
                 keys = {(tuple(repr(p) for p in ins),
                          tuple(repr(p) for p in outs))
-                        for (ins, outs), _, _ in per_branch}
+                        for (ins, outs), _, _, _ in per_branch}
                 if len(keys) != 1:
                     continue  # branches disagree on the boundary
-                (ins, outs), _, _ = per_branch[0]
+                # fold the full-price compute only for solves that SURVIVED
+                # the per-branch agreement check — a rejected solve's price
+                # would skew the shard/replicate crossover the outer solver
+                # compares against (ADVICE r5 #1)
+                full_branch_compute = max(
+                    [full_branch_compute] + [fc for _, _, _, fc in per_branch])
+                (ins, outs), _, _, _ = per_branch[0]
                 if all(p.is_replicate() for p in ins):
                     continue
                 key = next(iter(keys))
                 if key in seen_keys:
                     continue
                 seen_keys.add(key)
-                comm = max(c for _, c, _ in per_branch)
-                compute = max(c for _, _, c in per_branch)
+                comm = max(c for _, c, _, _ in per_branch)
+                compute = max(c for _, _, c, _ in per_branch)
                 strategies.append((ins, outs, comm, compute))
                 for i, p in zip(edge_invars, ins):
                     if p.is_shard():
@@ -912,8 +916,14 @@ class ShardingAnalyzer:
                     cond_pins[cond_in_names[n_cc + kk]] = (
                         Placement.shard(p.dim) if p.is_shard()
                         else Placement.replicate())
-                cres = self._solve_body_pinned(cinner, csub, crules,
-                                               cshape, pins=cond_pins)
+                # cond consts (loop bounds etc.) are reported replicated at
+                # the emitted boundary (`ii < n_cc` below), so the
+                # predicate solve must price them that way too — left
+                # unpinned it could shard one and under-price the
+                # crossover (ADVICE r5 #1; pricing only, never correctness)
+                cres = self._solve_body_pinned(
+                    cinner, csub, crules, cshape, pins=cond_pins,
+                    replicate_names=tuple(cond_in_names[:n_cc]))
                 if cres is None:
                     continue
                 cond_comm = cres[1]

@@ -13,7 +13,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from easydist_tpu.utils.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 

@@ -44,7 +44,7 @@ def calibrate(mesh=None, axis: Optional[str] = None,
     Returns {"hbm_bandwidth", "ici_bandwidth", "ici_latency"} in the
     solver's units (bytes/s, seconds/launch).
     """
-    from jax import shard_map
+    from easydist_tpu.utils.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     # HBM-bound bandwidth: big elementwise op, bytes moved = read + write

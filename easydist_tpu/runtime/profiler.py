@@ -51,6 +51,19 @@ def memory_analysis(compiled) -> Dict[str, int]:
     return out
 
 
+def serving_history(sub_key: str = "engine",
+                    db: Optional[PerfDB] = None) -> list:
+    """Recorded serving-metrics snapshots for one engine (the export
+    target of `easydist_tpu.serve.ServeMetrics.export`): bounded history
+    of {counters, gauges, latency percentiles, batch_occupancy,
+    compile_cache_hit_rate} dicts, oldest first.  Serving history lives in
+    the same PerfDB as step-time history (EASYDIST_RUNTIME_PROF), so one
+    store answers both "how fast is the step" and "how is it serving"."""
+    if db is None:
+        db = PerfDB()
+    return db.get_op_perf("serving", sub_key) or []
+
+
 def profile_compiled(fn, args, key: Optional[str] = None,
                      trials: int = 5, warmup: int = 2,
                      db: Optional[PerfDB] = None,

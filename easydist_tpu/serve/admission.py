@@ -1,0 +1,108 @@
+"""Admission control and robustness policies for the serving engine.
+
+Three concerns live here, all independent of how batches are packed or run:
+
+- backpressure: a bounded queue rejects (`QueueFullError`) instead of
+  buffering unboundedly — the caller sheds load or retries upstream;
+- deadlines: every request carries an absolute expiry; an expired request
+  surfaces `DeadlineExceededError` instead of occupying a batch slot;
+- failure policy: transient executor failures are retried with exponential
+  backoff (`retry_transient`), and a bucket whose compile exhausts device
+  memory is classified by `is_oom_error` so the engine can degrade to
+  smaller batch buckets rather than failing every request routed to it.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+
+class ServeError(Exception):
+    """Base class for serving-layer failures."""
+
+
+class QueueFullError(ServeError):
+    """Backpressure: the bounded request queue is at capacity."""
+
+
+class DeadlineExceededError(ServeError):
+    """The request's deadline expired before a result was produced."""
+
+
+class EngineStoppedError(ServeError):
+    """The engine was stopped while the request was still pending."""
+
+
+class RequestTooLargeError(ServeError):
+    """A request dimension exceeds the largest configured bucket."""
+
+
+def is_oom_error(exc: BaseException) -> bool:
+    """Device-memory exhaustion during compile or execution (XLA surfaces
+    it as RESOURCE_EXHAUSTED; allocators say "out of memory")."""
+    msg = str(exc).lower()
+    return ("resource_exhausted" in msg or "out of memory" in msg
+            or "allocation failure" in msg or type(exc).__name__ == "OomError")
+
+
+def is_transient_error(exc: BaseException) -> bool:
+    """Failures worth retrying: runtime hiccups (UNAVAILABLE/ABORTED RPC
+    states, connection resets), never programming errors or OOM — retrying
+    an OOM at the same shape just re-exhausts the device."""
+    if is_oom_error(exc):
+        return False
+    if isinstance(exc, (TypeError, ValueError, KeyError, AttributeError,
+                        ServeError)):
+        return False
+    msg = str(exc).lower()
+    return any(tok in msg for tok in (
+        "unavailable", "aborted", "deadline_exceeded", "connection reset",
+        "transient", "cancelled", "socket closed"))
+
+
+def retry_transient(fn: Callable, *, max_retries: int, backoff_s: float,
+                    is_transient: Callable[[BaseException], bool]
+                    = is_transient_error,
+                    sleep: Callable[[float], None] = time.sleep):
+    """Call `fn()` retrying transient failures with exponential backoff
+    (backoff_s, 2*backoff_s, 4*backoff_s, ...).  Non-transient failures and
+    the final attempt's failure propagate."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 - classification decides
+            if attempt >= max_retries or not is_transient(e):
+                raise
+            sleep(backoff_s * (2 ** attempt))
+            attempt += 1
+
+
+class AdmissionController:
+    """Admission decision at submit time: assign the absolute deadline and
+    enforce queue-depth backpressure.  Kept separate from the queue so the
+    policy is unit-testable without threads."""
+
+    def __init__(self, max_queue: int,
+                 default_deadline_ms: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.max_queue = max_queue
+        self.default_deadline_ms = default_deadline_ms
+        self.clock = clock
+
+    def resolve_deadline(self, deadline_ms: Optional[float]) -> Optional[float]:
+        """Relative deadline (ms, or None for the config default) ->
+        absolute monotonic expiry seconds (None = no deadline)."""
+        ms = deadline_ms if deadline_ms is not None else self.default_deadline_ms
+        if ms is None:
+            return None
+        return self.clock() + ms / 1e3
+
+    def check_depth(self, depth: int) -> None:
+        if depth >= self.max_queue:
+            raise QueueFullError(
+                f"request queue at capacity ({self.max_queue}); shed load "
+                f"or raise ServeConfig.max_queue")

@@ -1,0 +1,156 @@
+"""Serving observability: counters, gauges, latency histograms.
+
+Everything a dashboard needs to judge a serving deployment — queue depth,
+batch occupancy (real rows / bucket rows), executable-cache hit rate,
+p50/p95/p99 latency — collected lock-cheap in-process and exported through
+the existing runtime plumbing (`runtime.perfdb.PerfDB`), so serving history
+lands next to the step-time history `EASYDIST_RUNTIME_PROF` already keeps.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+# log-spaced bucket upper bounds, 0.1ms .. ~107s (x2 per bucket)
+_DEFAULT_BOUNDS = tuple(1e-4 * (2 ** i) for i in range(21))
+
+
+class LatencyHistogram:
+    """Fixed log-spaced histogram over seconds.  Percentiles resolve to the
+    upper bound of the bucket containing the rank — a <=2x overestimate by
+    construction, stable under any traffic shape, O(1) memory."""
+
+    def __init__(self, bounds=_DEFAULT_BOUNDS):
+        self.bounds = tuple(bounds)
+        self.counts = [0] * (len(self.bounds) + 1)  # +1 overflow bucket
+        self.total = 0
+        self.sum = 0.0
+
+    def observe(self, seconds: float) -> None:
+        idx = len(self.bounds)
+        for i, b in enumerate(self.bounds):
+            if seconds <= b:
+                idx = i
+                break
+        self.counts[idx] += 1
+        self.total += 1
+        self.sum += seconds
+
+    def percentile(self, p: float) -> Optional[float]:
+        """p in [0, 100] -> seconds (bucket upper bound), None when empty."""
+        if self.total == 0:
+            return None
+        rank = max(1, int(round(p / 100.0 * self.total)))
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= rank:
+                return self.bounds[i] if i < len(self.bounds) \
+                    else self.bounds[-1] * 2
+        return self.bounds[-1] * 2
+
+    def mean(self) -> Optional[float]:
+        return self.sum / self.total if self.total else None
+
+    def snapshot(self) -> Dict[str, float]:
+        out = {"count": self.total}
+        if self.total:
+            out.update(mean_s=self.mean(),
+                       p50_s=self.percentile(50),
+                       p95_s=self.percentile(95),
+                       p99_s=self.percentile(99))
+        return out
+
+
+class ServeMetrics:
+    """Thread-safe counters/gauges/histograms for one `ServeEngine`.
+
+    Counter names (all monotonically increasing):
+      requests_submitted / completed / failed / timed_out / rejected,
+      batches_executed, batch_rows_real, batch_rows_padded,
+      compile_cache_hits, compile_cache_misses, oom_degradations,
+      transient_retries.
+    Histograms: queue_wait (submit->drain), execute (device time incl.
+    host roundtrip), e2e (submit->future resolution)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._gauges: Dict[str, float] = {}
+        self.queue_wait = LatencyHistogram()
+        self.execute = LatencyHistogram()
+        self.e2e = LatencyHistogram()
+
+    # ------------------------------------------------------------- recording
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, hist_name: str, seconds: float) -> None:
+        with self._lock:
+            getattr(self, hist_name).observe(seconds)
+
+    def record_batch(self, n_real: int, bucket: int,
+                     execute_s: float) -> None:
+        with self._lock:
+            self._counters["batches_executed"] = \
+                self._counters.get("batches_executed", 0) + 1
+            self._counters["batch_rows_real"] = \
+                self._counters.get("batch_rows_real", 0) + n_real
+            self._counters["batch_rows_padded"] = \
+                self._counters.get("batch_rows_padded", 0) + bucket
+            self.execute.observe(execute_s)
+
+    def counter(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    # ------------------------------------------------------------- reporting
+    def batch_occupancy(self) -> Optional[float]:
+        """Mean fraction of bucket rows carrying real requests — the
+        padding waste signal (1.0 = every executed row was real work)."""
+        with self._lock:
+            padded = self._counters.get("batch_rows_padded", 0)
+            real = self._counters.get("batch_rows_real", 0)
+        return real / padded if padded else None
+
+    def compile_cache_hit_rate(self) -> Optional[float]:
+        with self._lock:
+            h = self._counters.get("compile_cache_hits", 0)
+            m = self._counters.get("compile_cache_misses", 0)
+        return h / (h + m) if (h + m) else None
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = {"queue_wait": self.queue_wait.snapshot(),
+                     "execute": self.execute.snapshot(),
+                     "e2e": self.e2e.snapshot()}
+        return {"counters": counters, "gauges": gauges,
+                "latency": hists,
+                "batch_occupancy": self.batch_occupancy(),
+                "compile_cache_hit_rate": self.compile_cache_hit_rate()}
+
+    def export(self, db=None, key: str = "serving",
+               sub_key: str = "engine", persist: bool = True):
+        """Record the snapshot into the persistent PerfDB (the same store
+        runtime profiling uses), appended to a bounded history list."""
+        if db is None:
+            from easydist_tpu.runtime.perfdb import PerfDB
+
+            db = PerfDB()
+        hist: List = db.get_op_perf(key, sub_key) or []
+        hist = (hist + [self.snapshot()])[-32:]
+        db.record_op_perf(key, sub_key, hist)
+        if persist:
+            try:
+                db.persist()
+            except Exception:  # metrics export must never fail serving
+                pass
+        return db

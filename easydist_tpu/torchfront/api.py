@@ -486,7 +486,7 @@ def make_torch_pp_train_step(module, example_args, loss_fn: Callable,
                              lr: Optional[float] = None,
                              optimizer: str = "adam",
                              schedule: str = "gpipe", tp_axes=None,
-                             train: bool = False):
+                             train: bool = False, pp_axis: str = "pp"):
     """Pipeline-parallel training for a torch module — the torch frontend
     entry to the hybrid auto-PP x SPMD compile (reference:
     easydist/torch/experimental/pp/api.py:13-105, where per-rank processes
@@ -506,6 +506,9 @@ def make_torch_pp_train_step(module, example_args, loss_fn: Callable,
     optimizer: 'adam' or 'sgd' (the pp path runs its optimizer on the
     packed stage rows; torch.optim instances with per-group
     hyperparameters do not map onto that flat representation).
+    pp_axis: name of the mesh axis stages are laid out over (default
+    'pp'); every other mesh axis is a batch sibling unless listed in
+    tp_axes.
     """
     if not isinstance(optimizer, str):
         raise NotImplementedError(
@@ -513,12 +516,29 @@ def make_torch_pp_train_step(module, example_args, loss_fn: Callable,
             "pipeline optimizer runs on packed flat stage rows, which "
             "per-parameter-group hyperparameters cannot address; pass "
             "optimizer='adam'/'sgd' + lr=")
+    # validate the mesh axes up front (ADVICE r5 #5): a pipeline axis under
+    # another name used to fail only later in _build's mesh check, AFTER
+    # the batch-divisibility message had been computed with a wrong
+    # sibling count
+    if pp_axis not in mesh.axis_names:
+        raise ValueError(
+            f"pp_axis {pp_axis!r} is not a mesh axis (mesh has "
+            f"{tuple(mesh.axis_names)}); pass pp_axis= matching your "
+            f"mesh's pipeline axis name")
+    for a in (tp_axes or ()):
+        if a not in mesh.axis_names:
+            raise ValueError(
+                f"tp_axes entry {a!r} is not a mesh axis (mesh has "
+                f"{tuple(mesh.axis_names)})")
+        if a == pp_axis:
+            raise ValueError(
+                f"tp_axes entry {a!r} collides with pp_axis {pp_axis!r}")
     # torch.export bakes concrete sizes into view/reshape params, and the
     # pipeline replays stages at BATCH-LOCAL microbatch shape — so the
     # module must be exported at exactly that shape
     M = n_microbatches or pp_stages * 2
     batch_axes = [a for a in mesh.axis_names
-                  if a != "pp" and a not in (tp_axes or ())]
+                  if a != pp_axis and a not in (tp_axes or ())]
     import math as _math
 
     n_batch = _math.prod(int(mesh.shape[a]) for a in batch_axes)
@@ -582,5 +602,5 @@ def make_torch_pp_train_step(module, example_args, loss_fn: Callable,
     compiled = easydist_compile(loss, mesh=mesh, pp_stages=pp_stages,
                                 n_microbatches=M, lr=lr,
                                 optimizer=optimizer, schedule=schedule,
-                                tp_axes=tp_axes)
+                                tp_axes=tp_axes, pp_axis=pp_axis)
     return compiled, params0

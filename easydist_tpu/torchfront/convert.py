@@ -931,34 +931,44 @@ def torch_module_to_jax(module, example_args, train: bool = False):
     fn.aten_ops = frozenset(str(n.target) for n in node_list
                             if n.op == "call_function")
 
-    def _is_stochastic(n):
-        t = str(n.target)
-        if "dropout" in t:
-            pval = n.args[1] if len(n.args) > 1 else 0.0
-            # dropout(x, p, train): train=False is eval-frozen — fully
-            # deterministic regardless of p (r5 review #1)
-            if len(n.args) > 2 and n.args[2] is False:
-                return False
-        elif "scaled_dot_product_attention" in t:
-            # (q, k, v, attn_mask=None, dropout_p=0.0, ...)
-            pval = n.kwargs.get(
-                "dropout_p", n.args[4] if len(n.args) > 4 else 0.0)
-        else:
-            return False
-        # a non-literal p (traced tensor) is conservatively stochastic
-        return not isinstance(pval, (int, float)) or pval > 0.0
-
     # ops that would draw randomness at runtime (dropout with p>0,
     # sdpa with dropout_p>0) — the pp path must reject these, and a
     # name-substring check misses sdpa's argument-carried dropout
     fn.stochastic_ops = frozenset(
         str(n.target) for n in node_list
-        if n.op == "call_function" and _is_stochastic(n))
+        if n.op == "call_function" and _node_is_stochastic(n))
     # buffers the module MUTATES (batch-norm running stats) vs constant
     # buffers (causal masks etc) — only the former block pipelining
     fn.mutated_buffer_names = frozenset(mutated.values()) if train \
         else frozenset()
     return fn, params
+
+
+def _node_is_stochastic(n):
+    """Would this exported-graph node draw randomness at runtime?
+
+    dropout(x, p, train) and sdpa(..., dropout_p=...) may carry p/train in
+    EITHER positional args or kwargs depending on how the export
+    normalized the call — reading only positionals would misclassify a
+    kwargs-carrying dropout as deterministic and let the pp path silently
+    train with a frozen step-invariant rng (ADVICE r5 #4)."""
+    t = str(n.target)
+    if "dropout" in t:
+        pval = n.kwargs.get("p", n.args[1] if len(n.args) > 1 else 0.0)
+        # dropout(x, p, train): train=False is eval-frozen — fully
+        # deterministic regardless of p (r5 review #1)
+        train_flag = n.kwargs.get(
+            "train", n.args[2] if len(n.args) > 2 else None)
+        if train_flag is False:
+            return False
+    elif "scaled_dot_product_attention" in t:
+        # (q, k, v, attn_mask=None, dropout_p=0.0, ...)
+        pval = n.kwargs.get(
+            "dropout_p", n.args[4] if len(n.args) > 4 else 0.0)
+    else:
+        return False
+    # a non-literal p (traced tensor) is conservatively stochastic
+    return not isinstance(pval, (int, float)) or pval > 0.0
 
 
 @register_aten("aten.flatten.using_ints")

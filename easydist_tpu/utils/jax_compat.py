@@ -1,0 +1,42 @@
+"""Version-portability shims for the jax API surface this codebase uses.
+
+The code targets the modern spellings (`jax.shard_map` with `check_vma=`,
+pallas `CompilerParams`); older jaxlibs (<= 0.4.x, still common in
+containers) only ship the experimental spellings (`jax.experimental.
+shard_map.shard_map` with `check_rep=`, `TPUCompilerParams`).  Every call
+site imports from here and keeps writing the modern form.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+try:  # jax >= 0.6: top-level export
+    from jax import shard_map as _shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+    @functools.wraps(_shard_map)
+    def shard_map(f, *args, **kwargs):
+        # pre-rename jax: the replication-check knob is `check_rep`
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        if "axis_names" in kwargs:
+            # modern `axis_names` lists the MANUAL axes; the old API takes
+            # the complement as `auto` (axes left to GSPMD)
+            manual = frozenset(kwargs.pop("axis_names"))
+            mesh = kwargs.get("mesh", args[0] if args else None)
+            kwargs["auto"] = frozenset(mesh.axis_names) - manual
+        return _shard_map(f, *args, **kwargs)
+
+
+def tpu_compiler_params(pltpu_module, **kwargs):
+    """pltpu.CompilerParams(**kwargs), falling back to the pre-rename
+    TPUCompilerParams class on older pallas."""
+    cls = getattr(pltpu_module, "CompilerParams", None) \
+        or getattr(pltpu_module, "TPUCompilerParams")
+    return cls(**kwargs)

@@ -1,0 +1,376 @@
+"""Unit tests for the serving layer's pure core: bucket selection, padding
+round-trip, pack/scatter correctness, admission policies (backpressure,
+deadlines, retry, OOM degradation) — all CPU, no easydist compile."""
+
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from easydist_tpu.serve import (DeadlineExceededError, EngineStoppedError,
+                                LatencyHistogram, QueueFullError, Request,
+                                RequestQueue, RequestTooLargeError,
+                                ServeConfig, ServeEngine, ServeMetrics,
+                                pack_requests, retry_transient,
+                                scatter_results, select_bucket)
+from easydist_tpu.serve.admission import is_oom_error, is_transient_error
+
+
+# ------------------------------------------------------------ bucket select
+
+def test_select_bucket_smallest_fitting():
+    assert select_bucket(1, (2, 4, 8)) == 2
+    assert select_bucket(3, (2, 4, 8)) == 4
+    assert select_bucket(4, (2, 4, 8)) == 4
+    assert select_bucket(8, (8, 4, 2)) == 8  # order-insensitive
+
+
+def test_select_bucket_overflow_is_none():
+    assert select_bucket(9, (2, 4, 8)) is None
+
+
+# ---------------------------------------------------------- pack round-trip
+
+def _reqs(lengths, dtype=np.float32):
+    return [Request(args=(np.arange(n, dtype=dtype),)) for n in lengths]
+
+
+def test_pack_pads_seq_and_batch():
+    reqs = _reqs([3, 5, 2])
+    batched, meta = pack_requests(reqs, (4, 8), (4, 8), pad_value=0)
+    (x,) = batched
+    assert x.shape == (4, 8)  # batch 3 -> bucket 4, max seq 5 -> bucket 8
+    assert meta.n_real == 3 and meta.batch_bucket == 4
+    assert meta.padded_lens == (8,)
+    np.testing.assert_array_equal(x[0, :3], np.arange(3))
+    assert (x[0, 3:] == 0).all()  # seq padding is pad_value
+    np.testing.assert_array_equal(x[3], x[2])  # batch pad repeats last row
+
+
+def test_pack_scatter_round_trip():
+    lengths = [3, 5, 2, 7]
+    reqs = _reqs(lengths)
+    batched, meta = pack_requests(reqs, (4,), (8,), pad_value=0)
+    outs = scatter_results(batched[0] * 2.0, meta)
+    for n, o in zip(lengths, outs):
+        assert o.shape == (n,)
+        np.testing.assert_array_equal(o, np.arange(n) * 2.0)
+
+
+def test_scatter_without_unpad_keeps_bucket_shape():
+    reqs = _reqs([3, 5])
+    batched, meta = pack_requests(reqs, (2,), (8,))
+    outs = scatter_results(batched[0], meta, unpad_outputs=False)
+    assert all(o.shape == (8,) for o in outs)
+
+
+def test_pack_seq_overflow_raises():
+    with pytest.raises(RequestTooLargeError):
+        pack_requests(_reqs([9]), (4,), (4, 8))
+
+
+def test_pack_batch_overflow_raises():
+    with pytest.raises(RequestTooLargeError):
+        pack_requests(_reqs([1] * 5), (2, 4), (8,))
+
+
+def test_pack_heterogeneous_without_seq_buckets_raises():
+    with pytest.raises(ValueError, match="heterogeneous"):
+        pack_requests(_reqs([3, 5]), (2,), None)
+
+
+def test_pack_homogeneous_without_seq_buckets_ok():
+    batched, meta = pack_requests(_reqs([4, 4]), (2,), None)
+    assert batched[0].shape == (2, 4)
+    assert meta.padded_lens == (None,)
+    outs = scatter_results(batched[0], meta)
+    assert outs[0].shape == (4,)  # nothing to unpad
+
+
+def test_pack_scalar_arg_shared_and_mismatch_rejected():
+    reqs = [Request(args=(np.arange(4, dtype=np.float32), 7)),
+            Request(args=(np.arange(2, dtype=np.float32), 7))]
+    batched, meta = pack_requests(reqs, (2,), (4,))
+    assert batched[1] == 7  # passed through unbatched
+    reqs[1] = Request(args=(np.arange(2, dtype=np.float32), 8))
+    with pytest.raises(ValueError, match="scalar arg"):
+        pack_requests(reqs, (2,), (4,))
+
+
+def test_shape_class_separates_incompatible_requests():
+    a = Request(args=(np.zeros((3, 4), np.float32),))
+    b = Request(args=(np.zeros((5, 4), np.float32),))
+    c = Request(args=(np.zeros((3, 6), np.float32),))
+    assert a.shape_class() == b.shape_class()  # same trailing dims
+    assert a.shape_class() != c.shape_class()
+
+
+# ------------------------------------------------------------------- queue
+
+def test_queue_put_reports_capacity():
+    q = RequestQueue(max_depth=2)
+    assert q.put(Request(args=())) and q.put(Request(args=()))
+    assert not q.put(Request(args=()))
+    assert q.depth() == 2
+
+
+def test_queue_drain_collects_up_to_max():
+    q = RequestQueue(max_depth=8)
+    for _ in range(5):
+        q.put(Request(args=()))
+    stop = threading.Event()
+    got = q.drain(3, max_wait_s=0.01, stop=stop)
+    assert len(got) == 3 and q.depth() == 2
+
+
+# -------------------------------------------------------------- admission
+
+def test_engine_backpressure_rejects_when_full():
+    eng = ServeEngine(lambda x: x, ServeConfig(
+        batch_buckets=(2,), seq_buckets=(4,), max_queue=2), compile=False)
+    # batcher NOT started: queue fills deterministically
+    eng.submit(np.zeros(2, np.float32))
+    eng.submit(np.zeros(2, np.float32))
+    with pytest.raises(QueueFullError):
+        eng.submit(np.zeros(2, np.float32))
+    assert eng.metrics.counter("requests_rejected") == 1
+    eng.stop()  # pending requests surface EngineStoppedError
+
+
+def test_engine_rejects_oversized_at_submit():
+    eng = ServeEngine(lambda x: x, ServeConfig(
+        batch_buckets=(2,), seq_buckets=(4,)), compile=False)
+    with pytest.raises(RequestTooLargeError):
+        eng.submit(np.zeros(5, np.float32))
+
+
+def test_stop_fails_pending_requests():
+    eng = ServeEngine(lambda x: x, ServeConfig(
+        batch_buckets=(2,), seq_buckets=(4,)), compile=False)
+    fut = eng.submit(np.zeros(2, np.float32))
+    eng.stop()
+    with pytest.raises(EngineStoppedError):
+        fut.result(timeout=1)
+
+
+def test_deadline_expiry_surfaces_timeout_not_hang():
+    eng = ServeEngine(lambda x: x, ServeConfig(
+        batch_buckets=(2,), seq_buckets=(4,), max_wait_ms=1.0),
+        compile=False)
+    # submit BEFORE the batcher runs, with an already-tiny deadline
+    fut = eng.submit(np.zeros(2, np.float32), deadline_ms=1.0)
+    time.sleep(0.05)  # let it expire while queued
+    with eng:
+        with pytest.raises(DeadlineExceededError):
+            fut.result(timeout=2)
+    assert eng.metrics.counter("requests_timed_out") == 1
+
+
+def test_default_deadline_from_config():
+    eng = ServeEngine(lambda x: x, ServeConfig(
+        batch_buckets=(2,), seq_buckets=(4,), default_deadline_ms=1.0),
+        compile=False)
+    fut = eng.submit(np.zeros(2, np.float32))
+    time.sleep(0.05)
+    with eng:
+        with pytest.raises(DeadlineExceededError):
+            fut.result(timeout=2)
+
+
+# ------------------------------------------------------------------ retry
+
+def test_retry_transient_retries_then_succeeds():
+    calls = {"n": 0}
+    sleeps = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("collective UNAVAILABLE: transient link flap")
+        return 42
+
+    out = retry_transient(flaky, max_retries=3, backoff_s=0.01,
+                          sleep=sleeps.append)
+    assert out == 42 and calls["n"] == 3
+    assert sleeps == [0.01, 0.02]  # exponential backoff
+
+
+def test_retry_transient_gives_up_after_max():
+    def always():
+        raise RuntimeError("UNAVAILABLE forever")
+
+    with pytest.raises(RuntimeError):
+        retry_transient(always, max_retries=2, backoff_s=0,
+                        sleep=lambda _: None)
+
+
+def test_retry_does_not_retry_programming_errors():
+    calls = {"n": 0}
+
+    def broken():
+        calls["n"] += 1
+        raise ValueError("bad shapes")
+
+    with pytest.raises(ValueError):
+        retry_transient(broken, max_retries=5, backoff_s=0,
+                        sleep=lambda _: None)
+    assert calls["n"] == 1
+
+
+def test_error_classification():
+    assert is_oom_error(RuntimeError("RESOURCE_EXHAUSTED: out of memory"))
+    assert not is_transient_error(RuntimeError("RESOURCE_EXHAUSTED"))
+    assert is_transient_error(RuntimeError("server UNAVAILABLE"))
+    assert not is_transient_error(ValueError("UNAVAILABLE"))  # typed out
+
+
+def test_engine_retries_transient_batch_failures():
+    calls = {"n": 0}
+
+    def flaky(x):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("stream ABORTED (transient)")
+        return x + 1
+
+    eng = ServeEngine(flaky, ServeConfig(
+        batch_buckets=(2,), seq_buckets=(4,), max_wait_ms=1.0,
+        retry_backoff_ms=0.1), compile=False)
+    with eng:
+        out = eng.infer(np.zeros(4, np.float32), timeout=5)
+    np.testing.assert_array_equal(out, np.ones(4))
+    assert calls["n"] == 2
+    assert eng.metrics.counter("transient_retries") == 1
+
+
+# ------------------------------------------------------- OOM degradation
+
+def test_oom_degrades_to_smaller_bucket():
+    seen_batches = []
+
+    def fn(x):
+        seen_batches.append(x.shape[0])
+        if x.shape[0] >= 4:
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory while "
+                               "allocating on device")
+        return x * 3
+
+    eng = ServeEngine(fn, ServeConfig(
+        batch_buckets=(2, 4), seq_buckets=(4,), max_wait_ms=20.0),
+        compile=False)
+    with eng:
+        futs = [eng.submit(np.full(4, i, np.float32)) for i in range(4)]
+        outs = [f.result(timeout=10) for f in futs]
+    for i, o in enumerate(outs):
+        np.testing.assert_array_equal(o, np.full(4, i * 3.0))
+    assert eng.stats()["disabled_batch_buckets"] == [4]
+    assert eng.metrics.counter("oom_degradations") == 1
+    # one failed bucket-4 run, then two bucket-2 runs
+    assert seen_batches[0] == 4 and sorted(seen_batches[1:]) == [2, 2]
+
+
+def test_oom_with_no_smaller_bucket_fails_requests():
+    def fn(x):
+        raise RuntimeError("RESOURCE_EXHAUSTED")
+
+    eng = ServeEngine(fn, ServeConfig(
+        batch_buckets=(2,), seq_buckets=(4,), max_wait_ms=1.0),
+        compile=False)
+    with eng:
+        fut = eng.submit(np.zeros(2, np.float32))
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            fut.result(timeout=5)
+    assert eng.metrics.counter("requests_failed") == 1
+
+
+# ------------------------------------------------------ concurrent submits
+
+def test_concurrent_submits_scatter_correctly():
+    def fn(x):
+        return x * 2.0
+
+    eng = ServeEngine(fn, ServeConfig(
+        batch_buckets=(2, 4, 8), seq_buckets=(8, 16), max_wait_ms=2.0,
+        max_queue=256), compile=False)
+    results = {}
+    errors = []
+
+    def client(cid):
+        rng = np.random.RandomState(cid)
+        try:
+            for k in range(10):
+                n = int(rng.randint(1, 17))
+                x = rng.rand(n).astype(np.float32)
+                out = eng.infer(x, timeout=30)
+                np.testing.assert_array_equal(out, x * 2.0)
+            results[cid] = True
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append((cid, e))
+
+    with eng:
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+    assert not errors
+    assert len(results) == 8
+    assert eng.metrics.counter("requests_completed") == 80
+    occ = eng.metrics.batch_occupancy()
+    assert occ is not None and 0.0 < occ <= 1.0
+
+
+# ----------------------------------------------------------------- metrics
+
+def test_latency_histogram_percentiles():
+    h = LatencyHistogram()
+    for v in [0.001] * 90 + [0.5] * 10:
+        h.observe(v)
+    assert h.total == 100
+    assert h.percentile(50) <= 0.002  # bucket upper bound containing 1ms
+    assert h.percentile(99) >= 0.5
+    snap = h.snapshot()
+    assert snap["count"] == 100 and snap["p99_s"] >= snap["p50_s"]
+
+
+def test_metrics_export_lands_in_perfdb(tmp_path):
+    from easydist_tpu.runtime.perfdb import PerfDB
+
+    m = ServeMetrics()
+    m.inc("requests_completed", 5)
+    m.record_batch(n_real=3, bucket=4, execute_s=0.01)
+    db = PerfDB(path=str(tmp_path / "perf.db"))
+    m.export(db=db, sub_key="unit")
+    hist = db.get_op_perf("serving", "unit")
+    assert len(hist) == 1
+    assert hist[0]["counters"]["requests_completed"] == 5
+    assert hist[0]["batch_occupancy"] == 0.75
+    # exports append into a bounded history
+    m.export(db=db, sub_key="unit")
+    assert len(db.get_op_perf("serving", "unit")) == 2
+    # and the file round-trips
+    db2 = PerfDB(path=str(tmp_path / "perf.db"))
+    assert len(db2.get_op_perf("serving", "unit")) == 2
+
+
+def test_serving_history_readback(tmp_path, monkeypatch):
+    """Engine export -> runtime.serving_history round-trip through the
+    same PerfDB store step-time history uses."""
+    from easydist_tpu import config as edconfig
+    from easydist_tpu.runtime import serving_history
+
+    monkeypatch.setattr(edconfig, "prof_db_path",
+                        str(tmp_path / "perf.db"))
+    eng = ServeEngine(lambda x: x + 1, ServeConfig(
+        batch_buckets=(2,), seq_buckets=(4,), max_wait_ms=1.0),
+        compile=False)
+    with eng:
+        eng.infer(np.zeros(3, np.float32), timeout=10)
+        eng.export_metrics(sub_key="roundtrip")
+    hist = serving_history("roundtrip")
+    assert len(hist) == 1
+    assert hist[0]["counters"]["requests_completed"] == 1
+    assert hist[0]["batch_occupancy"] == 0.5

@@ -472,3 +472,50 @@ def test_sdpa_dropout_draws_randomness(cpu_devices):
     assert not np.allclose(np.asarray(o1), np.asarray(o2)), \
         "different rngs must give different attention dropout masks"
     np.testing.assert_allclose(np.asarray(o1), np.asarray(o1b))
+
+
+class _StubNode:
+    """Minimal fx-node stand-in for classifying stochastic ops."""
+
+    def __init__(self, target, args=(), kwargs=None):
+        self.target = target
+        self.args = args
+        self.kwargs = kwargs or {}
+
+
+def test_node_is_stochastic_reads_kwargs():
+    """ADVICE r5 #4 regression: a dropout node carrying p (and the train
+    flag) in kwargs must classify exactly like the positional form — a
+    kwargs-carrying active dropout misread as deterministic would let the
+    pp path silently train with a frozen step-invariant rng."""
+    from easydist_tpu.torchfront.convert import _node_is_stochastic
+
+    x = object()
+    # positional form (unchanged behavior)
+    assert _node_is_stochastic(_StubNode("aten.dropout.default",
+                                         (x, 0.5, True)))
+    assert not _node_is_stochastic(_StubNode("aten.dropout.default",
+                                             (x, 0.5, False)))
+    assert not _node_is_stochastic(_StubNode("aten.dropout.default",
+                                             (x, 0.0, True)))
+    # kwargs-carrying forms (the previously-misclassified shapes)
+    assert _node_is_stochastic(_StubNode("aten.dropout.default", (x,),
+                                         {"p": 0.5, "train": True}))
+    assert not _node_is_stochastic(_StubNode("aten.dropout.default", (x,),
+                                             {"p": 0.5, "train": False}))
+    assert not _node_is_stochastic(_StubNode("aten.dropout.default", (x,),
+                                             {"p": 0.0, "train": True}))
+    # mixed: positional p, kwargs train flag
+    assert not _node_is_stochastic(_StubNode("aten.dropout.default",
+                                             (x, 0.5), {"train": False}))
+    # a non-literal (traced) p stays conservatively stochastic
+    assert _node_is_stochastic(_StubNode("aten.dropout.default", (x,),
+                                         {"p": object()}))
+    # sdpa unchanged: dropout_p via kwargs or positional
+    assert _node_is_stochastic(_StubNode(
+        "aten.scaled_dot_product_attention.default", (x, x, x),
+        {"dropout_p": 0.1}))
+    assert not _node_is_stochastic(_StubNode(
+        "aten.scaled_dot_product_attention.default", (x, x, x)))
+    # non-stochastic ops never match
+    assert not _node_is_stochastic(_StubNode("aten.mm.default", (x, x)))

@@ -223,3 +223,48 @@ def test_pp_rejects_buffered_modules(cpu_devices):
         make_torch_pp_train_step(wrapper, (x,), lambda o, t: o.sum(),
                                  mesh, pp_stages=4, n_microbatches=2,
                                  train=True)
+
+
+def test_pp_axis_validated_up_front(cpu_devices):
+    """ADVICE r5 #5: a mesh whose pipeline axis has another name must fail
+    immediately with a precise error, not deep inside _build — and the
+    batch-sibling count must follow pp_axis, not a hardcoded 'pp'."""
+    from easydist_tpu.torchfront import make_torch_pp_train_step
+
+    model, wrapper = _tiny_gpt2(seed=7)
+    ids = torch.randint(0, 128, (8, 16))
+    mesh = make_device_mesh((4, 2), ("pipe", "dp"))
+    with pytest.raises(ValueError, match="pp_axis 'pp' is not a mesh axis"):
+        make_torch_pp_train_step(wrapper, (ids,), _xent, mesh,
+                                 pp_stages=4, n_microbatches=2, train=True)
+    # unknown tp axis and pp/tp collision are rejected just as early
+    with pytest.raises(ValueError, match="tp_axes entry 'tp'"):
+        make_torch_pp_train_step(wrapper, (ids,), _xent, mesh,
+                                 pp_stages=4, n_microbatches=2, train=True,
+                                 pp_axis="pipe", tp_axes=("tp",))
+    with pytest.raises(ValueError, match="collides with pp_axis"):
+        make_torch_pp_train_step(wrapper, (ids,), _xent, mesh,
+                                 pp_stages=4, n_microbatches=2, train=True,
+                                 pp_axis="pipe", tp_axes=("pipe",))
+
+
+def test_pp_axis_renamed_builds_and_sizes_siblings(cpu_devices):
+    """pp_axis='pipe' threads through to the hybrid compile, and the
+    batch-divisibility check counts siblings from the OTHER axes."""
+    from easydist_tpu.torchfront import make_torch_pp_train_step
+
+    model, wrapper = _tiny_gpt2(seed=7)
+    ids = torch.randint(0, 128, (8, 16))
+    mesh = make_device_mesh((4, 2), ("pipe", "dp"))
+    compiled, params0 = make_torch_pp_train_step(
+        wrapper, (ids,), _xent, mesh, pp_stages=4, n_microbatches=2,
+        lr=1e-3, train=True, pp_axis="pipe")
+    assert compiled.pp_axis == "pipe"
+    assert params0  # export happened at microbatch-local shape
+    # batch dim 6 is not divisible by M * n_dp = 2 * 2: the error message
+    # must be computed with the renamed axis's sibling count
+    bad = torch.randint(0, 128, (6, 16))
+    with pytest.raises(ValueError, match=r"2\*2"):
+        make_torch_pp_train_step(wrapper, (bad,), _xent, mesh,
+                                 pp_stages=4, n_microbatches=2,
+                                 lr=1e-3, train=True, pp_axis="pipe")
