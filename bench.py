@@ -331,9 +331,12 @@ def child_main():
                 log(f"# flash variant skipped: {type(e).__name__}: {e}")
         log(f"# benching attention={variant}")
 
+        t_compile = time.perf_counter()
         compiled = easydist_compile(step, mesh=mesh)
         compiled(fresh(), tokens, targets)  # trigger compile outside timing
-        log("# easydist compile done")
+        compile_s = time.perf_counter() - t_compile
+        result["compile_s"] = round(compile_s, 2)
+        log(f"# easydist compile done in {compile_s:.1f}s")
 
         # model FLOPs per step from XLA's own cost analysis (for MFU)
         flops_per_step = None
@@ -516,9 +519,102 @@ def serve_main():
     print(json.dumps(result), flush=True)
 
 
+def comm_main():
+    """Gradient-collective scenario (`--comm`): DDP gradient sync bytes and
+    step time, fp32 vs quantized+bucketed (easydist_tpu.comm, docs/COMM.md).
+
+    Runs on a forced 8-device virtual CPU mesh so the collective PROGRAM
+    (launch count, wire-byte accounting, parity) is exercised exactly as on
+    an 8-chip slice; step-time deltas on CPU are indicative only — the byte
+    and launch counters are the durable evidence and are also exported to
+    the runtime PerfDB under ("comm_stats", "bench_comm")."""
+    result = {"metric": "comm_grad_sync_bytes_per_step", "value": 0.0,
+              "unit": "bytes"}
+    try:
+        os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+            " --xla_force_host_platform_device_count=8"
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import numpy as np
+
+        from easydist_tpu import config as edconfig
+        from easydist_tpu.comm import comm_counters
+        from easydist_tpu.jaxfront import make_device_mesh
+        from easydist_tpu.models import mlp_apply, mlp_init
+        from easydist_tpu.parallel import ddp_step
+
+        mesh = make_device_mesh((8,), ("dp",))
+        sizes = (256, 512, 512, 256)
+        params = mlp_init(jax.random.PRNGKey(0), sizes=sizes)
+        x = jax.random.normal(jax.random.PRNGKey(1), (64, sizes[0]))
+        y = jax.random.normal(jax.random.PRNGKey(2), (64, sizes[-1]))
+
+        def loss_fn(p, xb, yb):
+            return jnp.mean((mlp_apply(p, xb) - yb) ** 2)
+
+        def measure(label):
+            comm_counters.reset()
+            t0 = time.perf_counter()
+            step = ddp_step(loss_fn, mesh, lr=0.05)
+            p, loss = step(params, x, y)  # trace + compile
+            jax.block_until_ready(loss)
+            compile_s = time.perf_counter() - t0
+            snap = comm_counters.snapshot()
+            losses = [float(loss)]
+            n_steps = 20
+            t0 = time.perf_counter()
+            for _ in range(n_steps):
+                p, loss = step(p, x, y)
+            jax.block_until_ready(loss)
+            step_ms = (time.perf_counter() - t0) / n_steps * 1e3
+            losses.append(float(loss))
+            log(f"# {label}: {snap['launches']} launches, "
+                f"{snap['bytes_on_wire']:.0f} wire bytes/step, "
+                f"{step_ms:.2f} ms/step")
+            return snap, step_ms, compile_s, losses
+
+        snap_f, ms_f, comp_f, losses_f = measure("fp32 per-leaf")
+
+        saved = (edconfig.comm_quant_dtype, edconfig.comm_bucket_bytes)
+        try:
+            edconfig.comm_quant_dtype = "int8"
+            edconfig.comm_bucket_bytes = 1 << 20
+            snap_q, ms_q, comp_q, losses_q = measure("int8 bucketed")
+            comm_counters.export_to_perfdb(sub_key="bench_comm")
+        finally:
+            edconfig.comm_quant_dtype, edconfig.comm_bucket_bytes = saved
+
+        parity = max(abs(a - b) for a, b in zip(losses_f, losses_q))
+        result.update({
+            "value": round(snap_q["bytes_on_wire"], 0),
+            "fp32_bytes": round(snap_f["bytes_on_wire"], 0),
+            "compression": round(snap_q["bytes_on_wire"]
+                                 / max(snap_f["bytes_on_wire"], 1.0), 4),
+            "launches_fp32": snap_f["launches"],
+            "launches_quant": snap_q["launches"],
+            "bucketed_leaves": snap_q["bucketed_leaves"],
+            "step_ms_fp32": round(ms_f, 3),
+            "step_ms_quant": round(ms_q, 3),
+            "compile_s": round(comp_q, 2),
+            "parity_loss_delta": round(parity, 6),
+            "n_chips": 8,
+            "device": "host cpu (virtual 8-device mesh)",
+        })
+    except Exception as e:  # always land the JSON line
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        result["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(result), flush=True)
+
+
 if __name__ == "__main__":
     if "--serve" in sys.argv:
         serve_main()
+    elif "--comm" in sys.argv:
+        comm_main()
     elif "--child" in sys.argv:
         child_main()
     else:

@@ -61,6 +61,26 @@ def _all_to_all(x: float, n: int) -> float:
     return factor * x * (n - 1) / (n * n)
 
 
+def comm_compression_ratio() -> float:
+    """Wire-bytes ratio of the configured gradient-collective compression
+    (easydist_tpu.comm): 1.0 when off, 0.5 for bf16, ~0.26 for int8
+    (payload + one f32 scale per `comm_quant_block` elements)."""
+    mode = (edconfig.comm_quant_dtype or "none").lower()
+    if mode == "bf16":
+        return 0.5
+    if mode == "int8":
+        block = max(edconfig.comm_quant_block, 1)
+        return (1.0 + 4.0 / block) / 4.0
+    return 1.0
+
+
+def quantize_compute_cost(var_bytes: float) -> float:
+    """Seconds of quantize/dequantize compute a compressed reduction pays:
+    block-amax + scale + round + dequant is a handful of memory-bound
+    passes over the buffer — priced as 4 HBM round-trips."""
+    return 4.0 * var_bytes / edconfig.hbm_bandwidth
+
+
 def resharding_cost(var_bytes: float, up: Placement, down: Placement,
                     axis: MeshAxisSpec) -> float:
     """Seconds to reshard one tensor from `up` to `down` along `axis`.
@@ -68,11 +88,20 @@ def resharding_cost(var_bytes: float, up: Placement, down: Placement,
     `up` is what the producer emits, `down` what the consumer needs.
     Replicate -> anything is free (slicing is local); the collective cases
     mirror reference solver.py:58-72 plus the reduce_scatter case it lacks.
+
+    When gradient-collective compression is enabled (`comm_quant_dtype`),
+    the REDUCTION edges (P -> R all_reduce, P -> S reduce_scatter — the
+    shapes the comm layer's quantized fences actually emit) are priced at
+    min(exact, compressed): wire bytes scaled by the compression ratio
+    plus the quantize-compute passes.  The ILP then defers/compresses only
+    where the byte saving beats the quantize cost — exactly the
+    solver-priced-compression contract of docs/COMM.md.
     """
     n = axis.size
     if n <= 1:
         return 0.0
 
+    reduction_edge = False
     if up.is_shard():
         if down.is_shard():
             bytes_wire = 0.0 if up.dim == down.dim else _all_to_all(var_bytes, n)
@@ -81,10 +110,12 @@ def resharding_cost(var_bytes: float, up: Placement, down: Placement,
     elif up.is_partial():
         if down.is_shard():
             bytes_wire = _reduce_scatter(var_bytes, n)
+            reduction_edge = True
         elif down.is_partial():
             bytes_wire = 0.0
         else:  # P -> R
             bytes_wire = _all_reduce(var_bytes, n)
+            reduction_edge = True
     else:  # R -> anything is a local slice / no-op
         bytes_wire = 0.0
 
@@ -95,7 +126,15 @@ def resharding_cost(var_bytes: float, up: Placement, down: Placement,
     # bias is bytes-equal to replicating it (reduce_scatter + all_gather ==
     # all_reduce) and the memory tie-break scatters small params across the
     # mesh, emitting dozens of sub-KB collectives that cost pure latency.
-    return axis.resolved_latency() + bytes_wire / axis.resolved_bandwidth()
+    cost = axis.resolved_latency() + bytes_wire / axis.resolved_bandwidth()
+    if reduction_edge and var_bytes >= 4.0 * edconfig.comm_quant_min_numel:
+        ratio = comm_compression_ratio()
+        if ratio < 1.0:
+            compressed = (axis.resolved_latency()
+                          + bytes_wire * ratio / axis.resolved_bandwidth()
+                          + quantize_compute_cost(var_bytes))
+            cost = min(cost, compressed)
+    return cost
 
 
 def placement_bytes(var_bytes: float, p: Placement, axis_size: int) -> float:

@@ -24,6 +24,7 @@ excluded from pools and shapes pre-shrunk before the next 1D solve.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -39,11 +40,16 @@ from .cost_model import MeshAxisSpec, placement_bytes, resharding_cost
 logger = logging.getLogger(__name__)
 
 _op_times_cache: Optional[Tuple[Tuple[str, float], Dict[str, float]]] = None
+# check-then-reload below is a read-mutate race under ServeEngine's
+# concurrent bucket compiles (two threads can interleave the None check and
+# the assignment, one returning a half-installed table); all access to the
+# module global goes through this lock
+_op_times_lock = threading.Lock()
 
 
 def _cached_op_times() -> Dict[str, float]:
     """PerfDB op-time table, reloaded only when the DB file changes (the
-    solver runs once per mesh axis per compile)."""
+    solver runs once per mesh axis per compile).  Thread-safe."""
     global _op_times_cache
     import os
 
@@ -53,11 +59,12 @@ def _cached_op_times() -> Dict[str, float]:
     except OSError:
         return {}
     key = (path, mtime)
-    if _op_times_cache is None or _op_times_cache[0] != key:
-        from easydist_tpu.runtime.op_profile import load_op_times
+    with _op_times_lock:
+        if _op_times_cache is None or _op_times_cache[0] != key:
+            from easydist_tpu.runtime.op_profile import load_op_times
 
-        _op_times_cache = (key, load_op_times())
-    return _op_times_cache[1]
+            _op_times_cache = (key, load_op_times())
+        return _op_times_cache[1]
 
 
 class _Edge:

@@ -1,0 +1,22 @@
+"""Quantized, bucketed gradient collectives (see docs/COMM.md).
+
+Layers:
+  quant.py     block-scaled int8 / bf16 reduction collectives with an exact
+               fp32 fallback (bitwise-identical when disabled)
+  bucketer.py  fuse many small leaf collectives into few fixed-size buckets
+  reduce.py    the grad-path entry points (DDP tree reduce, ZeRO leaf
+               reduce_scatter, partial-region fences)
+  counters.py  trace-time bytes/launch accounting, exported via PerfDB
+"""
+
+from .bucketer import Bucket, bucketed_reduce, pack, plan_buckets, unpack  # noqa: F401
+from .counters import (CommCounters, comm_counters,  # noqa: F401
+                       ring_all_gather_bytes, ring_all_reduce_bytes,
+                       ring_reduce_scatter_bytes)
+from .quant import (bf16_psum, bf16_psum_scatter, comm_enabled,  # noqa: F401
+                    dequantize_blockwise, int8_payload_bytes,
+                    leaf_quantizable, quant_mode, quantize_blockwise,
+                    quantized_psum, quantized_psum_scatter)
+from .reduce import (all_reduce_grad, fence_psum,  # noqa: F401
+                     fence_psum_scatter, reduce_gradients,
+                     reduce_scatter_grad)

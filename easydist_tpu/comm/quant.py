@@ -1,0 +1,206 @@
+"""Block-wise-scaled quantized reduction collectives (EQuARX-style).
+
+EQuARX (arxiv 2506.17615) shows a block-scaled quantized all-reduce inside
+XLA recovers most of the interconnect bandwidth at negligible quality
+loss.  XLA's collective primitives are not user-extensible, so the same
+two-pass scheme is expressed here as a portable collective program over
+`jax.lax` primitives (the 2112.01075 shape: redistribution as collective
+programs a cost model can price):
+
+  pass 1 (reduce-scatter hop)   quantize the full local vector block-wise
+                                (int8 payload + one f32 scale per block),
+                                `all_to_all` so device i receives every
+                                peer's chunk i, dequantize and sum in f32
+                                in fixed peer order -> exact-order shard
+  pass 2 (all-gather hop)       re-quantize the reduced shard, `all_gather`
+                                payload+scales, dequantize everywhere
+
+Wire bytes per device drop from ``2*(n-1)/n * 4B`` to about
+``2*(n-1)/n * (1 + 4/block)B`` per element — ~3.9x at block=256.  Both
+passes round with `jnp.rint` (half-to-even) and reduce in a fixed peer
+order, so results are deterministic across runs and identical on every
+device.  The int8 payload never carries arithmetic on the wire (sums happen
+in f32 after dequantize), so there is no accumulator-overflow regime.
+
+The ``"bf16"`` mode is the degenerate single-pass form: cast, reduce,
+cast back — 2x wire saving, no block scales.
+
+When quantization is disabled (``comm_quant_dtype="none"``) every wrapper
+falls through to the exact `jax.lax` collective, bitwise-identical to the
+emission that predates this subsystem.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from easydist_tpu import config as edconfig
+
+_QMAX_INT8 = 127.0
+_VALID_MODES = ("none", "int8", "bf16")
+
+
+def quant_mode() -> str:
+    """The configured wire dtype, validated ("none" | "int8" | "bf16")."""
+    mode = (edconfig.comm_quant_dtype or "none").lower()
+    if mode not in _VALID_MODES:
+        raise ValueError(
+            f"comm_quant_dtype={edconfig.comm_quant_dtype!r}; expected one "
+            f"of {_VALID_MODES}")
+    return mode
+
+
+def comm_enabled() -> bool:
+    """True when any comm transformation (quantization OR bucketing) is on;
+    False means the grad paths must emit their pre-subsystem programs."""
+    return quant_mode() != "none" or edconfig.comm_bucket_bytes > 0
+
+
+def leaf_quantizable(path: str, numel: int,
+                     mode: Optional[str] = None) -> bool:
+    """Per-leaf opt-out: sensitive leaves (norm scales, biases — anything
+    matching `comm_quant_skip`) and tiny leaves (below
+    `comm_quant_min_numel`, where padding + scale overhead eats the saving)
+    stay at full precision."""
+    mode = quant_mode() if mode is None else mode
+    if mode == "none":
+        return False
+    if numel < edconfig.comm_quant_min_numel:
+        return False
+    pat = edconfig.comm_quant_skip
+    if pat and re.search(pat, path, re.IGNORECASE):
+        return False
+    return True
+
+
+# ------------------------------------------------------------- block scaling
+
+def quantize_blockwise(flat: jax.Array, block: int
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """1-D f32 vector (size % block == 0) -> (int8 payload, f32 per-block
+    scales).  All-zero blocks get scale 1.0 so dequantize is exact."""
+    xb = flat.reshape(-1, block)
+    amax = jnp.max(jnp.abs(xb), axis=1, keepdims=True)
+    scale = jnp.where(amax > 0.0, amax / _QMAX_INT8,
+                      jnp.ones_like(amax))
+    q = jnp.clip(jnp.rint(xb / scale), -_QMAX_INT8, _QMAX_INT8)
+    return q.astype(jnp.int8).reshape(-1), scale.astype(jnp.float32).reshape(-1)
+
+
+def dequantize_blockwise(q: jax.Array, scales: jax.Array,
+                         block: int) -> jax.Array:
+    return (q.astype(jnp.float32).reshape(-1, block)
+            * scales.reshape(-1, 1)).reshape(-1)
+
+
+def _pad_flat(flat: jax.Array, multiple: int) -> Tuple[jax.Array, int]:
+    pad = (-flat.size) % multiple
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat, pad
+
+
+def int8_payload_bytes(numel: int, block: int) -> float:
+    """Wire payload of a block-quantized vector: int8 values + one f32
+    scale per block (padding to the block grid included)."""
+    padded = numel + ((-numel) % block)
+    return padded * 1.0 + (padded // block) * 4.0
+
+
+# ------------------------------------------------------- quantized collectives
+#
+# All of these run INSIDE shard_map over `axis_name` (the dp.py / region
+# emission context).  `axis_size` is static (mesh.shape[axis]).
+
+def quantized_psum(x: jax.Array, axis_name: str, axis_size: int, *,
+                   block: Optional[int] = None,
+                   mean: bool = False) -> jax.Array:
+    """Two-pass block-scaled int8 all-reduce; same shape/dtype as `x`.
+    `mean=True` folds the /n into the reduced shard BEFORE the second
+    quantization pass (better scale utilization than dividing after)."""
+    n = axis_size
+    if n <= 1:
+        return x / n if mean else x
+    block = block or edconfig.comm_quant_block
+    shape, dtype = x.shape, x.dtype
+    flat = x.astype(jnp.float32).reshape(-1)
+    numel = flat.size
+    flat, _ = _pad_flat(flat, n * block)
+    chunk = flat.size // n
+
+    # pass 1: exchange quantized chunks; device i ends with reduced chunk i
+    q, s = quantize_blockwise(flat, block)
+    q = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                           tiled=True)
+    s = jax.lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0,
+                           tiled=True)
+    contrib = dequantize_blockwise(q, s, block).reshape(n, chunk)
+    reduced = jnp.sum(contrib, axis=0)  # fixed peer order: deterministic
+    if mean:
+        reduced = reduced / n
+
+    # pass 2: gather re-quantized shards back to every device
+    q2, s2 = quantize_blockwise(reduced, block)
+    q2 = jax.lax.all_gather(q2, axis_name, axis=0, tiled=True)
+    s2 = jax.lax.all_gather(s2, axis_name, axis=0, tiled=True)
+    out = dequantize_blockwise(q2, s2, block)
+    return out[:numel].reshape(shape).astype(dtype)
+
+
+def quantized_psum_scatter(x: jax.Array, axis_name: str, axis_size: int, *,
+                           scatter_dim: int = 0,
+                           block: Optional[int] = None,
+                           mean: bool = False) -> jax.Array:
+    """Block-scaled int8 reduce_scatter (tiled): device i gets the reduced
+    slice i along `scatter_dim` — the single-hop half of quantized_psum."""
+    n = axis_size
+    if n <= 1:
+        return x / n if mean else x
+    block = block or edconfig.comm_quant_block
+    dtype = x.dtype
+    if scatter_dim != 0:
+        x = jnp.moveaxis(x, scatter_dim, 0)
+    assert x.shape[0] % n == 0, (x.shape, n)
+    shard_shape = (x.shape[0] // n,) + x.shape[1:]
+    parts = x.astype(jnp.float32).reshape(n, -1)  # row j = slice j
+    cols = parts.shape[1]
+    pad = (-cols) % block
+    if pad:
+        parts = jnp.pad(parts, ((0, 0), (0, pad)))
+    # (cols+pad) % block == 0: every quant block lies inside one row, so a
+    # row's scales travel with its payload through the same all_to_all
+    q, s = quantize_blockwise(parts.reshape(-1), block)
+    q = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                           tiled=True)
+    s = jax.lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0,
+                           tiled=True)
+    contrib = dequantize_blockwise(q, s, block).reshape(n, cols + pad)
+    reduced = jnp.sum(contrib, axis=0)
+    if pad:
+        reduced = reduced[:cols]
+    if mean:
+        reduced = reduced / n
+    out = reduced.reshape(shard_shape).astype(dtype)
+    if scatter_dim != 0:
+        out = jnp.moveaxis(out, 0, scatter_dim)
+    return out
+
+
+def bf16_psum(x: jax.Array, axis_name: str, *, mean: bool = False,
+              axis_size: int = 1) -> jax.Array:
+    """Half-width wire: reduce a bf16 cast, cast back."""
+    r = jax.lax.psum(x.astype(jnp.bfloat16), axis_name)
+    r = r.astype(x.dtype)
+    return r / axis_size if mean else r
+
+
+def bf16_psum_scatter(x: jax.Array, axis_name: str, *, scatter_dim: int = 0,
+                      mean: bool = False, axis_size: int = 1) -> jax.Array:
+    r = jax.lax.psum_scatter(x.astype(jnp.bfloat16), axis_name,
+                             scatter_dimension=scatter_dim, tiled=True)
+    r = r.astype(x.dtype)
+    return r / axis_size if mean else r

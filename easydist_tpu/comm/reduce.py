@@ -1,0 +1,193 @@
+"""Gradient-synchronization entry points for the parallel layer.
+
+These are what `parallel/dp.py` (ddp/zero2/zero3) and the auto path's
+partial-region fences call instead of raw `jax.lax` collectives.  Contract:
+
+  * with the subsystem DISABLED (``comm_quant_dtype="none"`` and
+    ``comm_bucket_bytes=0``, the defaults) every function emits exactly the
+    pre-subsystem collective — same primitive, same operands — so compiled
+    programs are bitwise-identical to the historical emission;
+  * with it enabled, leaves are bucketed (fewer launches) and/or
+    block-quantized on the wire (fewer bytes), with per-leaf opt-out for
+    sensitive tensors (``comm_quant_skip``) and exact fp32 for tiny leaves;
+  * every launch is recorded in `comm_counters` at trace time, wire bytes
+    priced with the same ring closed forms as the solver's cost model.
+
+All functions run INSIDE shard_map over `axis_name`.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from easydist_tpu import config as edconfig
+
+from .bucketer import bucketed_reduce
+from .counters import (comm_counters, ring_all_gather_bytes,
+                       ring_all_reduce_bytes, ring_reduce_scatter_bytes)
+from .quant import (bf16_psum, bf16_psum_scatter, comm_enabled,
+                    int8_payload_bytes, leaf_quantizable, quant_mode,
+                    quantized_psum, quantized_psum_scatter)
+
+
+def _record_all_reduce(numel: int, itemsize: int, n: int, mode: str,
+                       quantized: bool, fallback: bool = False,
+                       bucketed_leaves: int = 0) -> None:
+    full = ring_all_reduce_bytes(numel * 4.0, n)
+    if not quantized:
+        wire = ring_all_reduce_bytes(numel * float(itemsize), n)
+    elif mode == "bf16":
+        wire = ring_all_reduce_bytes(numel * 2.0, n)
+    else:  # int8 two-pass: RS hop + AG hop of (payload + scales)
+        payload = int8_payload_bytes(numel, edconfig.comm_quant_block)
+        wire = (ring_reduce_scatter_bytes(payload, n)
+                + ring_all_gather_bytes(payload, n))
+    comm_counters.record(bytes_on_wire=wire, bytes_fp32_equiv=full,
+                         quantized=quantized, fallback=fallback,
+                         bucketed_leaves=bucketed_leaves)
+
+
+def _record_reduce_scatter(numel: int, itemsize: int, n: int, mode: str,
+                           quantized: bool, fallback: bool = False) -> None:
+    full = ring_reduce_scatter_bytes(numel * 4.0, n)
+    if not quantized:
+        wire = ring_reduce_scatter_bytes(numel * float(itemsize), n)
+    elif mode == "bf16":
+        wire = ring_reduce_scatter_bytes(numel * 2.0, n)
+    else:
+        payload = int8_payload_bytes(numel, edconfig.comm_quant_block)
+        wire = ring_reduce_scatter_bytes(payload, n)
+    comm_counters.record(bytes_on_wire=wire, bytes_fp32_equiv=full,
+                         quantized=quantized, fallback=fallback)
+
+
+# --------------------------------------------------------------- tree reduce
+
+def reduce_gradients(grads, axis_name: str, axis_size: int,
+                     op: str = "pmean"):
+    """Synchronize a gradient pytree over `axis_name` (the DDP path).
+
+    Disabled -> one `jax.lax.pmean`/`psum` per leaf, the exact historical
+    program.  Enabled -> leaves are partitioned by quantizability, packed
+    into `comm_bucket_bytes` buckets, and each bucket pays ONE collective
+    (block-scaled int8, bf16, or exact fp32 per its group).
+    """
+    if op not in ("pmean", "psum"):
+        raise ValueError(f"op={op!r}; expected pmean|psum")
+    mean = op == "pmean"
+    n = axis_size
+    mode = quant_mode()
+
+    if not comm_enabled():
+        # exact fp32 fallback: bitwise-identical to the pre-subsystem
+        # tree_map emission (one collective per leaf, no repacking)
+        def red(g):
+            _record_all_reduce(g.size, jnp.dtype(g.dtype).itemsize, n, mode,
+                               quantized=False, fallback=True)
+            return (jax.lax.pmean(g, axis_name) if mean
+                    else jax.lax.psum(g, axis_name))
+
+        return jax.tree_util.tree_map(red, grads)
+
+    leaves_kp, tdef = jax.tree_util.tree_flatten_with_path(grads)
+    paths = [jax.tree_util.keystr(kp) for kp, _ in leaves_kp]
+    leaves = [leaf for _, leaf in leaves_kp]
+    flags = [leaf_quantizable(p, leaf.size, mode)
+             for p, leaf in zip(paths, leaves)]
+
+    def reduce_bucket(flat, bucket):
+        fused = len(bucket.indices)
+        if bucket.quantize and mode == "int8":
+            out = quantized_psum(flat, axis_name, n, mean=mean)
+            _record_all_reduce(flat.size, flat.dtype.itemsize, n, mode,
+                               quantized=True, bucketed_leaves=fused)
+        elif bucket.quantize and mode == "bf16":
+            out = bf16_psum(flat, axis_name, mean=mean, axis_size=n)
+            _record_all_reduce(flat.size, flat.dtype.itemsize, n, mode,
+                               quantized=True, bucketed_leaves=fused)
+        else:
+            out = (jax.lax.pmean(flat, axis_name) if mean
+                   else jax.lax.psum(flat, axis_name))
+            _record_all_reduce(flat.size, flat.dtype.itemsize, n, mode,
+                               quantized=False, bucketed_leaves=fused)
+        return out
+
+    reduced = bucketed_reduce(leaves, flags, edconfig.comm_bucket_bytes,
+                              reduce_bucket)
+    return jax.tree_util.tree_unflatten(tdef, reduced)
+
+
+# --------------------------------------------------------------- leaf reduce
+
+def all_reduce_grad(g, axis_name: str, axis_size: int, *, mean: bool = True,
+                    path: str = ""):
+    """One leaf's all-reduce (the ZeRO replicated-moment path)."""
+    mode = quant_mode()
+    if leaf_quantizable(path, g.size, mode):
+        _record_all_reduce(g.size, g.dtype.itemsize, axis_size, mode,
+                           quantized=True)
+        if mode == "int8":
+            return quantized_psum(g, axis_name, axis_size, mean=mean)
+        return bf16_psum(g, axis_name, mean=mean, axis_size=axis_size)
+    _record_all_reduce(g.size, g.dtype.itemsize, axis_size, mode,
+                       quantized=False, fallback=(mode == "none"))
+    return (jax.lax.pmean(g, axis_name) if mean
+            else jax.lax.psum(g, axis_name))
+
+
+def reduce_scatter_grad(g, axis_name: str, axis_size: int, *,
+                        scatter_dim: int = 0, mean: bool = True,
+                        path: str = ""):
+    """One leaf's reduce_scatter over `scatter_dim` (tiled), the
+    ZeRO-2/3 sharded-grad path.  Returns the local reduced shard."""
+    mode = quant_mode()
+    if leaf_quantizable(path, g.size, mode):
+        _record_reduce_scatter(g.size, g.dtype.itemsize, axis_size, mode,
+                               quantized=True)
+        if mode == "int8":
+            return quantized_psum_scatter(g, axis_name, axis_size,
+                                          scatter_dim=scatter_dim, mean=mean)
+        return bf16_psum_scatter(g, axis_name, scatter_dim=scatter_dim,
+                                 mean=mean, axis_size=axis_size)
+    _record_reduce_scatter(g.size, g.dtype.itemsize, axis_size, mode,
+                           quantized=False, fallback=(mode == "none"))
+    out = jax.lax.psum_scatter(g, axis_name, scatter_dimension=scatter_dim,
+                               tiled=True)
+    return out / axis_size if mean else out
+
+
+# ----------------------------------------------------------- region fences
+
+def fence_psum(val, axis_name: str, axis_size: int):
+    """The deferred-reduction all-reduce at a partial-region fence (auto
+    path).  No leaf path exists here; quantizability gates on size only."""
+    mode = quant_mode()
+    if leaf_quantizable("", val.size, mode):
+        _record_all_reduce(val.size, val.dtype.itemsize, axis_size, mode,
+                           quantized=True)
+        if mode == "int8":
+            return quantized_psum(val, axis_name, axis_size)
+        return bf16_psum(val, axis_name)
+    _record_all_reduce(val.size, val.dtype.itemsize, axis_size, mode,
+                       quantized=False, fallback=(mode == "none"))
+    return jax.lax.psum(val, axis_name)
+
+
+def fence_psum_scatter(val, axis_name: str, axis_size: int,
+                       scatter_dim: int):
+    """The P -> S fence: reduce_scatter at half the all-reduce bytes."""
+    mode = quant_mode()
+    if leaf_quantizable("", val.size, mode):
+        _record_reduce_scatter(val.size, val.dtype.itemsize, axis_size, mode,
+                               quantized=True)
+        if mode == "int8":
+            return quantized_psum_scatter(val, axis_name, axis_size,
+                                          scatter_dim=scatter_dim)
+        return bf16_psum_scatter(val, axis_name, scatter_dim=scatter_dim)
+    _record_reduce_scatter(val.size, val.dtype.itemsize, axis_size, mode,
+                           quantized=False, fallback=(mode == "none"))
+    return jax.lax.psum_scatter(val, axis_name,
+                                scatter_dimension=scatter_dim, tiled=True)

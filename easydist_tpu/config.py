@@ -130,6 +130,31 @@ dcn_latency = _env_float("EASYDIST_DCN_LATENCY", 2.0e-5)
 # HBM bandwidth (bytes/s): prices the compute-redundancy of replicated ops
 # (elementwise ops are memory-bound; v5e ~ 810 GB/s)
 hbm_bandwidth = _env_float("EASYDIST_HBM_BANDWIDTH", 8.1e11)
+
+# ---------------- gradient-collective compression (easydist_tpu.comm) ----
+# wire dtype for gradient reductions: "none" (exact fp32 path, the
+# default — emitted programs stay bitwise-identical to pre-comm behavior)
+# | "int8" (two-pass block-scaled, ~3.9x fewer wire bytes) | "bf16" (cast,
+# 2x).  See docs/COMM.md for the scheme and accuracy guidance.
+comm_quant_dtype = os.environ.get("EASYDIST_COMM_QUANT", "none")
+# elements per scaling block for int8 (one f32 scale per block; larger
+# blocks = less scale overhead, coarser dynamic range)
+comm_quant_block = _env_int("EASYDIST_COMM_QUANT_BLOCK", 256)
+# fuse leaf gradients into buckets of at most this many bytes before
+# reducing (0 = one collective per leaf, the historical emission).  Fewer
+# launches amortize the per-collective alpha and fill the ICI rings.
+comm_bucket_bytes = _env_int("EASYDIST_COMM_BUCKET_BYTES", 0)
+# per-tree opt-out: leaves whose key path matches this regex (case-
+# insensitive) stay at exact fp32 — norm scales/biases are tiny but
+# disproportionately sensitive to quantization noise
+# (the `'b'` alternative catches dict-key paths like "[0]['b']" that
+# jax.tree_util.keystr produces for the toy models' bias leaves)
+comm_quant_skip = os.environ.get(
+    "EASYDIST_COMM_QUANT_SKIP", r"bias|norm|\bln\b|scale|gamma|beta|'b'")
+# leaves below this many elements are never quantized: block padding plus
+# per-block scales would move MORE bytes than fp32, and tiny collectives
+# are alpha-bound anyway (bucket them instead)
+comm_quant_min_numel = _env_int("EASYDIST_COMM_QUANT_MIN_NUMEL", 2048)
 # load measured alpha/beta/HBM values from the PerfDB when present
 # (runtime.calibrate.calibrate() records them on the target hardware)
 auto_calibration = _env_bool("EASYDIST_AUTO_CALIBRATION", True)

@@ -269,7 +269,7 @@ def _compile_cache_key(closed_jaxpr, axis_specs) -> str:
     # schema + cost-model salt: cached strategies are only valid for the
     # solver/cost-model that produced them; a version bump or a tuned
     # bandwidth/latency knob must miss, not silently serve stale plans
-    h.update(("v2|" + "|".join(
+    h.update(("v3|" + "|".join(
         f"{k}={getattr(edconfig, k)}" for k in
         ("ici_bandwidth", "dcn_bandwidth", "ici_latency", "dcn_latency",
          "hbm_bandwidth", "all_to_all_punish_factor",
@@ -277,7 +277,11 @@ def _compile_cache_key(closed_jaxpr, axis_specs) -> str:
          "enable_partial_pools", "enable_auto_remat",
          "coarsen_level", "enable_graph_coarsen", "predict_comm_overlap",
          "comm_overlap_ratio", "allow_repeated_axis_strategy",
-         "solver_backend", "liveness_only_input", "peak_flops"))).encode())
+         "solver_backend", "liveness_only_input", "peak_flops",
+         # comm compression changes reduction-edge prices (cost_model
+         # min(exact, compressed)), so cached strategies are mode-specific
+         "comm_quant_dtype", "comm_quant_block",
+         "comm_quant_min_numel"))).encode())
     names = VarNames()
     for v in closed_jaxpr.jaxpr.invars:
         names.name(v)
@@ -320,14 +324,29 @@ def _strategy_cache_load(key: str):
 def _strategy_cache_store(key: str, per_axis) -> None:
     import os
     import pickle
+    import tempfile
 
     os.makedirs(edconfig.compile_cache_dir, exist_ok=True)
     path = os.path.join(edconfig.compile_cache_dir, f"strategies_{key}.pkl")
+    # write-to-temp + atomic rename: concurrent serve-bucket compiles may
+    # read this file mid-write; os.replace guarantees a reader sees either
+    # the old pickle or the complete new one, never a torn file
+    tmp = None
     try:
-        with open(path, "wb") as f:
+        fd, tmp = tempfile.mkstemp(dir=edconfig.compile_cache_dir,
+                                   prefix=f"strategies_{key}.",
+                                   suffix=".tmp")
+        with os.fdopen(fd, "wb") as f:
             pickle.dump(per_axis, f)
+        os.replace(tmp, path)
+        tmp = None
     except Exception:
         logger.warning("compile cache write failed for %s", path)
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
 
 
 def _dump_strategies(graph, per_axis, axis_names):

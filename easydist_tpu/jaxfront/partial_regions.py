@@ -372,16 +372,21 @@ def emit_region(region: PartialRegion, jaxpr, env, mesh):
                 vals = [vals]
             for var, val in zip(eqn.outvars, vals):
                 local[var] = val
+        from easydist_tpu.comm import fence_psum, fence_psum_scatter
+
         result = []
         for v in outs:
             val = local[v]
             if v in scatter_dim:
                 # P -> S fence: half the wire bytes of the all_reduce,
-                # and the consumer wanted the shard anyway
-                val = jax.lax.psum_scatter(
-                    val, axis, scatter_dimension=scatter_dim[v], tiled=True)
+                # and the consumer wanted the shard anyway.  The comm
+                # wrapper block-quantizes the wire when enabled and is the
+                # exact jax.lax collective when not (docs/COMM.md).
+                val = fence_psum_scatter(val, axis, axis_count,
+                                         scatter_dim=scatter_dim[v])
             elif v in region.fence_partial:
-                val = jax.lax.psum(val, axis)  # THE deferred reduction
+                # THE deferred reduction
+                val = fence_psum(val, axis, axis_count)
             result.append(val)
         return tuple(result)
 

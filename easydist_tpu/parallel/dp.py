@@ -10,6 +10,13 @@ surgery over NCCL ops:
            the local shard, all_gather updated params
   zero3  — fully sharded params AND moments: per-step all_gather of
            params for fw/bw, reduce_scatter grads, shard-local Adam
+
+All gradient reductions route through `easydist_tpu.comm`: with the
+default config the wrappers emit the exact historical collectives
+(bitwise-identical programs); with `comm_quant_dtype`/`comm_bucket_bytes`
+set, gradients travel block-quantized and/or fused into fixed-size
+buckets (docs/COMM.md), with sensitive leaves (`comm_quant_skip`) kept at
+full precision.
 """
 
 from __future__ import annotations
@@ -19,18 +26,26 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+from easydist_tpu import comm
 from easydist_tpu.utils.jax_compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _grad_paths(grads):
+    """keystr paths of the grad tree's leaves, flat order (the
+    comm_quant_skip opt-out matches against these)."""
+    return [jax.tree_util.keystr(kp)
+            for kp, _ in jax.tree_util.tree_flatten_with_path(grads)[0]]
 
 
 def ddp_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2):
     """SGD DDP step: batch sharded over `axis`, grads averaged with psum.
     Returns step(params, batch...) -> (new_params, loss)."""
+    n = mesh.shape[axis]
 
     def local_step(params, *batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
-        grads = jax.tree_util.tree_map(
-            lambda g: jax.lax.pmean(g, axis), grads)
+        grads = comm.reduce_gradients(grads, axis, n, op="pmean")
         loss = jax.lax.pmean(loss, axis)
         new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g,
                                             params, grads)
@@ -107,14 +122,15 @@ def zero3_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2,
             c1 = 1 - b1 ** count.astype(jnp.float32)
             c2 = 1 - b2 ** count.astype(jnp.float32)
             flat_g = jax.tree_util.tree_flatten(grads)[0]
+            g_paths = _grad_paths(grads)
             new_p, new_m, new_v = [], [], []
-            for p_shard, g, m, v, flag in zip(flat_ps, flat_g, flat_mu,
-                                              flat_nu, shard_flags):
+            for p_shard, g, m, v, flag, gpath in zip(flat_ps, flat_g, flat_mu,
+                                                     flat_nu, shard_flags,
+                                                     g_paths):
                 if flag:
-                    g = jax.lax.psum_scatter(g, axis, scatter_dimension=0,
-                                             tiled=True) / n
+                    g = comm.reduce_scatter_grad(g, axis, n, path=gpath)
                 else:
-                    g = jax.lax.pmean(g, axis)
+                    g = comm.all_reduce_grad(g, axis, n, path=gpath)
                 m = b1 * m + (1 - b1) * g
                 v = b2 * v + (1 - b2) * g * g
                 new_p.append(p_shard - lr * (m / c1) / (jnp.sqrt(v / c2) + eps))
@@ -191,11 +207,10 @@ def zero2_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2,
         c1 = 1 - b1 ** count.astype(jnp.float32)
         c2 = 1 - b2 ** count.astype(jnp.float32)
 
-        def update(p, g, m, v):
+        def update(p, g, m, v, gpath):
             if shardable(p):
                 # grads: [d0, ...] -> reduce_scatter -> [d0/n, ...]
-                g_shard = jax.lax.psum_scatter(g, axis, scatter_dimension=0,
-                                               tiled=True) / n
+                g_shard = comm.reduce_scatter_grad(g, axis, n, path=gpath)
                 m, v = m[0], v[0]
                 p_shard = jax.lax.dynamic_slice_in_dim(
                     p, jax.lax.axis_index(axis) * g_shard.shape[0],
@@ -205,7 +220,7 @@ def zero2_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2,
                 p_new = p_shard - lr * (m / c1) / (jnp.sqrt(v / c2) + eps)
                 p_full = jax.lax.all_gather(p_new, axis, axis=0, tiled=True)
                 return p_full, m[None], v[None]
-            g = jax.lax.pmean(g, axis)
+            g = comm.all_reduce_grad(g, axis, n, path=gpath)
             m = b1 * m + (1 - b1) * g
             v = b2 * v + (1 - b2) * g * g
             return p - lr * (m / c1) / (jnp.sqrt(v / c2) + eps), m, v
@@ -214,8 +229,9 @@ def zero2_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2,
         flat_g = jax.tree_util.tree_flatten(grads)[0]
         flat_m = jax.tree_util.tree_flatten(mu)[0]
         flat_v = jax.tree_util.tree_flatten(nu)[0]
-        new = [update(p, g, m, v) for p, g, m, v in
-               zip(flat_p, flat_g, flat_m, flat_v)]
+        g_paths = _grad_paths(grads)
+        new = [update(p, g, m, v, gp) for p, g, m, v, gp in
+               zip(flat_p, flat_g, flat_m, flat_v, g_paths)]
         new_params = jax.tree_util.tree_unflatten(tdef, [t[0] for t in new])
         new_mu = jax.tree_util.tree_unflatten(tdef, [t[1] for t in new])
         new_nu = jax.tree_util.tree_unflatten(tdef, [t[2] for t in new])

@@ -1,0 +1,211 @@
+"""End-to-end DP/ZeRO gradient sync under compression: loss/param parity
+within 1e-2 of the exact fp32 run, byte-counter evidence, opt-out leaves,
+and the auto (solver) path with compression enabled."""
+
+import socket
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from easydist_tpu import config as edconfig
+from easydist_tpu.comm import comm_counters
+from easydist_tpu.jaxfront import make_device_mesh
+from easydist_tpu.models import mlp_apply, mlp_init
+from easydist_tpu.parallel import ddp_step, zero2_step, zero3_step
+
+
+@pytest.fixture(scope="module")
+def mesh_dp(cpu_devices):
+    return make_device_mesh((8,), ("dp",))
+
+
+@pytest.fixture
+def int8_comm(monkeypatch):
+    monkeypatch.setattr(edconfig, "comm_quant_dtype", "int8")
+    monkeypatch.setattr(edconfig, "comm_bucket_bytes", 256 << 10)
+    monkeypatch.setattr(edconfig, "comm_quant_min_numel", 512)
+    comm_counters.reset()
+
+
+def loss_fn(params, x, y):
+    return jnp.mean((mlp_apply(params, x) - y) ** 2)
+
+
+def _data(key=10):
+    ks = jax.random.split(jax.random.PRNGKey(key), 3)
+    params = mlp_init(ks[0], sizes=(32, 64, 32))
+    x = jax.random.normal(ks[1], (64, 32))
+    y = jax.random.normal(ks[2], (64, 32))
+    return params, x, y
+
+
+def _assert_compressed():
+    snap = comm_counters.snapshot()
+    assert snap["quantized_launches"] > 0, snap
+    assert snap["bytes_on_wire"] < snap["bytes_fp32_equiv"], snap
+    return snap
+
+
+@pytest.mark.world_8
+def test_ddp_int8_parity(mesh_dp, int8_comm):
+    params, x, y = _data()
+    step = ddp_step(loss_fn, mesh_dp, lr=0.05)
+    ref_p, losses_q = params, []
+    p = params
+    for _ in range(3):
+        p, l = step(p, x, y)
+        losses_q.append(float(l))
+    snap = _assert_compressed()
+
+    # exact fp32 reference (subsystem disabled)
+    edconfig.comm_quant_dtype = "none"
+    edconfig.comm_bucket_bytes = 0
+    step_f = ddp_step(loss_fn, mesh_dp, lr=0.05)
+    losses_f = []
+    for _ in range(3):
+        ref_p, l = step_f(ref_p, x, y)
+        losses_f.append(float(l))
+    np.testing.assert_allclose(losses_q, losses_f, atol=1e-2, rtol=1e-2)
+    for a, b in zip(jax.tree_util.tree_leaves(p),
+                    jax.tree_util.tree_leaves(ref_p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-2, rtol=1e-1)
+
+
+@pytest.mark.world_8
+def test_zero2_int8_parity(mesh_dp, int8_comm):
+    params, x, y = _data(20)
+    step, init_opt = zero2_step(loss_fn, mesh_dp, lr=1e-2)
+    state = (params, init_opt(params), jnp.zeros((), jnp.int32))
+    losses_q = []
+    for _ in range(3):
+        state, l = step(state, x, y)
+        losses_q.append(float(l))
+    _assert_compressed()
+
+    edconfig.comm_quant_dtype = "none"
+    edconfig.comm_bucket_bytes = 0
+    step_f, init_f = zero2_step(loss_fn, mesh_dp, lr=1e-2)
+    state_f = (params, init_f(params), jnp.zeros((), jnp.int32))
+    losses_f = []
+    for _ in range(3):
+        state_f, l = step_f(state_f, x, y)
+        losses_f.append(float(l))
+    np.testing.assert_allclose(losses_q, losses_f, atol=1e-2, rtol=1e-2)
+
+
+@pytest.mark.world_8
+def test_zero3_int8_parity(mesh_dp, int8_comm):
+    params, x, y = _data(30)
+    step, init_state = zero3_step(loss_fn, mesh_dp, lr=1e-2)
+    state = init_state(params)
+    losses_q = []
+    for _ in range(3):
+        state, l = step(state, x, y)
+        losses_q.append(float(l))
+    _assert_compressed()
+
+    edconfig.comm_quant_dtype = "none"
+    edconfig.comm_bucket_bytes = 0
+    step_f, init_f = zero3_step(loss_fn, mesh_dp, lr=1e-2)
+    state_f = init_f(params)
+    losses_f = []
+    for _ in range(3):
+        state_f, l = step_f(state_f, x, y)
+        losses_f.append(float(l))
+    np.testing.assert_allclose(losses_q, losses_f, atol=1e-2, rtol=1e-2)
+
+
+@pytest.mark.world_8
+def test_sensitive_leaves_stay_fp32(mesh_dp, int8_comm):
+    """Bias leaves (matched by comm_quant_skip) and sub-threshold leaves
+    must ride an exact fp32 bucket even when quantization is on."""
+    params, x, y = _data(40)
+    step = ddp_step(loss_fn, mesh_dp, lr=0.05)
+    step(params, x, y)
+    snap = comm_counters.snapshot()
+    # mlp has w (quantizable: 32*64 >= 512) and b leaves (skip-matched):
+    # both bucket kinds must have launched
+    assert snap["quantized_launches"] >= 1
+    assert snap["launches"] > snap["quantized_launches"]
+
+
+@pytest.mark.world_8
+def test_auto_path_parity_with_compression(cpu_devices, monkeypatch):
+    """easydist_compile with compression enabled: solver prices compressed
+    reduction edges and any partial-region fences emit quantized psum; the
+    compiled loss trajectory must stay within 1e-2 of eager."""
+    from easydist_tpu.jaxfront import easydist_compile
+
+    monkeypatch.setattr(edconfig, "comm_quant_dtype", "int8")
+    monkeypatch.setattr(edconfig, "comm_quant_min_numel", 512)
+    mesh = make_device_mesh((8,), ("dp",))
+    params, x, y = _data(50)
+
+    def step(p, xb, yb):
+        loss, grads = jax.value_and_grad(loss_fn)(p, xb, yb)
+        new_p = jax.tree_util.tree_map(lambda w, g: w - 0.05 * g, p, grads)
+        return new_p, loss
+
+    compiled = easydist_compile(step, mesh=mesh)
+    # separate copies: the compiled step donates its state buffers
+    p_c = jax.tree_util.tree_map(lambda t: t.copy(), params)
+    p_e = jax.tree_util.tree_map(lambda t: t.copy(), params)
+    for _ in range(3):
+        p_c, l_c = compiled(p_c, x, y)
+        p_e, l_e = step(p_e, x, y)
+        assert abs(float(l_c) - float(l_e)) <= 1e-2 * max(
+            1.0, abs(float(l_e)))
+
+
+@pytest.mark.world_2
+@pytest.mark.slow
+def test_quantized_psum_across_dcn_boundary():
+    """Multi-host-only comm path: quantized all-reduce crossing a REAL
+    jax.distributed process (DCN) boundary.  Heavy (spawns two processes);
+    excluded from tier-1 via the `slow` marker."""
+    port = socket.socket()
+    port.bind(("localhost", 0))
+    coordinator = f"localhost:{port.getsockname()[1]}"
+    port.close()
+
+    worker = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+coordinator, rank = sys.argv[1], int(sys.argv[2])
+from easydist_tpu.runtime.elastic import multihost_setup
+multihost_setup(coordinator=coordinator, num_processes=2, process_id=rank)
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+from easydist_tpu.utils.jax_compat import shard_map
+from easydist_tpu.jaxfront import make_device_mesh
+from easydist_tpu.comm import quantized_psum
+mesh = make_device_mesh((2, 2), ("dcn", "ici"), dcn_axes=("dcn",))
+x = jnp.arange(4 * 512, dtype=jnp.float32).reshape(4, 512) / 100.0
+def body(v):
+    return (quantized_psum(v, "dcn", 2),
+            jax.lax.psum(v, "dcn"))
+fn = jax.jit(shard_map(body, mesh=mesh, in_specs=P(("dcn", "ici")),
+                       out_specs=(P("ici"), P("ici")),
+                       check_vma=False))
+got, exact = fn(x)
+g, e = np.asarray(got), np.asarray(exact)
+np.testing.assert_allclose(g, e, rtol=0, atol=0.03 * np.max(np.abs(e)))
+print("OK", rank)
+"""
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", worker, coordinator, str(rank)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for rank in (0, 1)]
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+        assert "OK" in out, out

@@ -1,9 +1,14 @@
 """Compile-cache key robustness (ADVICE round-1 findings): dataflow wiring
-and large-literal contents must be part of the key."""
+and large-literal contents must be part of the key — plus the persistent
+strategy-cache HIT path (a second compile of the same jaxpr/mesh must skip
+ShardCombine discovery and reuse the per-axis strategies)."""
+
+import logging
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from easydist_tpu.jaxfront.api import _compile_cache_key
 
@@ -50,3 +55,55 @@ def test_identical_programs_share_key():
 
     x = jnp.ones((4, 4))
     assert _key(f, x, x) == _key(f, x, x)
+
+
+@pytest.mark.world_8
+def test_strategy_cache_hit_skips_discovery(cpu_devices, tmp_path,
+                                            monkeypatch, caplog):
+    """Persistent strategy-cache hit path: the second compile of the same
+    jaxpr/mesh must (a) log the cache hit, (b) never run ShardCombine
+    discovery, and (c) produce identical per-axis strategies."""
+    from easydist_tpu import config as edconfig
+    from easydist_tpu.jaxfront import easydist_compile, make_device_mesh
+    from easydist_tpu.jaxfront.interpreter import ShardingAnalyzer
+
+    monkeypatch.setattr(edconfig, "enable_compile_cache", True)
+    monkeypatch.setattr(edconfig, "compile_cache_dir", str(tmp_path))
+
+    discovery_runs = []
+    orig_run = ShardingAnalyzer.run
+
+    def counting_run(self):
+        discovery_runs.append(1)
+        return orig_run(self)
+
+    monkeypatch.setattr(ShardingAnalyzer, "run", counting_run)
+    mesh = make_device_mesh((8,), ("dp",))
+
+    def step(w, x):
+        return jnp.tanh(x @ w).sum()
+
+    w = jnp.ones((16, 16))
+    x = jnp.ones((32, 16))
+
+    caplog.set_level(logging.INFO, logger="easydist_tpu.jaxfront.api")
+    first = easydist_compile(step, mesh=mesh, compile_only=True)
+    res1 = first.get_compiled(w, x)
+    assert len(discovery_runs) == 1
+    assert first.cache_stats() == {"size": 1, "hits": 0, "misses": 1}
+
+    # fresh CompiledFunction: the in-memory signature cache cannot serve
+    # this, only the persistent strategy pickle can
+    second = easydist_compile(step, mesh=mesh, compile_only=True)
+    res2 = second.get_compiled(w, x)
+    assert len(discovery_runs) == 1, \
+        "second compile re-ran ShardCombine discovery despite a cache hit"
+    assert second.cache_stats()["misses"] == 1  # compiled, but from cache
+    assert any("[compile cache] hit" in rec.getMessage()
+               for rec in caplog.records)
+
+    assert len(res1.strategies) == len(res2.strategies)
+    for ax1, ax2 in zip(res1.strategies, res2.strategies):
+        assert sorted(ax1) == sorted(ax2)
+        for name in ax1:
+            assert repr(ax1[name]) == repr(ax2[name]), name
