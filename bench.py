@@ -610,11 +610,147 @@ def comm_main():
     print(json.dumps(result), flush=True)
 
 
+def analyze_main():
+    """Static-analyzer scenario (`--analyze`): run the sharding lint
+    (easydist_tpu.analyze, docs/ANALYZE.md) over the preset models — mlp
+    and GPT on the auto path (solver + emitted program) and their DDP
+    collective programs — on a forced 8-device virtual CPU mesh.
+
+    The gate is ZERO error-severity findings; the JSON line records the
+    finding counts per severity and rule plus the solver-objective audit
+    drift, and the full report is exported to the runtime PerfDB under
+    ("analyze_stats", "bench_analyze")."""
+    result = {"metric": "analyze_error_findings", "value": -1,
+              "unit": "findings"}
+    try:
+        os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+            " --xla_force_host_platform_device_count=8"
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+
+        from easydist_tpu.analyze import AnalysisReport, lint_fn
+        from easydist_tpu.jaxfront import easydist_compile, make_device_mesh
+        from easydist_tpu.models import (GPTConfig, make_gpt_train_step,
+                                         mlp_apply, mlp_init)
+        from easydist_tpu.models.gpt import gpt_init, gpt_loss
+        from easydist_tpu.parallel import ddp_step
+
+        report = AnalysisReport()
+        models = {}
+        audit_max_delta = 0.0
+
+        def run_auto(name, fn, *args, mesh):
+            nonlocal audit_max_delta
+            t0 = time.perf_counter()
+            compiled = easydist_compile(fn, mesh=mesh, compile_only=True)
+            res = compiled(*args)
+            rep = compiled.analyze(raise_on_error=False, export=False)
+            report.extend(rep.findings)
+            for rec in res.solver_audits:
+                audit_max_delta = max(audit_max_delta,
+                                      abs(rec["reported"]
+                                          - rec["recomputed"]))
+            models[name] = rep.counts()
+            log(f"# {name}: {rep.counts()} in "
+                f"{time.perf_counter() - t0:.1f}s")
+
+        def run_lint(name, step, *args, mesh):
+            t0 = time.perf_counter()
+            findings = lint_fn(step, *args,
+                               axis_sizes={str(k): int(v)
+                                           for k, v in mesh.shape.items()})
+            rep = AnalysisReport(findings)
+            report.extend(findings)
+            models[name] = rep.counts()
+            log(f"# {name}: {rep.counts()} in "
+                f"{time.perf_counter() - t0:.1f}s")
+
+        def run_ddp(name, loss, params, *batch, mesh):
+            run_lint(name, ddp_step(loss, mesh, lr=0.05), params, *batch,
+                     mesh=mesh)
+
+        # ---- mlp: auto (dp x tp solver path) + DDP collective program
+        mesh_dt = make_device_mesh((4, 2), ("dp", "tp"))
+        mesh_dp = make_device_mesh((8,), ("dp",))
+        params = mlp_init(jax.random.PRNGKey(0), sizes=(64, 128, 64))
+        x = jax.random.normal(jax.random.PRNGKey(1), (64, 64))
+        y = jax.random.normal(jax.random.PRNGKey(2), (64, 64))
+
+        def mlp_loss(p, xb, yb):
+            return jnp.mean((mlp_apply(p, xb) - yb) ** 2)
+
+        def mlp_step(p, xb, yb):
+            loss, grads = jax.value_and_grad(mlp_loss)(p, xb, yb)
+            return jax.tree_util.tree_map(
+                lambda a, g: a - 0.05 * g, p, grads), loss
+
+        run_auto("mlp_auto", mlp_step, params, x, y, mesh=mesh_dt)
+        run_ddp("mlp_ddp", mlp_loss, params, x, y, mesh=mesh_dp)
+
+        # ---- gpt: auto (sizes where the solver actually shards — the
+        # clean-model half of the golden gate needs real S/P placements)
+        cfg = GPTConfig.tiny(seq=64, dim=128, heads=4, layers=2, vocab=128)
+        step, init_state = make_gpt_train_step(cfg)
+        state = init_state(jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, cfg.seq), 0,
+                                    cfg.vocab)
+        targets = jax.random.randint(jax.random.PRNGKey(2), (8, cfg.seq), 0,
+                                     cfg.vocab)
+        run_auto("gpt_auto", step, state, tokens, targets, mesh=mesh_dt)
+
+        gpt_params = gpt_init(cfg, jax.random.PRNGKey(3))
+        run_ddp("gpt_ddp", lambda p, t, g: gpt_loss(p, cfg, t, g),
+                gpt_params, tokens, targets, mesh=mesh_dp)
+
+        # ---- pipeline path: the 1f1b supertick program (ppermute ring +
+        # masked fwd/bwd + interleaved virtual stages), traced and linted
+        from easydist_tpu.models.gpt import make_gpt_pipeline_step
+
+        pp_mesh = make_device_mesh((4, 2), ("pp", "dp"))
+        cfg_pp = GPTConfig.tiny(seq=16, dim=32, heads=4, layers=8,
+                                vocab=128)
+        pp_step, pp_init = make_gpt_pipeline_step(
+            cfg_pp, pp_mesh, 8, lr=1e-2, schedule="1f1b", n_virtual=2,
+            data_axis="dp")
+        pp_state = pp_init(jax.random.PRNGKey(4))
+        pp_toks = jax.random.randint(jax.random.PRNGKey(5),
+                                     (8, 4, cfg_pp.seq), 0, cfg_pp.vocab)
+        run_lint("gpt_pp_1f1b", pp_step, pp_state, pp_toks, pp_toks,
+                 mesh=pp_mesh)
+
+        counts = report.counts()
+        report.export_to_perfdb(sub_key="bench_analyze")
+        result.update({
+            "value": counts["error"],
+            "warnings": counts["warning"],
+            "rules": report.rule_counts(),
+            "models": models,
+            "solver_audit_max_delta": audit_max_delta,
+            "n_chips": 8,
+            "device": "host cpu (virtual 8-device mesh)",
+        })
+        if counts["error"]:
+            result["error_findings"] = [str(f) for f in report.errors()[:10]]
+        log(f"# analyze gate: {counts['error']} errors, "
+            f"{counts['warning']} warnings, audit drift "
+            f"{audit_max_delta:.2e}")
+    except Exception as e:  # always land the JSON line
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        result["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(result), flush=True)
+
+
 if __name__ == "__main__":
     if "--serve" in sys.argv:
         serve_main()
     elif "--comm" in sys.argv:
         comm_main()
+    elif "--analyze" in sys.argv:
+        analyze_main()
     elif "--child" in sys.argv:
         child_main()
     else:

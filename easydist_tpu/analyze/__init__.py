@@ -1,0 +1,51 @@
+"""`easydist_tpu.analyze`: static SPMD strategy & collective verifier.
+
+A rule-based analyzer that runs after solving and before execution
+(DistIR-style static checking over a typed distributed IR):
+
+  layer 1  strategy verifier over solved MetaIR (`verify_axis`,
+           `audit_solver_objective`) — placement typing, S-dim validity,
+           PARTIAL resolution, solver objective audit;
+  layer 2  collective-program linter over emitted jaxprs and comm plans
+           (`lint_jaxpr`, `lint_fn`, `lint_bucket_plan`) — axis
+           existence, cond-branch deadlock shapes, bucket tiling, int8
+           accumulation.
+
+Surfaced via `CompiledFunction.analyze()`, `bench.py --analyze`, and the
+dryrun gate; findings export through the runtime PerfDB under
+`("analyze_stats", <sub_key>)`.  Error-severity findings raise by default
+(`EASYDIST_ANALYZE_RAISE=0` is the escape hatch); rule catalog in
+docs/ANALYZE.md.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from .findings import (RULES, AnalysisError, AnalysisReport, Finding,
+                       make_finding)
+from .jaxpr_rules import lint_bucket_plan, lint_fn, lint_jaxpr
+from .strategy_rules import audit_solver_objective, verify_axis
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "RULES", "AnalysisError", "AnalysisReport", "Finding", "make_finding",
+    "lint_bucket_plan", "lint_fn", "lint_jaxpr",
+    "audit_solver_objective", "verify_axis", "check_bucket_plan",
+]
+
+
+def check_bucket_plan(leaves, buckets) -> None:
+    """Trace-time self-check hook for `comm.bucketer`: lint the plan and
+    raise (or log, with the escape hatch) on error findings."""
+    from easydist_tpu import config as edconfig
+
+    findings = lint_bucket_plan(leaves, buckets)
+    if not findings:
+        return
+    report = AnalysisReport(findings)
+    if edconfig.analyze_raise:
+        report.raise_on_errors()
+    for f in findings:
+        logger.warning("[analyze] %s", f)

@@ -1,0 +1,168 @@
+"""Structured findings for the static SPMD analyzer.
+
+Every rule violation the analyzer detects is a `Finding(rule_id, severity,
+node, message)`; a pass over one artifact (a solved MetaGraph axis, an
+emitted jaxpr, a bucket plan) returns a list of findings, and
+`AnalysisReport` aggregates them across passes with PerfDB export and the
+raise-on-error gate (`edconfig.analyze_raise` is the escape hatch).
+
+The rule catalog lives HERE (id -> severity/title) so the rule modules,
+docs/ANALYZE.md, and the tests share one source of truth; a rule module
+emitting an unregistered id is itself a bug and raises immediately.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional
+
+SEV_ERROR = "error"
+SEV_WARNING = "warning"
+SEV_INFO = "info"
+
+# rule_id -> (default severity, one-line title).  docs/ANALYZE.md mirrors
+# this table with the full description + escape hatch per rule.
+RULES: Dict[str, tuple] = {
+    # ---- layer 1: strategy verifier (solved MetaIR, per mesh axis)
+    "STRAT000": (SEV_INFO,
+                 "strategy layer skipped (compile-cache hit: no MetaGraph)"),
+    "STRAT001": (SEV_ERROR,
+                 "consumer expects PARTIAL but producer emits R/S "
+                 "(no priced reshard materializes a partial)"),
+    "STRAT002": (SEV_ERROR,
+                 "S(dim) out of tensor rank or not divisible by the "
+                 "mesh-axis size"),
+    "STRAT003": (SEV_ERROR,
+                 "PARTIAL placement escapes at a graph output"),
+    "STRAT004": (SEV_ERROR,
+                 "PARTIAL unresolved: rides a non-linear consumer, both "
+                 "operands of a bilinear op, or a mismatched reduction"),
+    "STRAT005": (SEV_ERROR,
+                 "solver objective drift: reported edge-comm cost != "
+                 "independent assignment_comm_cost recomputation"),
+    # ---- layer 2: collective-program linter (emitted jaxpr / comm plans)
+    "COLL000": (SEV_WARNING,
+                "program lint skipped (emitted jaxpr unavailable)"),
+    "COLL001": (SEV_ERROR,
+                "collective names a mesh axis that does not exist"),
+    "COLL002": (SEV_ERROR,
+                "cond branches disagree on their collective programs "
+                "(deadlock shape)"),
+    "COLL003": (SEV_ERROR,
+                "bucket slices do not tile the flat buffer exactly "
+                "(gap/overlap/size mismatch)"),
+    "COLL004": (SEV_ERROR,
+                "int8 operand fed to an arithmetic reduction collective "
+                "(missing the two-pass scale)"),
+    "COLL005": (SEV_WARNING,
+                "collective inside a while-loop predicate (trip counts may "
+                "diverge across devices)"),
+}
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one graph/jaxpr location."""
+
+    rule_id: str
+    severity: str
+    node: str
+    message: str
+
+    def __post_init__(self):
+        if self.rule_id not in RULES:
+            raise ValueError(f"unregistered analyzer rule id {self.rule_id!r}")
+        if self.severity not in (SEV_ERROR, SEV_WARNING, SEV_INFO):
+            raise ValueError(f"bad severity {self.severity!r}")
+
+    def __str__(self) -> str:
+        return f"[{self.rule_id}:{self.severity}] {self.node}: {self.message}"
+
+
+def make_finding(rule_id: str, node: str, message: str,
+                 severity: Optional[str] = None) -> Finding:
+    """Finding with the rule's registered default severity."""
+    return Finding(rule_id, severity or RULES[rule_id][0], node, message)
+
+
+class AnalysisError(RuntimeError):
+    """Raised when a report carries error-severity findings and raising is
+    enabled (`edconfig.analyze_raise`, EASYDIST_ANALYZE_RAISE=0 to opt out)."""
+
+    def __init__(self, report: "AnalysisReport"):
+        self.report = report
+        errors = report.errors()
+        lines = "\n  ".join(str(f) for f in errors[:20])
+        more = f"\n  ... and {len(errors) - 20} more" if len(errors) > 20 else ""
+        super().__init__(
+            f"static analysis found {len(errors)} error-severity finding(s) "
+            f"(set EASYDIST_ANALYZE_RAISE=0 to demote to logging):\n  "
+            f"{lines}{more}")
+
+
+class AnalysisReport:
+    """Aggregated findings of one analyze() run."""
+
+    def __init__(self, findings: Iterable[Finding] = ()):
+        self.findings: List[Finding] = list(findings)
+
+    def add(self, finding: Finding) -> None:
+        self.findings.append(finding)
+
+    def extend(self, findings: Iterable[Finding]) -> None:
+        self.findings.extend(findings)
+
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == SEV_ERROR]
+
+    def warnings(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == SEV_WARNING]
+
+    def counts(self) -> Dict[str, int]:
+        out = {SEV_ERROR: 0, SEV_WARNING: 0, SEV_INFO: 0}
+        for f in self.findings:
+            out[f.severity] += 1
+        return out
+
+    def rule_counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule_id] = out.get(f.rule_id, 0) + 1
+        return out
+
+    def summary(self) -> str:
+        c = self.counts()
+        head = (f"analyze: {c[SEV_ERROR]} error(s), {c[SEV_WARNING]} "
+                f"warning(s), {c[SEV_INFO]} info")
+        if not self.findings:
+            return head + " — clean"
+        return head + "\n" + "\n".join(f"  {f}" for f in self.findings)
+
+    def raise_on_errors(self) -> "AnalysisReport":
+        """Raise AnalysisError if any error-severity finding; returns self
+        otherwise (chaining).  Callers gate on `edconfig.analyze_raise`."""
+        if self.errors():
+            raise AnalysisError(self)
+        return self
+
+    def export_to_perfdb(self, sub_key: str = "analyze",
+                         db: Optional[object] = None) -> Dict[str, object]:
+        """Persist counts + findings under ("analyze_stats", sub_key) so the
+        lint evidence lands next to step times and comm_stats."""
+        from easydist_tpu.runtime.perfdb import PerfDB
+
+        payload: Dict[str, object] = {
+            "counts": self.counts(),
+            "rules": self.rule_counts(),
+            # cap the stored detail: the counts are the gate, the first
+            # findings are the debugging breadcrumb
+            "findings": [(f.rule_id, f.severity, f.node, f.message)
+                         for f in self.findings[:50]],
+        }
+        db = db or PerfDB()
+        db.record_op_perf("analyze_stats", sub_key, payload)
+        try:
+            db.persist()
+        except Exception:  # a read-only DB path must not break analysis
+            pass
+        return payload

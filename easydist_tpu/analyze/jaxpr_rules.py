@@ -1,0 +1,212 @@
+"""Layer 2: collective-program linter over emitted jaxprs and comm plans.
+
+Works on the program that actually ships: the jaxpr traced from the
+emitted sharded function (partial-region `shard_map` fences, comm-layer
+collectives, dp/zero/pipeline `shard_map` programs all appear here), plus
+the bucketer's packing plans.  Rules:
+
+  COLL001  every collective's named axis exists in the mesh;
+  COLL002  `cond`/`switch` branches carry identical collective programs —
+           a branch-dependent collective is the classic SPMD deadlock
+           shape (devices disagreeing on the predicate post different
+           collectives and hang);
+  COLL003  a bucket plan's slices tile the flat buffer exactly: every
+           leaf in exactly one bucket, no overlap/gap, byte counts
+           consistent, dtypes uniform per bucket;
+  COLL004  arithmetic reduction collectives (psum/pmin/pmax/
+           reduce_scatter) never see an int8/uint8 operand — the
+           quantized scheme sums in f32 after dequantize (two-pass
+           scale); int8 accumulation on the wire overflows at axis
+           sizes as small as 2;
+  COLL005  collectives inside a `while` predicate get a warning: if the
+           predicate diverges across devices the trip counts diverge and
+           the program deadlocks.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+from .findings import Finding, make_finding
+
+# primitives that perform arithmetic on the wire (int8 operands overflow);
+# pmean lowers to psum + div so it is covered by "psum"
+_REDUCING_COLLECTIVES = frozenset((
+    "psum", "pmin", "pmax", "reduce_scatter", "psum_scatter",
+))
+# primitives that carry data without reducing (safe for int8 payloads —
+# this is exactly why the two-pass quantized scheme is clean)
+_MOVING_COLLECTIVES = frozenset((
+    "all_gather", "all_to_all", "ppermute", "pbroadcast", "axis_index",
+))
+_COLLECTIVES = _REDUCING_COLLECTIVES | _MOVING_COLLECTIVES
+
+_INT8_DTYPES = ("int8", "uint8")
+
+
+def _axis_names(eqn) -> List[str]:
+    """Named mesh axes a collective eqn binds (positional int axes from
+    vmap are not mesh axes and are skipped)."""
+    names: List[str] = []
+    for key in ("axes", "axis_name"):
+        if key not in eqn.params:
+            continue
+        val = eqn.params[key]
+        vals = val if isinstance(val, (tuple, list)) else (val,)
+        names.extend(v for v in vals if isinstance(v, str))
+    return names
+
+
+def _sub_jaxprs(eqn) -> List[Tuple[str, object]]:
+    """(param_key, jaxpr) for every sub-jaxpr in an eqn's params, in a
+    stable order.  Handles pjit (`jaxpr`), scan/while/cond (`jaxpr`,
+    `cond_jaxpr`, `body_jaxpr`, `branches`), shard_map, custom_* calls."""
+    out: List[Tuple[str, object]] = []
+    for key in sorted(eqn.params):
+        val = eqn.params[key]
+        vals = val if isinstance(val, (tuple, list)) else (val,)
+        for i, v in enumerate(vals):
+            inner = getattr(v, "jaxpr", v)
+            if hasattr(inner, "eqns"):
+                label = f"{key}{i}" if isinstance(val, (tuple, list)) else key
+                out.append((label, inner))
+    return out
+
+
+def _collective_signature(jaxpr) -> List[Tuple[str, Tuple[str, ...]]]:
+    """Ordered (primitive, named axes) of every collective in `jaxpr`,
+    recursively — the "shape" that must agree across cond branches for the
+    program to be deadlock-free."""
+    sig: List[Tuple[str, Tuple[str, ...]]] = []
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in _COLLECTIVES:
+            sig.append((name, tuple(_axis_names(eqn))))
+        for _, sub in _sub_jaxprs(eqn):
+            sig.extend(_collective_signature(sub))
+    return sig
+
+
+def lint_jaxpr(jaxpr, axis_sizes: Dict[str, int],
+               _path: str = "") -> List[Finding]:
+    """Lint one jaxpr (recursively) against a mesh given as
+    {axis_name: size}.  Accepts a Jaxpr or ClosedJaxpr."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    findings: List[Finding] = []
+    for idx, eqn in enumerate(jaxpr.eqns):
+        name = eqn.primitive.name
+        loc = f"{_path}eqn{idx}:{name}"
+
+        if name in _COLLECTIVES:
+            for ax in _axis_names(eqn):
+                if ax not in axis_sizes:
+                    findings.append(make_finding(
+                        "COLL001", loc,
+                        f"collective {name!r} names mesh axis {ax!r}; "
+                        f"mesh has {sorted(axis_sizes)}"))
+            if name in _REDUCING_COLLECTIVES:
+                bad = [v for v in eqn.invars
+                       if hasattr(v, "aval")
+                       and str(getattr(v.aval, "dtype", "")) in _INT8_DTYPES]
+                if bad:
+                    findings.append(make_finding(
+                        "COLL004", loc,
+                        f"{name!r} accumulates {len(bad)} int8-typed "
+                        f"operand(s) on the wire — quantized reductions "
+                        f"must dequantize to f32 before summing "
+                        f"(two-pass scale)"))
+
+        if name == "cond":
+            branches = eqn.params.get("branches", ())
+            sigs = [_collective_signature(getattr(b, "jaxpr", b))
+                    for b in branches]
+            if len({tuple(s) for s in sigs}) > 1:
+                detail = "; ".join(
+                    f"branch{i}={s or 'none'}" for i, s in enumerate(sigs))
+                findings.append(make_finding(
+                    "COLL002", loc,
+                    f"cond branches disagree on collective programs "
+                    f"({detail}) — devices taking different branches "
+                    f"deadlock"))
+
+        if name == "while":
+            cond_j = eqn.params.get("cond_jaxpr")
+            if cond_j is not None:
+                csig = _collective_signature(getattr(cond_j, "jaxpr", cond_j))
+                if csig:
+                    findings.append(make_finding(
+                        "COLL005", loc,
+                        f"while predicate contains collectives {csig}: "
+                        f"safe only if the predicate is replicated "
+                        f"(divergent trip counts deadlock)"))
+
+        for label, sub in _sub_jaxprs(eqn):
+            findings.extend(lint_jaxpr(sub, axis_sizes,
+                                       _path=f"{loc}/{label}/"))
+    return findings
+
+
+def lint_fn(fn, *example_args, axis_sizes: Dict[str, int],
+            **example_kwargs) -> List[Finding]:
+    """Trace `fn` with jax.make_jaxpr and lint the result — the
+    entry point for the dp/zero/pipeline paths, whose programs only exist
+    as traceable callables."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*example_args, **example_kwargs)
+    return lint_jaxpr(closed.jaxpr, axis_sizes)
+
+
+# --------------------------------------------------------------- bucket lint
+
+def lint_bucket_plan(leaves: Sequence, buckets: Iterable) -> List[Finding]:
+    """COLL003: verify a `comm.bucketer` plan tiles the flat leaf set
+    exactly.  `leaves` are the arrays handed to `plan_buckets`; `buckets`
+    the resulting plan.  Checks (one finding per violation kind/site):
+
+      * every leaf index in range and in exactly one bucket (a duplicate
+        is an overlapping slice; missing indices are a gap);
+      * each bucket's `nbytes` equals the sum of its leaves' bytes (an
+        off-by-one slice shows up here);
+      * one dtype per bucket (pack/unpack are cast-free by contract).
+    """
+    findings: List[Finding] = []
+    seen: Dict[int, int] = {}
+    for b_idx, b in enumerate(buckets):
+        loc = f"bucket{b_idx}"
+        nbytes = 0
+        dtypes = set()
+        for i in b.indices:
+            if i < 0 or i >= len(leaves):
+                findings.append(make_finding(
+                    "COLL003", loc,
+                    f"leaf index {i} out of range (have {len(leaves)} "
+                    f"leaves)"))
+                continue
+            if i in seen:
+                findings.append(make_finding(
+                    "COLL003", loc,
+                    f"leaf {i} already packed by bucket{seen[i]} — "
+                    f"overlapping slices"))
+            else:
+                seen[i] = b_idx
+            leaf = leaves[i]
+            nbytes += leaf.size * leaf.dtype.itemsize
+            dtypes.add(str(leaf.dtype))
+        if len(dtypes) > 1:
+            findings.append(make_finding(
+                "COLL003", loc,
+                f"mixed dtypes {sorted(dtypes)} in one bucket (packing "
+                f"must be cast-free)"))
+        if nbytes != b.nbytes:
+            findings.append(make_finding(
+                "COLL003", loc,
+                f"bucket claims {b.nbytes} bytes but its leaves hold "
+                f"{nbytes} — slice offsets will not tile the flat buffer"))
+    missing = [i for i in range(len(leaves)) if i not in seen]
+    if missing:
+        findings.append(make_finding(
+            "COLL003", "plan",
+            f"{len(missing)} leaf/leaves never packed (gap): indices "
+            f"{missing[:8]}{'...' if len(missing) > 8 else ''}"))
+    return findings

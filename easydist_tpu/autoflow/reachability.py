@@ -41,8 +41,14 @@ def _node_flops(node: MetaNode) -> float:
         # fallback for synthetic nodes (no recorded flops): for an
         # unbatched (M,K)x(K,N)->(M,N), in0*in1/out = K^2 exactly; batched
         # dots are ambiguous from shapes alone, which is why the bridge
-        # records exact MACs for real graphs (r5 review #3)
+        # records exact MACs for real graphs (r5 review #3).  The sqrt
+        # inflates by sqrt(B) on a batched (B,M,K)x(B,K,N) dot, so clamp
+        # by the largest input dim — the contraction length can never
+        # exceed it (ADVICE r5: inflated stage-balance estimates)
         k = math.sqrt(max(ins[0], 1) * max(ins[1], 1) / out_elems)
+        max_dim = max((d for v in node.invars if v is not None
+                       for d in v.shape), default=1)
+        k = min(k, float(max_dim))
     else:
         k = max(max(ins, default=0) / max(out_elems, 1), 1.0)
     return 2.0 * out_elems * max(k, 1.0)

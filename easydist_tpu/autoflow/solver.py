@@ -109,6 +109,11 @@ class SpmdSolver:
         self.free_outputs = free_outputs
         self.clusters = graph.clusters
         self.edges: List[_Edge] = []
+        # pure edge-communication cost of the solution this solver last
+        # returned, computed from its own pick indices — the analyzer's
+        # objective audit (analyze.audit_solver_objective) recomputes the
+        # same number independently via assignment_comm_cost and compares
+        self.last_comm_cost: Optional[float] = None
         self._collect_edges()
         self._build_matrices()
         # isomorphic-cluster tying: identical transformer layers share one
@@ -358,6 +363,12 @@ class SpmdSolver:
             logger.info("[SpmdSolver] tied %d clusters into %d groups",
                         len(self.clusters), n_rep)
 
+    def _picks_comm_cost(self, picks: Dict[int, int]) -> float:
+        """Edge-communication cost of a {cid: strategy_idx} solution."""
+        return float(sum(
+            e.comm[picks[e.up_cluster.cid], picks[e.down_cluster.cid]]
+            for e in self.edges))
+
     def assignment_comm_cost(self, chosen: Dict[str, NodeStrategy]) -> float:
         """Pure edge-communication cost of a node-strategy assignment
         (no y costs): 0.0 means sync-free."""
@@ -562,6 +573,7 @@ class SpmdSolver:
         # untied objective.
         picks = self._refine(picks, capped=(
             apply_memory_cap and edconfig.per_device_memory_cap > 0))
+        self.last_comm_cost = self._picks_comm_cost(picks)
 
         chosen: Dict[str, NodeStrategy] = {}
         for c in self.clusters:
@@ -739,6 +751,8 @@ class SpmdSolver:
                 assign, best_cost = res
                 logger.info("[SpmdSolver.beam/native] axis=%s cost=%.3e",
                             self.axis.name, best_cost)
+                self.last_comm_cost = self._picks_comm_cost(
+                    {c.cid: int(assign[pos[c.cid]]) for c in self.clusters})
                 chosen: Dict[str, NodeStrategy] = {}
                 for c in self.clusters:
                     for uid, (_, strat) in \
@@ -768,6 +782,7 @@ class SpmdSolver:
         best_cost, best = beam[0]
         logger.info("[SpmdSolver.beam] axis=%s cost=%.3e", self.axis.name,
                     best_cost)
+        self.last_comm_cost = self._picks_comm_cost(best)
         chosen: Dict[str, NodeStrategy] = {}
         for c in self.clusters:
             for uid, (_, strat) in c.strategies[best[c.cid]].items():

@@ -23,6 +23,8 @@ from typing import Callable, List, Sequence
 
 import jax.numpy as jnp
 
+from easydist_tpu import config as edconfig
+
 
 @dataclass
 class Bucket:
@@ -84,6 +86,13 @@ def bucketed_reduce(leaves: Sequence, quantize_flags: Sequence[bool],
     reduced leaves in the original flat order.
     """
     buckets = plan_buckets(leaves, bucket_bytes, quantize_flags)
+    if edconfig.enable_analyze:
+        # trace-time self-check (COLL003): a plan whose slices do not tile
+        # the flat buffer silently corrupts gradients at unpack; cost is
+        # O(leaves) python at trace time
+        from easydist_tpu.analyze import check_bucket_plan
+
+        check_bucket_plan(leaves, buckets)
     reduced: List = [None] * len(leaves)
     for b in buckets:
         flat = pack(leaves, b)

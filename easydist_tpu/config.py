@@ -160,6 +160,15 @@ comm_quant_min_numel = _env_int("EASYDIST_COMM_QUANT_MIN_NUMEL", 2048)
 auto_calibration = _env_bool("EASYDIST_AUTO_CALIBRATION", True)
 multihost = _env_bool("EASYDIST_MULTIHOST", False)
 
+# ---------------- static analyzer (easydist_tpu.analyze) ----------------
+# run the layer-1 strategy verifier + solver objective audit after every
+# per-axis solve, and the bucketer's plan self-check (both are pure python
+# over already-built structures; cost is negligible next to the solve)
+enable_analyze = _env_bool("EASYDIST_ANALYZE", True)
+# error-severity findings raise AnalysisError; set 0 to demote to logging
+# (the escape hatch for shipping past a false positive while it is triaged)
+analyze_raise = _env_bool("EASYDIST_ANALYZE_RAISE", True)
+
 # ---------------- runtime ----------------
 # donate params/opt-state buffers in the emitted jit (XLA buffer aliasing: the
 # TPU analog of the reference's in-place CUDA memory reuse)

@@ -400,6 +400,35 @@ class CompileResult:
         self.n_flat_in = n_flat_in
         self.in_avals = in_avals or []
         self._executable = None
+        # layer-1 analyzer findings collected during solve_axes, and the
+        # per-axis solver-objective audit records (set by _finish_compile)
+        self.analysis_findings: List[object] = []
+        self.solver_audits: List[Dict[str, float]] = []
+
+    def analyze(self, include_program: bool = True):
+        """Static analysis of this compiled result (easydist_tpu.analyze):
+        the layer-1 strategy findings recorded at solve time plus, when
+        `include_program`, a layer-2 lint of the emitted program (the flat
+        sharded function re-traced on abstract values — partial-region
+        fences and comm collectives included, no device execution).
+        Returns an AnalysisReport; raising is the CALLER's decision
+        (CompiledFunction.analyze gates it on `edconfig.analyze_raise`)."""
+        from easydist_tpu.analyze import (AnalysisReport, lint_jaxpr,
+                                          make_finding)
+
+        report = AnalysisReport(self.analysis_findings)
+        if include_program:
+            try:
+                traced = jax.make_jaxpr(self.jitted)(*self.in_avals)
+                axis_sizes = {str(k): int(v)
+                              for k, v in self.mesh.shape.items()}
+                report.extend(lint_jaxpr(traced.jaxpr, axis_sizes))
+            except Exception as e:  # lint must never be the thing that fails
+                report.add(make_finding(
+                    "COLL000", "emitted-program",
+                    f"program lint skipped: retrace failed "
+                    f"({type(e).__name__}: {e})"))
+        return report
 
     def executable(self):
         """Lower + compile the flat function (cached) — the object carrying
@@ -481,11 +510,17 @@ def _apply_user_pins(graph, closed_jaxpr, axis):
 
 
 def solve_axes(closed_jaxpr, axis_specs, world, rules, shape_info, names,
-               state_io_names=None):
+               state_io_names=None, findings=None, audits=None):
     """The per-axis sequential solve (reference compile_auto.py:128-173):
     strategies chosen on earlier axes are excluded from later pools and
     sharded shapes are pre-shrunk, so no dim is double-sharded past
     divisibility.  Shared by compile_step and scoped_region.
+
+    When `findings` is a list and `edconfig.enable_analyze` is on, the
+    layer-1 strategy verifier (easydist_tpu.analyze) runs on each axis's
+    (graph, chosen) pair right after its solve — the only moment that
+    exact pair exists — appending Finding objects; `audits` collects the
+    per-axis solver-objective audit records.
 
     Returns (per_axis strategies list, last metagraph or None)."""
     order = _axis_solve_order(axis_specs)
@@ -537,6 +572,17 @@ def solve_axes(closed_jaxpr, axis_specs, world, rules, shape_info, names,
             reach = ReachabilityMap(graph)
         solver = SpmdSolver(graph, axis, reachability=reach)
         chosen = solver.solve()
+        if findings is not None and edconfig.enable_analyze:
+            from easydist_tpu.analyze import (audit_solver_objective,
+                                              verify_axis)
+
+            findings.extend(verify_axis(graph, chosen, axis))
+            audit_finding, audit_record = audit_solver_objective(solver,
+                                                                 chosen)
+            if audit_finding is not None:
+                findings.append(audit_finding)
+            if audits is not None and "reported" in audit_record:
+                audits.append(audit_record)
         per_axis[axis_idx] = chosen
         prev_chosen.append(chosen)
         logger.info("[solve] axis %s (%d devices) in %.2fs", axis.name,
@@ -612,9 +658,19 @@ def compile_step(func, args, kwargs, mesh=None, state_io="auto",
                 names.name(v)
         per_axis = list(cached)
         graph = None
+        cache_findings = []
+        if edconfig.enable_analyze:
+            from easydist_tpu.analyze import make_finding
+
+            cache_findings.append(make_finding(
+                "STRAT000", "compile",
+                f"compile-cache hit {cache_key}: layer-1 strategy findings "
+                f"were produced by the solving compile; only the emitted-"
+                f"program lint runs here"))
         return _finish_compile(closed_jaxpr, jaxpr, names, per_axis, graph,
                                axis_specs, mesh, args, kwargs, flat_args,
-                               in_tree, out_tree, state_pairs, donate_state)
+                               in_tree, out_tree, state_pairs, donate_state,
+                               analysis_findings=cache_findings)
 
     # gate shardability on the SMALLEST axis: per-axis pools re-check
     # divisibility, so a dim only shardable on a small axis must not be
@@ -634,9 +690,14 @@ def compile_step(func, args, kwargs, mesh=None, state_io="auto",
             if not isinstance(ov, jex_core.Literal):
                 state_io_names[names.name(ov)] = names.name(jaxpr.invars[in_idx])
 
-    # ---- per-axis sequential solve
+    # ---- per-axis sequential solve (layer-1 analyzer findings collected
+    # per axis, on exactly the graph each solve saw)
+    analysis_findings: List[object] = []
+    solver_audits: List[Dict[str, float]] = []
     per_axis, graph = solve_axes(closed_jaxpr, axis_specs, world, rules,
-                                 shape_info, names, state_io_names)
+                                 shape_info, names, state_io_names,
+                                 findings=analysis_findings,
+                                 audits=solver_audits)
 
     if edconfig.dump_dir:
         _dump_strategies(graph, [c if c is not None else {} for c in per_axis],
@@ -647,7 +708,9 @@ def compile_step(func, args, kwargs, mesh=None, state_io="auto",
 
     return _finish_compile(closed_jaxpr, jaxpr, names, per_axis, graph,
                            axis_specs, mesh, args, kwargs, flat_args,
-                           in_tree, out_tree, state_pairs, donate_state)
+                           in_tree, out_tree, state_pairs, donate_state,
+                           analysis_findings=analysis_findings,
+                           solver_audits=solver_audits)
 
 
 def _replicated_flops_fraction(jaxpr, per_axis_final, axis_specs) -> float:
@@ -698,7 +761,8 @@ def _xla_peak_bytes(closed_jaxpr, names, per_axis_final, axis_specs, mesh,
 
 def _finish_compile(closed_jaxpr, jaxpr, names, per_axis, graph, axis_specs,
                     mesh, args, kwargs, flat_args, in_tree, out_tree,
-                    state_pairs, donate_state):
+                    state_pairs, donate_state, analysis_findings=None,
+                    solver_audits=None):
     """Emission + jit from solved strategies (shared by the fresh-solve and
     compile-cache paths)."""
     axis_names = [s.name for s in axis_specs]
@@ -872,6 +936,8 @@ def _finish_compile(closed_jaxpr, jaxpr, names, per_axis, graph, axis_specs,
                            in_avals=in_avals)
     result.remat_plan = remat_plan
     result.replicated_flops_fraction = replicated_fraction
+    result.analysis_findings = list(analysis_findings or [])
+    result.solver_audits = list(solver_audits or [])
     return result
 
 
@@ -934,6 +1000,38 @@ class CompiledFunction:
         (compiling it first if needed) — the object carrying
         cost_analysis()/memory_analysis()."""
         return self.get_compiled(*args, **kwargs).executable()
+
+    def analyze(self, *args, raise_on_error: Optional[bool] = None,
+                include_program: bool = True, export: bool = True,
+                **kwargs):
+        """Run the static analyzer (easydist_tpu.analyze) on a compiled
+        signature: with args, the signature they resolve to (compiling it
+        first if needed); without, the last-called one.
+
+        Exports finding counts to the runtime PerfDB under
+        ("analyze_stats", <function name>) and raises AnalysisError on
+        error-severity findings unless `raise_on_error=False` or the
+        `EASYDIST_ANALYZE_RAISE=0` escape hatch is set.  Returns the
+        AnalysisReport."""
+        if args or kwargs:
+            result = self.get_compiled(*args, **kwargs)
+        else:
+            result = self._last
+            if result is None:
+                raise RuntimeError(
+                    "analyze(): nothing compiled yet — call the function "
+                    "first or pass example args")
+        report = result.analyze(include_program=include_program)
+        if export:
+            report.export_to_perfdb(
+                sub_key=getattr(self.func, "__name__", "step"))
+        if raise_on_error is None:
+            raise_on_error = edconfig.analyze_raise
+        if raise_on_error:
+            report.raise_on_errors()
+        elif report.errors():
+            logger.warning("[analyze] %s", report.summary())
+        return report
 
     def _lookup(self, flat_args, treedef, args, kwargs) -> CompileResult:
         sig = self._signature(flat_args, treedef)

@@ -1,0 +1,175 @@
+"""Layer-1 golden fixtures: seeded mutations of solved MetaGraph strategy
+assignments, each firing exactly one rule with the right rule_id, and a
+clean assignment firing nothing (the zero-false-positive half of the
+acceptance gate)."""
+
+import pytest
+
+from easydist_tpu.analyze import audit_solver_objective, verify_axis
+from easydist_tpu.autoflow.cost_model import MeshAxisSpec
+from easydist_tpu.autoflow.solver import SpmdSolver
+from easydist_tpu.metashard.combination import Reduction
+from easydist_tpu.metashard.metair import (MetaGraph, MetaNode, MetaVar,
+                                           NodeStrategy, Placement)
+
+R = Placement.replicate
+S = Placement.shard
+P = Placement.partial
+
+
+def make_chain_graph():
+    """x,w placeholders -> dot -> reduce_sum -> tanh -> output.
+
+    reduce_sum gives the P rules a legitimately linear consumer; tanh a
+    non-linear one.  Shapes divisible by the axis size 4.
+    """
+    g = MetaGraph("fixture")
+    xv = MetaVar("x", (8, 8), "float32")
+    wv = MetaVar("w", (8, 8), "float32")
+    hv = MetaVar("h", (8, 8), "float32")
+    rv = MetaVar("r", (8,), "float32")
+    tv = MetaVar("t", (8,), "float32")
+    nx = MetaNode("in_x", "placeholder", [], [xv], is_input=True)
+    nw = MetaNode("in_w", "placeholder", [], [wv], is_input=True)
+    nd = MetaNode("op0", "dot_general", [xv, wv], [hv])
+    nr = MetaNode("op1", "reduce_sum", [hv], [rv])
+    nt = MetaNode("op2", "tanh", [rv], [tv])
+    for n in (nx, nw):
+        g.add_input(n)
+    for n in (nd, nr, nt):
+        g.add_op(n)
+    g.outputs = [tv]
+    return g
+
+
+AXIS = MeshAxisSpec("dp", 4)
+
+
+def clean_chosen():
+    return {
+        "in_x": NodeStrategy([], [S(0)]),
+        "in_w": NodeStrategy([], [R()]),
+        "op0": NodeStrategy([S(0), R()], [S(0)]),
+        "op1": NodeStrategy([S(0)], [S(0)]),
+        "op2": NodeStrategy([S(0)], [S(0)]),
+    }
+
+
+def fired(findings, rule_id):
+    return [f for f in findings if f.rule_id == rule_id]
+
+
+def test_clean_assignment_no_findings():
+    g = make_chain_graph()
+    assert verify_axis(g, clean_chosen(), AXIS) == []
+
+
+def test_strat002_dim_out_of_rank_fires_once():
+    g = make_chain_graph()
+    chosen = clean_chosen()
+    chosen["op0"] = NodeStrategy([S(0), R()], [S(5)])  # h is rank 2
+    findings = verify_axis(g, chosen, AXIS)
+    assert len(findings) == 1
+    assert findings[0].rule_id == "STRAT002"
+    assert findings[0].severity == "error"
+    assert "rank" in findings[0].message
+
+
+def test_strat002_indivisible_dim_fires_once():
+    g = make_chain_graph()
+    axis3 = MeshAxisSpec("dp", 3)  # 8 % 3 != 0
+    chosen = {
+        "in_x": NodeStrategy([], [R()]),
+        "in_w": NodeStrategy([], [R()]),
+        "op0": NodeStrategy([R(), R()], [R()]),
+        "op1": NodeStrategy([R()], [R()]),
+        "op2": NodeStrategy([R()], [S(0)]),  # only t is sharded
+    }
+    findings = verify_axis(g, chosen, axis3)
+    assert [f.rule_id for f in findings] == ["STRAT002"]
+    assert "not divisible" in findings[0].message
+
+
+def test_strat003_stray_partial_at_output_fires_once():
+    g = make_chain_graph()
+    chosen = clean_chosen()
+    # tanh "emits" P: its consumers don't expect P (t has none), so the
+    # only violated invariant is the escape at the graph output
+    chosen["op2"] = NodeStrategy([S(0)], [P()])
+    findings = verify_axis(g, chosen, AXIS)
+    assert [f.rule_id for f in findings] == ["STRAT003"]
+    assert "output" in findings[0].node
+
+
+def test_strat001_consumer_expects_partial_producer_does_not():
+    g = make_chain_graph()
+    chosen = clean_chosen()
+    # reduce_sum (linear, so no STRAT004) expects P, dot emits S(0)
+    chosen["op1"] = NodeStrategy([P()], [S(0)])
+    findings = verify_axis(g, chosen, AXIS)
+    assert [f.rule_id for f in findings] == ["STRAT001"]
+
+
+def test_strat004_partial_rides_nonlinear_consumer():
+    g = make_chain_graph()
+    chosen = clean_chosen()
+    chosen["op1"] = NodeStrategy([S(0)], [P()])  # reduce_sum creates P
+    chosen["op2"] = NodeStrategy([P()], [S(0)])  # tanh consumes it: invalid
+    findings = verify_axis(g, chosen, AXIS)
+    assert [f.rule_id for f in findings] == ["STRAT004"]
+    assert "non-linear" in findings[0].message
+
+
+def test_strat004_reduction_mismatch():
+    g = make_chain_graph()
+    chosen = clean_chosen()
+    chosen["op0"] = NodeStrategy([S(0), R()], [P(Reduction.SUM)])
+    chosen["op1"] = NodeStrategy([P(Reduction.MAX)], [S(0)])
+    findings = verify_axis(g, chosen, AXIS)
+    assert [f.rule_id for f in findings] == ["STRAT004"]
+    assert "mismatch" in findings[0].message
+
+
+def test_strat004_bilinear_both_operands_partial():
+    g = MetaGraph("bilinear")
+    av = MetaVar("a", (8, 8), "float32")
+    bv = MetaVar("b", (8, 8), "float32")
+    cv = MetaVar("c", (8, 8), "float32")
+    dv = MetaVar("d", (8, 8), "float32")
+    ev = MetaVar("e", (8, 8), "float32")
+    na = MetaNode("in_a", "placeholder", [], [av], is_input=True)
+    nb = MetaNode("in_b", "placeholder", [], [bv], is_input=True)
+    n0 = MetaNode("op0", "reduce_sum", [av], [cv])
+    n1 = MetaNode("op1", "reduce_sum", [bv], [dv])
+    n2 = MetaNode("op2", "mul", [cv, dv], [ev])
+    for n in (na, nb):
+        g.add_input(n)
+    for n in (n0, n1, n2):
+        g.add_op(n)
+    g.outputs = [ev]
+    chosen = {
+        "in_a": NodeStrategy([], [R()]),
+        "in_b": NodeStrategy([], [R()]),
+        "op0": NodeStrategy([R()], [P()]),
+        "op1": NodeStrategy([R()], [P()]),
+        # mul with P on BOTH sides: product of sums != sum of products.
+        # Its out is R so nothing escapes at the output.
+        "op2": NodeStrategy([P(), P()], [R()]),
+    }
+    findings = verify_axis(g, chosen, AXIS)
+    assert [f.rule_id for f in findings] == ["STRAT004"]
+    assert "bilinear" in findings[0].message
+
+
+def test_strat005_solver_objective_audit():
+    g = make_chain_graph()
+    g.coarsen(AXIS.size, level=0)
+    solver = SpmdSolver(g, AXIS)
+    chosen = solver.solve()
+    finding, record = audit_solver_objective(solver, chosen)
+    assert finding is None
+    assert record["reported"] == pytest.approx(record["recomputed"])
+    # seeded corruption: the reported objective drifts from the table
+    solver.last_comm_cost = record["reported"] + 1.0
+    finding, _ = audit_solver_objective(solver, chosen)
+    assert finding is not None and finding.rule_id == "STRAT005"

@@ -1,0 +1,52 @@
+"""Shape-only FLOPs fallback: the `k = sqrt(in0*in1/out)` contraction
+estimate is exact for unbatched dots but inflates by sqrt(B) on batched
+ones; it must be clamped by the largest input dim (ADVICE r5: inflated
+stage-balance estimates on synthetic batched dots)."""
+
+import math
+
+from easydist_tpu.autoflow.reachability import _node_flops
+from easydist_tpu.metashard.metair import MetaGraph, MetaNode, MetaVar
+
+
+def batched_dot_node(b, m, k, n):
+    g = MetaGraph("batched-dot")
+    av = MetaVar("a", (b, m, k), "float32")
+    bv = MetaVar("b", (b, k, n), "float32")
+    ov = MetaVar("o", (b, m, n), "float32")
+    na = MetaNode("in_a", "placeholder", [], [av], is_input=True)
+    nb = MetaNode("in_b", "placeholder", [], [bv], is_input=True)
+    nd = MetaNode("op0", "dot_general", [av, bv], [ov])
+    g.add_input(na)
+    g.add_input(nb)
+    g.add_op(nd)
+    g.outputs = [ov]
+    return nd
+
+
+def test_unbatched_dot_exact():
+    node = batched_dot_node(1, 64, 32, 16)
+    # (1,64,32)x(1,32,16): sqrt(in0*in1/out) recovers K exactly, clamp is
+    # a no-op (largest dim 64 > 32)
+    assert _node_flops(node) == 2.0 * 64 * 16 * 32
+
+
+def test_batched_dot_clamped_by_largest_input_dim():
+    b, m, k, n = 8, 64, 32, 16
+    node = batched_dot_node(b, m, k, n)
+    out_elems = b * m * n
+    unclamped = 2.0 * out_elems * math.sqrt(
+        (b * m * k) * (b * k * n) / out_elems)  # = k * sqrt(b) inflation
+    true = 2.0 * out_elems * k
+    got = _node_flops(node)
+    # clamped to the largest input dim (64): below the sqrt(B)-inflated
+    # estimate, and never more than largest-dim x the true contraction
+    assert got < unclamped
+    assert got == 2.0 * out_elems * 64
+    assert got <= true * (64 / k)
+
+
+def test_recorded_flops_bypass_fallback():
+    node = batched_dot_node(8, 64, 32, 16)
+    node.flops = 12345.0  # the bridge's exact MACs always win
+    assert _node_flops(node) == 12345.0
