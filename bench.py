@@ -613,12 +613,16 @@ def comm_main():
 def analyze_main():
     """Static-analyzer scenario (`--analyze`): run the sharding lint
     (easydist_tpu.analyze, docs/ANALYZE.md) over the preset models — mlp
-    and GPT on the auto path (solver + emitted program) and their DDP
-    collective programs — on a forced 8-device virtual CPU mesh.
+    and GPT on the auto path (solver + emitted program + memory plan,
+    including a remat-enabled compile) and their DDP collective programs,
+    plus the pipeline schedule tables — on a forced 8-device virtual CPU
+    mesh.
 
     The gate is ZERO error-severity findings; the JSON line records the
-    finding counts per severity and rule plus the solver-objective audit
-    drift, and the full report is exported to the runtime PerfDB under
+    finding counts per severity and rule, the solver-objective audit
+    drift, the predicted (planner) and XLA peak bytes per auto preset
+    (drift gated by `jaxfront.api.peak_model_drift_ok`), and the pipeline
+    bubble stats; the full report is exported to the runtime PerfDB under
     ("analyze_stats", "bench_analyze")."""
     result = {"metric": "analyze_error_findings", "value": -1,
               "unit": "findings"}
@@ -633,6 +637,7 @@ def analyze_main():
 
         from easydist_tpu.analyze import AnalysisReport, lint_fn
         from easydist_tpu.jaxfront import easydist_compile, make_device_mesh
+        from easydist_tpu.jaxfront.api import peak_model_drift_ok
         from easydist_tpu.models import (GPTConfig, make_gpt_train_step,
                                          mlp_apply, mlp_init)
         from easydist_tpu.models.gpt import gpt_init, gpt_loss
@@ -640,6 +645,7 @@ def analyze_main():
 
         report = AnalysisReport()
         models = {}
+        memory = {}
         audit_max_delta = 0.0
 
         def run_auto(name, fn, *args, mesh):
@@ -654,8 +660,32 @@ def analyze_main():
                                       abs(rec["reported"]
                                           - rec["recomputed"]))
             models[name] = rep.counts()
-            log(f"# {name}: {rep.counts()} in "
+            # memory trajectory: planner peak vs XLA's own schedule (the
+            # planner is an upper bound; temp==0 on CPU skips the drift
+            # assertion, the numbers are still recorded)
+            mem = {"predicted_peak_bytes": res.predicted_peak_bytes}
+            try:
+                ma = res.executable().memory_analysis()
+                temp = int(ma.temp_size_in_bytes)
+                mem["xla_peak_bytes"] = temp + int(
+                    ma.argument_size_in_bytes)
+                mem["xla_temp_bytes"] = temp
+                # the upper-bound contract holds for the PRE-rewrite
+                # liveness model; a remat rewrite's post-peak is validated
+                # against XLA by the remat pass itself on real backends
+                # (CPU skips those probes, so compare base_peak there)
+                model_peak = (res.remat_plan.base_peak if res.remat_plan
+                              else res.predicted_peak_bytes)
+                assert peak_model_drift_ok(model_peak, temp), \
+                    (name, model_peak, temp)
+            except AssertionError:
+                raise
+            except Exception as e:
+                log(f"# {name}: memory_analysis unavailable: {e}")
+            memory[name] = mem
+            log(f"# {name}: {rep.counts()} peak {mem} in "
                 f"{time.perf_counter() - t0:.1f}s")
+            return res
 
         def run_lint(name, step, *args, mesh):
             t0 = time.perf_counter()
@@ -690,6 +720,35 @@ def analyze_main():
         run_auto("mlp_auto", mlp_step, params, x, y, mesh=mesh_dt)
         run_ddp("mlp_ddp", mlp_loss, params, x, y, mesh=mesh_dp)
 
+        # ---- remat-enabled auto run: an activation-dominated step under a
+        # cap the solver cannot shard away — the MEM005 rewrite audit must
+        # see a real RematPlan and still report zero errors
+        from easydist_tpu import config as edconfig
+
+        rp = [jnp.ones((64, 64)) / 64 * (1 + 0.1 * i) for i in range(6)]
+        rx = jax.random.normal(jax.random.PRNGKey(7), (8192, 64))
+
+        def remat_step(ps, xb):
+            def loss_fn(ps):
+                h = xb
+                for w in ps:
+                    h = jnp.tanh(h @ w)
+                return jnp.mean(h ** 2)
+
+            loss, g = jax.value_and_grad(loss_fn)(ps)
+            return [p - 0.1 * gi for p, gi in zip(ps, g)], loss
+
+        saved_cap = edconfig.per_device_memory_cap
+        try:
+            edconfig.per_device_memory_cap = 1_700_000
+            res_rm = run_auto("mlp_auto_remat", remat_step, rp, rx,
+                              mesh=make_device_mesh((8,), ("dp",)))
+            assert res_rm.remat_plan is not None \
+                and res_rm.remat_plan.n_remat_vars > 0, \
+                "remat preset compiled without a remat plan"
+        finally:
+            edconfig.per_device_memory_cap = saved_cap
+
         # ---- gpt: auto (sizes where the solver actually shards — the
         # clean-model half of the golden gate needs real S/P placements)
         cfg = GPTConfig.tiny(seq=64, dim=128, heads=4, layers=2, vocab=128)
@@ -721,13 +780,38 @@ def analyze_main():
         run_lint("gpt_pp_1f1b", pp_step, pp_state, pp_toks, pp_toks,
                  mesh=pp_mesh)
 
+        # ---- schedule verifier (SCHED rules) over the same 1f1b config's
+        # tick tables + the static bubble report for the PerfDB
+        from easydist_tpu.analyze import (schedule_stats,
+                                          verify_schedule_tables)
+        from easydist_tpu.parallel.pipeline import _1f1b_schedule_tables
+
+        tables = _1f1b_schedule_tables(4, 2, 8)
+        sched_findings = verify_schedule_tables(tables, 4, 2, 8)
+        report.extend(sched_findings)
+        models["gpt_pp_schedule"] = AnalysisReport(sched_findings).counts()
+        sched = schedule_stats(tables)
+        log(f"# gpt_pp_schedule: {models['gpt_pp_schedule']} bubble "
+            f"{sched['bubble_fraction']:.3f}")
+
         counts = report.counts()
         report.export_to_perfdb(sub_key="bench_analyze")
+        from easydist_tpu.runtime.perfdb import PerfDB
+
+        db = PerfDB()
+        db.record_op_perf("analyze_stats", "bench_schedule", sched)
+        db.record_op_perf("analyze_stats", "bench_memory", memory)
+        try:
+            db.persist()
+        except Exception:
+            pass
         result.update({
             "value": counts["error"],
             "warnings": counts["warning"],
             "rules": report.rule_counts(),
             "models": models,
+            "memory": memory,
+            "schedule": sched,
             "solver_audit_max_delta": audit_max_delta,
             "n_chips": 8,
             "device": "host cpu (virtual 8-device mesh)",

@@ -1,4 +1,5 @@
-"""`easydist_tpu.analyze`: static SPMD strategy & collective verifier.
+"""`easydist_tpu.analyze`: static SPMD strategy, collective, memory &
+schedule verifier.
 
 A rule-based analyzer that runs after solving and before execution
 (DistIR-style static checking over a typed distributed IR):
@@ -9,7 +10,14 @@ A rule-based analyzer that runs after solving and before execution
   layer 2  collective-program linter over emitted jaxprs and comm plans
            (`lint_jaxpr`, `lint_fn`, `lint_bucket_plan`) — axis
            existence, cond-branch deadlock shapes, bucket tiling, int8
-           accumulation.
+           accumulation;
+  layer 3  memory-plan & pipeline-schedule verifier
+           (`verify_memory_plan`, `check_hbm_budget`, `audit_remat_plan`,
+           `verify_schedule_tables`) — independent liveness/sizing audit
+           of the graph memory plan, skyline soundness, the MEM004 HBM
+           budget gate with its remat advisory, the remat-rewrite audit,
+           and deadlock/stash-bound/bubble checks over pipeline tick
+           schedules.
 
 Surfaced via `CompiledFunction.analyze()`, `bench.py --analyze`, and the
 dryrun gate; findings export through the runtime PerfDB under
@@ -22,9 +30,14 @@ from __future__ import annotations
 
 import logging
 
-from .findings import (RULES, AnalysisError, AnalysisReport, Finding,
-                       make_finding)
+from .findings import (RULES, SEV_INFO, AnalysisError, AnalysisReport,
+                       Finding, make_finding)
 from .jaxpr_rules import lint_bucket_plan, lint_fn, lint_jaxpr
+from .memory_rules import (audit_remat_plan, check_hbm_budget,
+                           recompute_liveness, remat_advisory,
+                           resolve_hbm_budget, verify_memory_plan)
+from .schedule_rules import (gpipe_schedule_tables, schedule_stats,
+                             verify_schedule_tables)
 from .strategy_rules import audit_solver_objective, verify_axis
 
 logger = logging.getLogger(__name__)
@@ -33,6 +46,10 @@ __all__ = [
     "RULES", "AnalysisError", "AnalysisReport", "Finding", "make_finding",
     "lint_bucket_plan", "lint_fn", "lint_jaxpr",
     "audit_solver_objective", "verify_axis", "check_bucket_plan",
+    "verify_memory_plan", "check_hbm_budget", "audit_remat_plan",
+    "recompute_liveness", "remat_advisory", "resolve_hbm_budget",
+    "verify_schedule_tables", "gpipe_schedule_tables", "schedule_stats",
+    "check_schedule_tables",
 ]
 
 
@@ -49,3 +66,25 @@ def check_bucket_plan(leaves, buckets) -> None:
         report.raise_on_errors()
     for f in findings:
         logger.warning("[analyze] %s", f)
+
+
+def check_schedule_tables(tables, n_stages: int, n_virtual: int,
+                          n_microbatches: int, fwd_only: bool = False,
+                          node: str = "pipeline") -> None:
+    """Build-time self-check hook for the pipeline schedule builders
+    (`parallel/pipeline.py`, `parallel/auto_pipeline.py`): verify the tick
+    tables and raise (or log, with the escape hatch) on error findings.
+    Warning/info findings (the SCHED003 bubble report) only log."""
+    from easydist_tpu import config as edconfig
+
+    findings = verify_schedule_tables(tables, n_stages, n_virtual,
+                                      n_microbatches, fwd_only=fwd_only,
+                                      node=node)
+    if not findings:
+        return
+    report = AnalysisReport(findings)
+    if report.errors() and edconfig.analyze_raise:
+        report.raise_on_errors()
+    for f in findings:
+        logger.log(logging.INFO if f.severity == SEV_INFO
+                   else logging.WARNING, "[analyze] %s", f)

@@ -2,7 +2,8 @@
 
 Every rule violation the analyzer detects is a `Finding(rule_id, severity,
 node, message)`; a pass over one artifact (a solved MetaGraph axis, an
-emitted jaxpr, a bucket plan) returns a list of findings, and
+emitted jaxpr, a bucket plan, a memory plan, a pipeline tick schedule)
+returns a list of findings, and
 `AnalysisReport` aggregates them across passes with PerfDB export and the
 raise-on-error gate (`edconfig.analyze_raise` is the escape hatch).
 
@@ -57,6 +58,34 @@ RULES: Dict[str, tuple] = {
     "COLL005": (SEV_WARNING,
                 "collective inside a while-loop predicate (trip counts may "
                 "diverge across devices)"),
+    # ---- layer 3a: memory-plan verifier (MemoryPlan over solved MetaIR)
+    "MEM000": (SEV_INFO,
+               "memory layer skipped (no MetaGraph: compile-cache hit or "
+               "single-device mesh)"),
+    "MEM001": (SEV_ERROR,
+               "memory-plan lifetime drift: an interval disagrees with the "
+               "independent producer/last-consumer recomputation"),
+    "MEM002": (SEV_ERROR,
+               "memory-plan size drift: interval bytes != placement-"
+               "divided tensor bytes (element-aligned, shards rounded up)"),
+    "MEM003": (SEV_ERROR,
+               "skyline unsound: overlapping live offsets, or peak below "
+               "the sum-of-live lower bound / packed extent"),
+    "MEM004": (SEV_ERROR,
+               "predicted per-device peak exceeds the HBM budget "
+               "(structured remat advisory attached)"),
+    "MEM005": (SEV_ERROR,
+               "remat rewrite unsound: non-flat/pure chain equation, "
+               "non-lowering rewrite, or missing CSE barrier"),
+    # ---- layer 3b: pipeline-schedule verifier (tick schedule tables)
+    "SCHED001": (SEV_ERROR,
+                 "pipeline schedule deadlock: a unit runs before its "
+                 "dependency arrives, is scheduled twice, or never runs"),
+    "SCHED002": (SEV_ERROR,
+                 "pipeline activation stash over bound: in-flight "
+                 "microbatches exceed the residual ring or the 1F1B limit"),
+    "SCHED003": (SEV_WARNING,
+                 "pipeline bubble fraction above the report threshold"),
 }
 
 

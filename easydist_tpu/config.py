@@ -168,6 +168,16 @@ enable_analyze = _env_bool("EASYDIST_ANALYZE", True)
 # error-severity findings raise AnalysisError; set 0 to demote to logging
 # (the escape hatch for shipping past a false positive while it is triaged)
 analyze_raise = _env_bool("EASYDIST_ANALYZE_RAISE", True)
+# MEM004 HBM budget gate (bytes/device): -1 = auto (ask the real device's
+# memory_stats; unknown backends fall back to hbm_capacity_default), 0 =
+# gate off, >0 = explicit budget.  Unlike per_device_memory_cap (which
+# DRIVES remat), this only verifies — it never changes the program.
+analyze_hbm_budget = _env_int("EASYDIST_ANALYZE_HBM_BUDGET", -1)
+# platform HBM capacity assumed when no real device answers (v5e: 16 GiB)
+hbm_capacity_default = _env_int("EASYDIST_HBM_CAPACITY", 16 * 2**30)
+# SCHED003: warn when a pipeline tick schedule's static bubble fraction
+# (idle fwd/bwd slots over total slots) exceeds this
+analyze_bubble_warn_frac = _env_float("EASYDIST_ANALYZE_BUBBLE_WARN", 0.6)
 
 # ---------------- runtime ----------------
 # donate params/opt-state buffers in the emitted jit (XLA buffer aliasing: the

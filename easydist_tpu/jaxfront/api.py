@@ -404,19 +404,28 @@ class CompileResult:
         # per-axis solver-objective audit records (set by _finish_compile)
         self.analysis_findings: List[object] = []
         self.solver_audits: List[Dict[str, float]] = []
+        # set by _finish_compile for the memory analyzer (layer 3)
+        self.closed_jaxpr = None
+        self.remat_plan = None
+        self.memory_plan = None  # cached MemoryPlan from the last analyze()
+        self.predicted_peak_bytes: Optional[int] = None
 
-    def analyze(self, include_program: bool = True):
+    def analyze(self, include_program: bool = True,
+                include_memory: bool = True):
         """Static analysis of this compiled result (easydist_tpu.analyze):
-        the layer-1 strategy findings recorded at solve time plus, when
+        the layer-1 strategy findings recorded at solve time, plus, when
         `include_program`, a layer-2 lint of the emitted program (the flat
         sharded function re-traced on abstract values — partial-region
-        fences and comm collectives included, no device execution).
+        fences and comm collectives included, no device execution), plus,
+        when `include_memory`, the layer-3 memory verifier (graph memory
+        plan audit, HBM budget gate, remat-rewrite audit).
         Returns an AnalysisReport; raising is the CALLER's decision
         (CompiledFunction.analyze gates it on `edconfig.analyze_raise`)."""
         from easydist_tpu.analyze import (AnalysisReport, lint_jaxpr,
                                           make_finding)
 
         report = AnalysisReport(self.analysis_findings)
+        traced = None
         if include_program:
             try:
                 traced = jax.make_jaxpr(self.jitted)(*self.in_avals)
@@ -428,7 +437,55 @@ class CompileResult:
                     "COLL000", "emitted-program",
                     f"program lint skipped: retrace failed "
                     f"({type(e).__name__}: {e})"))
+        if include_memory:
+            report.extend(self._memory_findings(traced))
         return report
+
+    def _memory_findings(self, traced=None) -> List[object]:
+        """Layer 3a: plan this result's graph memory and run the MEM rule
+        family over it (easydist_tpu.analyze.memory_rules).  The plan is
+        built from the LAST solved axis's (graph, chosen) pair — that
+        graph's shapes are already pre-shrunk by every earlier axis, so
+        dividing by its own placements yields true per-device bytes."""
+        from easydist_tpu.analyze import (audit_remat_plan,
+                                          check_hbm_budget, make_finding,
+                                          resolve_hbm_budget,
+                                          verify_memory_plan)
+
+        if self.graph is None:
+            return [make_finding(
+                "MEM000", "memory-plan",
+                "no MetaGraph on this result (compile-cache hit or "
+                "single-device mesh): the memory layer ran — if ever — "
+                "on the solving compile")]
+        from easydist_tpu.schedule import plan_graph_memory
+
+        findings: List[object] = []
+        axis = getattr(self.graph, "solved_axis", None)
+        chosen = getattr(self.graph, "solved_chosen", None)
+        per_axis = [chosen] if chosen is not None else []
+        axis_sizes = [axis.size] if axis is not None else []
+        try:
+            plan = plan_graph_memory(self.graph, per_axis, axis_sizes)
+        except Exception as e:  # analysis must never be the thing that fails
+            return [make_finding(
+                "MEM000", "memory-plan",
+                f"memory planning failed ({type(e).__name__}: {e}); "
+                f"MEM rules skipped")]
+        self.memory_plan = plan
+        self.predicted_peak_bytes = (
+            int(self.remat_plan.predicted_peak) if self.remat_plan
+            else int(plan.peak_bytes))
+        findings.extend(verify_memory_plan(self.graph, plan, per_axis,
+                                           axis_sizes))
+        budget = resolve_hbm_budget(self.mesh)
+        findings.extend(check_hbm_budget(self.graph, plan, budget,
+                                         remat_plan=self.remat_plan))
+        if self.remat_plan is not None and self.closed_jaxpr is not None:
+            findings.extend(audit_remat_plan(self.closed_jaxpr,
+                                             self.remat_plan,
+                                             traced=traced))
+        return findings
 
     def executable(self):
         """Lower + compile the flat function (cached) — the object carrying
@@ -572,6 +629,11 @@ def solve_axes(closed_jaxpr, axis_specs, world, rules, shape_info, names,
             reach = ReachabilityMap(graph)
         solver = SpmdSolver(graph, axis, reachability=reach)
         chosen = solver.solve()
+        # tag the graph with ITS OWN solve pair: later-axis graphs carry
+        # shapes pre-shrunk by earlier axes, so the memory analyzer must
+        # divide by exactly this one axis's placements (analyze layer 3)
+        graph.solved_axis = axis
+        graph.solved_chosen = chosen
         if findings is not None and edconfig.enable_analyze:
             from easydist_tpu.analyze import (audit_solver_objective,
                                               verify_axis)
@@ -737,6 +799,22 @@ def _replicated_flops_fraction(jaxpr, per_axis_final, axis_specs) -> float:
         if not sharded:
             replicated += f
     return replicated / total if total > 0 else 0.0
+
+
+# The liveness model is a python-order UPPER bound on XLA's scheduled peak:
+# it may exceed what XLA achieves freely, but must never UNDERestimate the
+# scheduler's temp bytes by more than this fraction — shared by the remat
+# decision here and the bench --analyze planner/XLA drift assertion.
+_PEAK_MODEL_UNDER_TOL = 0.05
+
+
+def peak_model_drift_ok(predicted_bytes, xla_temp_bytes) -> bool:
+    """True when the planner's predicted peak respects the upper-bound
+    contract vs XLA's own memory_analysis temp bytes.  CPU backends report
+    temp_size 0 (same skip as the remat probes above): vacuously OK."""
+    if predicted_bytes is None or not xla_temp_bytes or xla_temp_bytes <= 0:
+        return True
+    return predicted_bytes >= (1.0 - _PEAK_MODEL_UNDER_TOL) * xla_temp_bytes
 
 
 def _xla_peak_bytes(closed_jaxpr, names, per_axis_final, axis_specs, mesh,
@@ -935,6 +1013,7 @@ def _finish_compile(closed_jaxpr, jaxpr, names, per_axis, graph, axis_specs,
                            graph, mesh, in_tree, out_tree, len(flat_args),
                            in_avals=in_avals)
     result.remat_plan = remat_plan
+    result.closed_jaxpr = closed_jaxpr
     result.replicated_flops_fraction = replicated_fraction
     result.analysis_findings = list(analysis_findings or [])
     result.solver_audits = list(solver_audits or [])
@@ -1002,8 +1081,8 @@ class CompiledFunction:
         return self.get_compiled(*args, **kwargs).executable()
 
     def analyze(self, *args, raise_on_error: Optional[bool] = None,
-                include_program: bool = True, export: bool = True,
-                **kwargs):
+                include_program: bool = True, include_memory: bool = True,
+                export: bool = True, **kwargs):
         """Run the static analyzer (easydist_tpu.analyze) on a compiled
         signature: with args, the signature they resolve to (compiling it
         first if needed); without, the last-called one.
@@ -1021,7 +1100,8 @@ class CompiledFunction:
                 raise RuntimeError(
                     "analyze(): nothing compiled yet — call the function "
                     "first or pass example args")
-        report = result.analyze(include_program=include_program)
+        report = result.analyze(include_program=include_program,
+                                include_memory=include_memory)
         if export:
             report.export_to_perfdb(
                 sub_key=getattr(self.func, "__name__", "step"))

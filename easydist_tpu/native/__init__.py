@@ -137,6 +137,21 @@ def check_plan(starts, ends, sizes, offsets, max_report: int = 16):
     return out
 
 
+def live_profile(starts, ends, sizes) -> np.ndarray:
+    """Sum-of-live-sizes per schedule step (length max(ends)+1) — the full
+    curve behind `peak_live`; the analyzer's MEM004 advisory uses its
+    argmax to find the peak step a remat candidate must span."""
+    n = len(starts)
+    if n == 0:
+        return np.zeros(0, dtype=np.int64)
+    s, e, z = _i64(starts), _i64(ends), _i64(sizes)
+    max_t = int(e.max())
+    delta = np.zeros(max_t + 2, dtype=np.int64)
+    np.add.at(delta, s, z)
+    np.add.at(delta, e + 1, -z)
+    return np.cumsum(delta[:-1])
+
+
 def peak_live(starts, ends, sizes) -> int:
     """Sum-of-live-sizes peak — the allocator-independent lower bound."""
     n = len(starts)

@@ -635,6 +635,17 @@ def pipeline_forward(fn: Callable, example_params, example_mb, mesh,
     plan, branches, sib_axes = prep.plan, prep.branches, prep.sib_axes
     S, M = n_stages, n_microbatches
 
+    # build-time schedule lint: the auto-split gpipe clock is the same
+    # u = s + m table family the analyzer verifies for the stacked path
+    from easydist_tpu import config as edconfig
+
+    if edconfig.enable_analyze:
+        from easydist_tpu.analyze import (check_schedule_tables,
+                                          gpipe_schedule_tables)
+
+        check_schedule_tables(gpipe_schedule_tables(S, M), S, 1, M,
+                              fwd_only=True, node="auto_pipeline/gpipe")
+
     def pipelined(params, microbatches):
         if shard_params:
             packed, shared_vals = params  # from pack_params

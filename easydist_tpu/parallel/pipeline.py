@@ -92,6 +92,15 @@ def spmd_pipeline(stage_fn: Callable, mesh, config: PipelineConfig):
     if config.n_virtual > 1:
         return _interleaved_forward(body, mesh, config)
 
+    from easydist_tpu import config as edconfig
+
+    if edconfig.enable_analyze:
+        from easydist_tpu.analyze import (check_schedule_tables,
+                                          gpipe_schedule_tables)
+
+        check_schedule_tables(gpipe_schedule_tables(S, M), S, 1, M,
+                              fwd_only=True, node="pipeline/gpipe")
+
     def pipelined(stage_params, microbatches):
         # stage-stacked params shard their leading dim over pp (optionally
         # with a tensor-parallel tail spec); microbatches shard their batch
@@ -478,6 +487,20 @@ def _1f1b_schedule_tables(S: int, V: int, M: int,
                 sum(1 for m2 in range(M) if u_f(j, m2) <= u_b(j, m1))
                 - m1 for m1 in range(M))
             ring = max(ring, live)
-    return {"m_f": m_f, "k_f": k_f, "f_ok": f_ok,
-            "m_b": m_b, "k_b": k_b, "b_ok": b_ok,
-            "n_superticks": U, "ring": ring}
+    tables = {"m_f": m_f, "k_f": k_f, "f_ok": f_ok,
+              "m_b": m_b, "k_b": k_b, "b_ok": b_ok,
+              "n_superticks": U, "ring": ring}
+
+    # build-time schedule lint (easydist_tpu.analyze SCHED rules): the
+    # lockstep scan runs masked garbage ticks rather than crashing on a
+    # bad table, so dependency/stash bugs must be caught HERE
+    from easydist_tpu import config as edconfig
+
+    if edconfig.enable_analyze:
+        from easydist_tpu.analyze import check_schedule_tables
+
+        check_schedule_tables(
+            tables, S, V, M, fwd_only=fwd_only,
+            node="pipeline/interleaved-fwd" if fwd_only
+            else "pipeline/1f1b")
+    return tables

@@ -4,6 +4,11 @@ On TPU, XLA owns buffer addresses, so the planner's outputs are *analysis
 and policy*: per-strategy peak-memory estimates (feeding the solver's memory
 cap), a skyline packing that bounds what any allocator could achieve, and a
 lifetime-overlap validator (the op_mem_checker analog).  The heavy loops run
-in the native C++ planner (easydist_tpu/native)."""
+in the native C++ planner (easydist_tpu/native).
+
+Everything this package plans is statically re-audited by
+`easydist_tpu.analyze` layer 3: the MEM rule family re-derives lifetimes
+and sharded sizes independently, gates the predicted peak against the HBM
+budget, and audits `remat.plan_remat` rewrites (docs/ANALYZE.md)."""
 
 from .memory_planner import plan_graph_memory, MemoryPlan  # noqa: F401

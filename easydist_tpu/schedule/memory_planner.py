@@ -15,7 +15,8 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from easydist_tpu import native
-from easydist_tpu.metashard.metair import MetaGraph, NodeStrategy
+from easydist_tpu.metashard.metair import (_DTYPE_BYTES, MetaGraph,
+                                           NodeStrategy)
 
 
 @dataclass
@@ -33,12 +34,20 @@ class MemoryPlan:
                                  self.offsets)
 
 
-def _sharded_bytes(var, placements, axis_sizes) -> float:
-    size = var.size_bytes()
+def _sharded_bytes(var, placements, axis_sizes) -> int:
+    """Per-device bytes under the given per-axis placements, in exact
+    integer bytes: shard dims divide in ELEMENTS, rounded UP per shard (a
+    non-divisible dim leaves ceil(d/n) elements on the widest device — the
+    one whose peak matters), so skyline offsets stay element-aligned and
+    never drift through fractional float accumulation."""
+    shape = list(var.shape)
     for p, n in zip(placements, axis_sizes):
-        if p is not None and p.is_shard():
-            size /= n
-    return size
+        if p is not None and p.is_shard() and n > 0 and p.dim < len(shape):
+            shape[p.dim] = -(-shape[p.dim] // n)  # ceil division
+    elems = 1
+    for d in shape:
+        elems *= int(d)
+    return elems * _DTYPE_BYTES.get(var.dtype, 4)
 
 
 def plan_graph_memory(graph: MetaGraph,
@@ -80,21 +89,25 @@ def plan_graph_memory(graph: MetaGraph,
             if var.name in out_names:
                 last = n_ops - 1
             intervals.append((var, i, last))
-    # graph inputs live from step 0 until their last consumer
+    # graph inputs live from step 0 until their last consumer (pinned to
+    # the end when they escape directly as graph outputs)
     for node in graph.inputs:
         for var in node.outvars:
             if var is None or var.name in seen:
                 continue
+            seen.add(var.name)
             last = 0
             for consumer, _ in var.consumers:
                 last = max(last, op_index.get(consumer.name, 0))
+            if var.name in out_names:
+                last = n_ops - 1
             intervals.append((var, 0, last))
 
     names = [v.name for v, _, _ in intervals]
     starts = np.array([s for _, s, _ in intervals], dtype=np.int64)
     ends = np.array([e for _, _, e in intervals], dtype=np.int64)
-    sizes = np.array([max(int(_sharded_bytes(v, var_placements(v),
-                                             axis_sizes)), 1)
+    sizes = np.array([max(_sharded_bytes(v, var_placements(v), axis_sizes),
+                          1)
                       for v, _, _ in intervals], dtype=np.int64)
 
     offsets, peak = native.skyline_plan(starts, ends, sizes)

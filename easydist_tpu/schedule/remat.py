@@ -72,6 +72,14 @@ def _eqn_recomputable(eqn) -> bool:
     return True
 
 
+def candidate_score(nbytes: float, recompute_s: float) -> float:
+    """The remat ranking metric: resident bytes reclaimed per second of
+    recompute — candidates are taken largest-first.  Shared with the
+    analyzer's MEM004 budget advisory (analyze/memory_rules.py) so the
+    advisory names exactly the candidates this planner would pick."""
+    return nbytes / (1e-6 + recompute_s)
+
+
 def _eqn_flops(eqn) -> float:
     """Crude per-equation recompute cost proxy (seconds are derived by the
     caller).  dot_general: 2*M*N*K; conv: treated as expensive; everything
@@ -339,7 +347,7 @@ def plan_remat(closed_jaxpr, names, per_axis: Sequence[Dict],
             if not chain:
                 continue
             cost_s = sum(eqn_seconds(e) for e in chain)
-            score = lv.size[v] / (1e-6 + cost_s)
+            score = candidate_score(lv.size[v], cost_s)
             cands.append((score, v, t_star, chain))
             if len(cands) >= 256:
                 break

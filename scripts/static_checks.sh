@@ -27,12 +27,16 @@ else
     echo "static_checks: mypy not installed; skipping (pip install mypy)"
 fi
 
-# the sharding lint is always available (pure python, ships in-tree):
-# bench.py --analyze gates zero error-severity findings on preset models
-echo "== bench.py --analyze (sharding lint gate)"
-out=$(python bench.py --analyze 2>/dev/null) || rc=1
-echo "$out"
-errors=$(python - "$out" <<'EOF'
+# the sharding/memory/schedule lint ships in-tree but needs a jax to trace
+# the preset models: bench.py --analyze gates zero error-severity findings
+# (STRAT/COLL plus the MEM/SCHED memory-plan & pipeline-schedule rules and
+# the HBM-budget/peak-drift assertions) when jax is importable, and skips
+# gracefully where it is not (bare linting containers)
+if python -c "import jax" >/dev/null 2>&1; then
+    echo "== bench.py --analyze (sharding + memory/schedule lint gate)"
+    out=$(python bench.py --analyze 2>/dev/null) || rc=1
+    echo "$out"
+    errors=$(python - "$out" <<'EOF'
 import json, sys
 try:
     print(json.loads(sys.argv[1].strip().splitlines()[-1])["value"])
@@ -40,9 +44,12 @@ except Exception:
     print(-1)
 EOF
 )
-if [ "$errors" != "0" ]; then
-    echo "static_checks: sharding lint reported $errors error finding(s)"
-    rc=1
+    if [ "$errors" != "0" ]; then
+        echo "static_checks: sharding lint reported $errors error finding(s)"
+        rc=1
+    fi
+else
+    echo "static_checks: jax not importable; skipping bench.py --analyze"
 fi
 
 [ "$ran" = 0 ] && echo "static_checks: no external linters ran (configs still validated by CI tests)"

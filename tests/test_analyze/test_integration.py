@@ -110,3 +110,75 @@ def test_analyze_before_any_call_errors():
                                 mesh=make_device_mesh((8,), ("dp",)))
     with pytest.raises(RuntimeError, match="nothing compiled"):
         compiled.analyze()
+
+
+# ------------------------------------------------------- layer 3 (memory)
+
+def test_memory_layer_runs_on_auto_path(compiled_mlp):
+    compiled, result = compiled_mlp
+    report = compiled.analyze(export=False)
+    assert report.errors() == []
+    # the analyze() call planned this result's graph memory and the plan
+    # passed its own validator + the MEM rules
+    assert result.memory_plan is not None
+    assert result.predicted_peak_bytes > 0
+    assert result.memory_plan.validate() == []
+
+
+def test_memory_layer_can_be_skipped(compiled_mlp):
+    compiled, result = compiled_mlp
+    result.memory_plan = None
+    compiled.analyze(export=False, include_memory=False)
+    assert result.memory_plan is None  # layer 3 really did not run
+    compiled.analyze(export=False)
+    assert result.memory_plan is not None
+
+
+def test_mem004_budget_gate_raises_with_advisory(compiled_mlp,
+                                                 monkeypatch):
+    compiled, result = compiled_mlp
+    budget = max(result.predicted_peak_bytes // 2, 1) \
+        if result.predicted_peak_bytes else 1
+    monkeypatch.setattr(edconfig, "analyze_hbm_budget", budget)
+    with pytest.raises(Exception) as exc:
+        compiled.analyze(export=False)
+    msg = str(exc.value)
+    assert "MEM004" in msg and "advisory" in msg
+    # the escape hatch demotes; only the budget finding is error-severity
+    monkeypatch.setattr(edconfig, "analyze_raise", False)
+    report = compiled.analyze(export=False)
+    assert [f.rule_id for f in report.errors()] == ["MEM004"]
+
+
+@pytest.mark.world_8
+def test_remat_enabled_compile_analyzes_clean(cpu_devices, monkeypatch):
+    """A compile whose program only fits the cap through the remat
+    rewrite: the MEM005 audit sees the real plan (flat chains, lowered
+    peak, optimization_barrier in the emitted program) and the full
+    report stays error-free."""
+    import jax.numpy as jnp
+
+    mesh = make_device_mesh((8,), ("dp",), devices=cpu_devices)
+    params = [jnp.ones((64, 64)) / 64 * (1 + 0.1 * i) for i in range(6)]
+    x = jax.random.normal(jax.random.PRNGKey(0), (8192, 64))
+
+    def step(ps, xb):
+        def loss_fn(ps):
+            h = xb
+            for w in ps:
+                h = jnp.tanh(h @ w)
+            return jnp.mean(h ** 2)
+
+        loss, g = jax.value_and_grad(loss_fn)(ps)
+        return [p - 0.1 * gi for p, gi in zip(ps, g)], loss
+
+    monkeypatch.setattr(edconfig, "per_device_memory_cap", 1_700_000)
+    compiled = easydist_compile(step, mesh=mesh, compile_only=True)
+    result = compiled(params, x)
+    assert result.remat_plan is not None \
+        and result.remat_plan.n_remat_vars > 0
+    report = compiled.analyze(export=False)
+    assert report.errors() == [], report.summary()
+    # the budget prediction follows the POST-rewrite peak
+    assert result.predicted_peak_bytes == \
+        result.remat_plan.predicted_peak

@@ -1,0 +1,283 @@
+"""Layer-3a golden fixtures: seeded mutations of a planned MemoryPlan /
+RematPlan, each firing exactly one MEM rule, and the clean pair firing
+nothing (the zero-false-positive half of the acceptance gate).  MEM001's
+independent liveness recomputation is additionally asserted to match
+`plan_graph_memory` exactly on a solver-solved graph."""
+
+import numpy as np
+import pytest
+
+from easydist_tpu import config as edconfig
+from easydist_tpu.analyze import (check_hbm_budget, recompute_liveness,
+                                  remat_advisory, resolve_hbm_budget,
+                                  verify_memory_plan)
+from easydist_tpu.autoflow.cost_model import MeshAxisSpec
+from easydist_tpu.metashard.metair import (MetaGraph, MetaNode, MetaVar,
+                                           NodeStrategy, Placement)
+from easydist_tpu.schedule import plan_graph_memory
+
+R = Placement.replicate
+S = Placement.shard
+
+AXIS = MeshAxisSpec("dp", 4)
+
+
+def make_graph():
+    """x,w -> tanh a -> dot b -> tanh c -> add(a, c) d.
+
+    `a` spans the whole schedule (read again by the last op) while the
+    wide `b`/`c` intermediates put the profile's peak in the MIDDLE — the
+    shape the MEM004 remat advisory must recognize (evicting `a` across
+    the peak step is the win)."""
+    g = MetaGraph("memfix")
+    xv = MetaVar("x", (32, 16), "float32")
+    wv = MetaVar("w", (16, 16), "float32")
+    av = MetaVar("a", (32, 16), "float32")
+    bv = MetaVar("b", (64, 64), "float32")
+    cv = MetaVar("c", (64, 64), "float32")
+    dv = MetaVar("d", (32, 16), "float32")
+    nx = MetaNode("in_x", "placeholder", [], [xv], is_input=True)
+    nw = MetaNode("in_w", "placeholder", [], [wv], is_input=True)
+    n0 = MetaNode("op0", "tanh", [xv], [av])
+    n1 = MetaNode("op1", "dot_general", [av, wv], [bv])
+    n2 = MetaNode("op2", "tanh", [bv], [cv])
+    n3 = MetaNode("op3", "add", [av, cv], [dv])
+    for n in (nx, nw):
+        g.add_input(n)
+    for n in (n0, n1, n2, n3):
+        g.add_op(n)
+    g.outputs = [dv]
+    return g
+
+
+def chosen():
+    return {
+        "in_x": NodeStrategy([], [S(0)]),
+        "in_w": NodeStrategy([], [R()]),
+        "op0": NodeStrategy([S(0)], [S(0)]),
+        "op1": NodeStrategy([S(0), R()], [S(0)]),
+        "op2": NodeStrategy([S(0)], [S(0)]),
+        "op3": NodeStrategy([S(0), S(0)], [S(0)]),
+    }
+
+
+def make_plan(g=None, ch=None):
+    g = g or make_graph()
+    ch = ch or chosen()
+    return g, ch, plan_graph_memory(g, [ch], [AXIS.size])
+
+
+def test_clean_plan_no_findings():
+    g, ch, plan = make_plan()
+    assert verify_memory_plan(g, plan, [ch], [AXIS.size]) == []
+
+
+def test_mem001_matches_planner_exactly():
+    """The independent recomputation reproduces every planner interval
+    (including the output pinned to the final op and inputs from 0)."""
+    g, ch, plan = make_plan()
+    expected = recompute_liveness(g)
+    assert set(expected) == set(plan.var_names)
+    for i, name in enumerate(plan.var_names):
+        assert expected[name] == (int(plan.starts[i]), int(plan.ends[i]))
+    # the output really is pinned to the last op, inputs start at 0
+    assert expected["d"] == (3, 3)
+    assert expected["a"] == (0, 3)
+    assert expected["x"][0] == 0
+
+
+def test_mem001_lifetime_drift_fires_once():
+    g, ch, plan = make_plan()
+    i = plan.var_names.index("b")
+    plan.ends[i] -= 1  # drops the real last consumer (use-after-free)
+    findings = verify_memory_plan(g, plan, [ch], [AXIS.size])
+    assert [f.rule_id for f in findings] == ["MEM001"]
+    assert "b" in findings[0].node
+
+
+def test_mem002_size_drift_fires_once():
+    g, ch, plan = make_plan()
+    i = plan.var_names.index("a")
+    plan.sizes[i] += 4  # one float of drift
+    # keep the skyline's own bookkeeping consistent so ONLY the sizing
+    # audit fires (the planner always emits peak == packed extent)
+    plan.peak_bytes = int(np.max(plan.offsets + plan.sizes))
+    findings = verify_memory_plan(g, plan, [ch], [AXIS.size])
+    assert [f.rule_id for f in findings] == ["MEM002"]
+    assert "rounded up" in findings[0].message
+
+
+def test_mem002_catches_fractional_float_sizing():
+    """The pre-fix `_sharded_bytes` divided bytes by the axis size even
+    when the dim does not divide: a plan sized that way must fire."""
+    g = MetaGraph("frac")
+    xv = MetaVar("x", (6, 4), "float32")  # 6 % 4 != 0
+    yv = MetaVar("y", (6, 4), "float32")
+    nx = MetaNode("in_x", "placeholder", [], [xv], is_input=True)
+    n0 = MetaNode("op0", "tanh", [xv], [yv])
+    g.add_input(nx)
+    g.add_op(n0)
+    g.outputs = [yv]
+    ch = {"in_x": NodeStrategy([], [S(0)]),
+          "op0": NodeStrategy([S(0)], [S(0)])}
+    plan = plan_graph_memory(g, [ch], [AXIS.size])
+    # satellite fix: ceil(6/4)=2 rows of 4 floats -> 32 bytes, integer
+    for i, name in enumerate(plan.var_names):
+        assert int(plan.sizes[i]) == 32, (name, plan.sizes[i])
+    assert verify_memory_plan(g, plan, [ch], [AXIS.size]) == []
+    # the legacy fractional sizing (6*4*4/4 = 24) is flagged
+    plan.sizes[0] = 24
+    findings = verify_memory_plan(g, plan, [ch], [AXIS.size])
+    assert [f.rule_id for f in findings] == ["MEM002"]
+
+
+def test_mem003_overlapping_offsets_fire_once():
+    g, ch, plan = make_plan()
+    # slide x onto w's address: they coexist at step 0 and nothing else
+    # shares that address window, so exactly one overlap pair fires
+    i, j = plan.var_names.index("w"), plan.var_names.index("x")
+    plan.offsets[j] = plan.offsets[i]
+    plan.peak_bytes = int(np.max(plan.offsets + plan.sizes))
+    findings = verify_memory_plan(g, plan, [ch], [AXIS.size])
+    assert [f.rule_id for f in findings] == ["MEM003"]
+    assert "overlaps" in findings[0].message
+
+
+def test_mem003_peak_below_live_lower_bound_fires():
+    g, ch, plan = make_plan()
+    plan.peak_bytes = plan.peak_live_bytes - 1
+    findings = verify_memory_plan(g, plan, [ch], [AXIS.size])
+    rules = [f.rule_id for f in findings]
+    assert rules.count("MEM003") == len(rules) >= 1
+    assert any("lower" in f.message for f in findings)
+
+
+# ------------------------------------------------------------------ MEM004
+
+@pytest.fixture
+def budget_knobs(monkeypatch):
+    yield monkeypatch
+
+
+def test_mem004_budget_gate_fires_with_sufficient_advisory():
+    g, ch, plan = make_plan()
+    # `a` spans the peak step strictly (produced op0, last read op3) with
+    # a flat producer: the advisory must name it and declare sufficiency
+    budget = plan.peak_bytes - int(plan.sizes[plan.var_names.index("a")])
+    findings = check_hbm_budget(g, plan, budget)
+    assert [f.rule_id for f in findings] == ["MEM004"]
+    msg = findings[0].message
+    assert "advisory" in msg and "a(" in msg
+    assert "sufficient to fit" in msg
+
+
+def test_mem004_clean_under_budget():
+    g, ch, plan = make_plan()
+    assert check_hbm_budget(g, plan, plan.peak_bytes) == []
+    assert check_hbm_budget(g, plan, 0) == []  # 0 disables
+
+
+def test_mem004_advisory_ranking_prefers_cheap_bytes():
+    """Two candidates spanning the peak: the advisory must list the
+    larger-bytes-per-recompute-second one (cheap tanh) before the
+    FLOP-heavy dot of equal size — remat.py's ranking."""
+    g = MetaGraph("rank")
+    xv = MetaVar("x", (64, 64), "float32")
+    a = MetaVar("a", (64, 64), "float32")   # cheap producer (tanh)
+    b = MetaVar("b", (64, 64), "float32")   # expensive producer (dot)
+    c = MetaVar("c", (64, 64), "float32")
+    d = MetaVar("d", (64, 64), "float32")
+    nx = MetaNode("in_x", "placeholder", [], [xv], is_input=True)
+    n0 = MetaNode("op0", "tanh", [xv], [a])
+    n1 = MetaNode("op1", "dot_general", [xv, xv], [b])
+    n1.flops = 2.0 * 64 * 64 * 64
+    n2 = MetaNode("op2", "tanh", [xv], [c])
+    n3 = MetaNode("op3", "add", [a, b], [d])
+    g.add_input(nx)
+    for n in (n0, n1, n2, n3):
+        g.add_op(n)
+    g.outputs = [d]
+    ch = {n.name: NodeStrategy([R()] * len(n.invars),
+                               [R()] * len(n.outvars))
+          for n in (nx, n0, n1, n2, n3)}
+    plan = plan_graph_memory(g, [ch], [1])
+    advisory = remat_advisory(g, plan, budget=1)
+    assert advisory.index("a(") < advisory.index("b(")
+
+
+def test_resolve_hbm_budget_knobs(monkeypatch):
+    monkeypatch.setattr(edconfig, "analyze_hbm_budget", 12345)
+    assert resolve_hbm_budget() == 12345
+    monkeypatch.setattr(edconfig, "analyze_hbm_budget", 0)
+    assert resolve_hbm_budget() == 0
+    monkeypatch.setattr(edconfig, "analyze_hbm_budget", -1)
+    # no mesh: platform default (v5e capacity)
+    assert resolve_hbm_budget() == edconfig.hbm_capacity_default
+
+
+# ------------------------------------------------------------------ MEM005
+
+def test_mem005_fixtures():
+    import jax
+    import jax.numpy as jnp
+
+    from easydist_tpu.analyze import audit_remat_plan
+    from easydist_tpu.schedule.remat import RematPlan
+
+    def f(x):
+        h = jnp.tanh(x)
+        s = jax.lax.scan(lambda c, _: (c * 1.5, None), h, None, length=3)[0]
+        return (h + s).sum()
+
+    closed = jax.make_jaxpr(f)(jnp.ones((4, 4)))
+    scan_idx = next(i for i, e in enumerate(closed.jaxpr.eqns)
+                    if e.primitive.name == "scan")
+    tanh_idx = next(i for i, e in enumerate(closed.jaxpr.eqns)
+                    if e.primitive.name == "tanh")
+
+    def plan(recompute):
+        return RematPlan(recompute=recompute, base_peak=100,
+                         predicted_peak=50)
+
+    # clean: flat chain, topological, lowering peak
+    assert audit_remat_plan(closed, plan({scan_idx + 1: [tanh_idx]})) == []
+
+    # a scan in the chain: non-flat primitive
+    findings = audit_remat_plan(closed, plan({scan_idx + 1: [scan_idx]}))
+    assert [f_.rule_id for f_ in findings] == ["MEM005"]
+    assert "non-flat" in findings[0].message
+
+    # chain does not precede its consumer
+    findings = audit_remat_plan(closed, plan({tanh_idx: [tanh_idx]}))
+    assert [f_.rule_id for f_ in findings] == ["MEM005"]
+    assert "precede" in findings[0].message
+
+    # rewrite that does not lower the peak
+    bad = RematPlan(recompute={scan_idx + 1: [tanh_idx]}, base_peak=100,
+                    predicted_peak=100)
+    findings = audit_remat_plan(closed, bad)
+    assert [f_.rule_id for f_ in findings] == ["MEM005"]
+    assert "lower" in findings[0].message
+
+    # emitted program without the CSE barrier
+    findings = audit_remat_plan(closed, plan({scan_idx + 1: [tanh_idx]}),
+                                traced=closed)
+    assert [f_.rule_id for f_ in findings] == ["MEM005"]
+    assert "optimization_barrier" in findings[0].message
+
+
+def test_mem005_barrier_detected_in_emitted_program():
+    import jax
+    import jax.numpy as jnp
+
+    from easydist_tpu.analyze import audit_remat_plan
+    from easydist_tpu.schedule.remat import RematPlan
+
+    def f(x):
+        return jnp.tanh(jax.lax.optimization_barrier(x)).sum()
+
+    closed = jax.make_jaxpr(f)(jnp.ones((4,)))
+    plan = RematPlan(recompute={1: [0]}, base_peak=100, predicted_peak=50)
+    # chain eqn 0 is the barrier itself: flat, precedes consumer, barrier
+    # present in the traced program -> clean
+    assert audit_remat_plan(closed, plan, traced=closed) == []

@@ -1,0 +1,173 @@
+"""Layer-3b golden fixtures: seeded mutations of the 1F1B/GPipe tick
+tables, each firing exactly one SCHED rule, with zero false positives on
+the real schedules across a (stages, virtual, microbatches) grid —
+including the presets bench.py --analyze and the dryrun gate run."""
+
+import numpy as np
+import pytest
+
+from easydist_tpu import config as edconfig
+from easydist_tpu.analyze import (AnalysisError, check_schedule_tables,
+                                  gpipe_schedule_tables, schedule_stats,
+                                  verify_schedule_tables)
+from easydist_tpu.parallel.pipeline import _1f1b_schedule_tables
+
+
+def copy_tables(t):
+    return {k: (v.copy() if hasattr(v, "copy") else v) for k, v in t.items()}
+
+
+def errors(findings):
+    return [f for f in findings if f.severity == "error"]
+
+
+@pytest.mark.parametrize("S,V,M", [
+    (2, 1, 2), (2, 1, 4), (4, 1, 8), (4, 2, 8), (3, 2, 6), (8, 1, 16),
+])
+def test_real_1f1b_tables_clean(S, V, M):
+    t = _1f1b_schedule_tables(S, V, M)
+    assert errors(verify_schedule_tables(t, S, V, M)) == []
+    tf = _1f1b_schedule_tables(S, V, M, fwd_only=True)
+    assert errors(verify_schedule_tables(tf, S, V, M, fwd_only=True)) == []
+
+
+def test_real_gpipe_tables_clean():
+    t = gpipe_schedule_tables(4, 8)
+    assert verify_schedule_tables(t, 4, 1, 8, fwd_only=True) == []
+
+
+def test_sched001_dependency_violation_fires_once():
+    t = copy_tables(_1f1b_schedule_tables(4, 2, 8))
+    # move stage 1's fwd of microbatch 0 one supertick early: it now runs
+    # in the same tick stage 0 produces its input (ppermute needs +1)
+    assert t["f_ok"][1, 1] and t["k_f"][1, 1] == 0 and t["m_f"][1, 1] == 0
+    t["f_ok"][1, 1] = False
+    t["f_ok"][0, 1] = True
+    t["m_f"][0, 1] = 0
+    t["k_f"][0, 1] = 0
+    findings = verify_schedule_tables(t, 4, 2, 8)
+    assert [f.rule_id for f in findings] == ["SCHED001"]
+    assert "has not arrived" in findings[0].message
+
+
+def test_sched001_unit_never_scheduled_fires():
+    t = copy_tables(_1f1b_schedule_tables(4, 1, 8))
+    # drop stage 3's backward of microbatch 2 entirely (starvation: its
+    # cotangent never enters the ring and downstream stages stall)
+    hit = [(u, s) for u in range(t["b_ok"].shape[0]) for s in range(4)
+           if t["b_ok"][u, s] and t["k_b"][u, s] * 4 + s == 3
+           and t["m_b"][u, s] == 2]
+    assert len(hit) == 1
+    t["b_ok"][hit[0]] = False
+    findings = verify_schedule_tables(t, 4, 1, 8)
+    assert [f.rule_id for f in findings] == ["SCHED001"]
+    assert "never scheduled" in findings[0].message
+
+
+def test_sched001_double_booking_fires():
+    t = copy_tables(_1f1b_schedule_tables(2, 1, 4))
+    # clone stage 0's fwd(m=0) into a free later slot: scheduled twice
+    free = [(u, 0) for u in range(t["f_ok"].shape[0])
+            if not t["f_ok"][u, 0]]
+    u, s = free[-1]
+    t["f_ok"][u, s] = True
+    t["m_f"][u, s] = 0
+    t["k_f"][u, s] = 0
+    findings = verify_schedule_tables(t, 2, 1, 4)
+    assert [f.rule_id for f in findings] == ["SCHED001"]
+    assert "twice" in findings[0].message
+
+
+def test_sched002_ring_too_small_fires_once():
+    t = copy_tables(_1f1b_schedule_tables(4, 1, 8))
+    assert t["ring"] == min(2 * 4 - 1, 8)
+    t["ring"] -= 1  # one slot short: a live residual gets overwritten
+    findings = verify_schedule_tables(t, 4, 1, 8)
+    assert [f.rule_id for f in findings] == ["SCHED002"]
+    assert "overwritten" in findings[0].message
+
+
+def test_sched002_stash_over_1f1b_bound_fires_once():
+    # a dependency-CONSISTENT gpipe-style schedule in the 1f1b table form:
+    # every backward waits for all forwards, so each stage stashes all M
+    # microbatches — past min(2*(J-j)-1, M) for every stage but 0.  The
+    # ring is sized to M so only the theoretical bound fires.
+    S = J = 4
+    M = 8
+    base = _1f1b_schedule_tables(S, 1, M)
+    U0 = int(np.asarray(base["f_ok"]).shape[0])
+    U = U0 + M * J + 1
+    t = {"n_superticks": U, "ring": M}
+    for key in ("m_f", "k_f", "m_b", "k_b"):
+        t[key] = np.zeros((U, S), np.int32)
+    for key in ("f_ok", "b_ok"):
+        t[key] = np.zeros((U, S), bool)
+    t["m_f"][:U0] = base["m_f"]
+    t["k_f"][:U0] = base["k_f"]
+    t["f_ok"][:U0] = base["f_ok"]
+    for m in range(M):
+        for j in range(J):  # bwd ripples J-1 -> 0, one tick per hop
+            t["b_ok"][U0 + m * J + (J - 1 - j), j] = True
+            t["m_b"][U0 + m * J + (J - 1 - j), j] = m
+    # the stretched clock is legitimately bubbly; silence the SCHED003
+    # report to pin the stash rule alone
+    findings = verify_schedule_tables(t, S, 1, M, bubble_warn_frac=1.0)
+    assert [f.rule_id for f in findings] == ["SCHED002"]
+    assert "1F1B" in findings[0].message
+
+
+def test_sched003_bubble_report_and_threshold(monkeypatch):
+    # S=4, M=2, V=1: U = 2S-2+M = 8 superticks, 4 useful of 16 slots per
+    # device half-pair -> bubble 0.75
+    t = _1f1b_schedule_tables(4, 1, 2)
+    stats = schedule_stats(t)
+    assert stats["bubble_fraction"] == pytest.approx(0.75)
+    monkeypatch.setattr(edconfig, "analyze_bubble_warn_frac", 0.7)
+    findings = verify_schedule_tables(t, 4, 1, 2)
+    assert [f.rule_id for f in findings] == ["SCHED003"]
+    assert findings[0].severity == "warning"
+    # generous threshold: report stays quiet
+    monkeypatch.setattr(edconfig, "analyze_bubble_warn_frac", 0.9)
+    assert verify_schedule_tables(t, 4, 1, 2) == []
+
+
+def test_stash_equals_theoretical_bound_on_real_tables():
+    """The real schedule's ring is exactly the worst-stage 1F1B bound
+    min(2*(J-j)-1, M) — affirmative evidence the SCHED002 bound is tight,
+    not merely respected."""
+    for S, V, M in ((2, 1, 4), (4, 1, 8), (4, 2, 8)):
+        t = _1f1b_schedule_tables(S, V, M)
+        assert t["ring"] == min(2 * V * S - 1, M)
+
+
+def test_check_schedule_tables_hook_raises_and_demotes(monkeypatch):
+    t = copy_tables(_1f1b_schedule_tables(4, 1, 8))
+    t["ring"] -= 1
+    with pytest.raises(AnalysisError, match="SCHED002"):
+        check_schedule_tables(t, 4, 1, 8)
+    monkeypatch.setattr(edconfig, "analyze_raise", False)
+    check_schedule_tables(t, 4, 1, 8)  # demoted to logging
+    monkeypatch.setattr(edconfig, "analyze_raise", True)
+    check_schedule_tables(_1f1b_schedule_tables(4, 1, 8), 4, 1, 8)  # clean
+
+
+def test_builders_run_the_hook(monkeypatch):
+    """`_1f1b_schedule_tables` itself verifies what it builds (the
+    build-time lint wired into parallel/pipeline.py): poison the verifier
+    and the builder must raise."""
+    import easydist_tpu.analyze as analyze_mod
+
+    calls = []
+    real = analyze_mod.check_schedule_tables
+
+    def spy(tables, *a, **kw):
+        calls.append(a)
+        return real(tables, *a, **kw)
+
+    monkeypatch.setattr(analyze_mod, "check_schedule_tables", spy)
+    _1f1b_schedule_tables(2, 1, 2)
+    assert calls, "builder did not invoke the schedule lint hook"
+    monkeypatch.setattr(edconfig, "enable_analyze", False)
+    calls.clear()
+    _1f1b_schedule_tables(2, 1, 2)
+    assert not calls, "EASYDIST_ANALYZE=0 must skip the hook"

@@ -1,0 +1,80 @@
+"""`schedule/memory_planner.py` sizing semantics (the MEM002 substrate):
+integer per-device bytes, shard dims rounded up in ELEMENTS on
+non-divisible splits, outputs pinned live to the program end."""
+
+import numpy as np
+
+from easydist_tpu.metashard.metair import (MetaGraph, MetaNode, MetaVar,
+                                           NodeStrategy, Placement)
+from easydist_tpu.schedule import plan_graph_memory
+from easydist_tpu.schedule.memory_planner import _sharded_bytes
+
+R = Placement.replicate
+S = Placement.shard
+
+
+def test_sharded_bytes_integer_and_exact():
+    v = MetaVar("v", (64, 32), "float32")
+    got = _sharded_bytes(v, [S(0)], [8])
+    assert isinstance(got, int)
+    assert got == 64 // 8 * 32 * 4
+
+
+def test_sharded_bytes_rounds_up_indivisible_dims():
+    # 6 rows over 4 devices: the widest device holds ceil(6/4)=2 rows
+    v = MetaVar("v", (6, 4), "float32")
+    assert _sharded_bytes(v, [S(0)], [4]) == 2 * 4 * 4
+    # two axes sharding different dims compose; 4 cols over 8 -> 1 col
+    assert _sharded_bytes(v, [S(0), S(1)], [4, 8]) == 2 * 1 * 4
+    # a shard dim past the rank is ignored (STRAT002's job to flag)
+    assert _sharded_bytes(v, [S(5)], [4]) == 6 * 4 * 4
+
+
+def test_sharded_bytes_dtype_itemsize():
+    v16 = MetaVar("v", (8, 8), "bfloat16")
+    assert _sharded_bytes(v16, [None], [2]) == 8 * 8 * 2
+    v8 = MetaVar("v", (8, 8), "int8")
+    assert _sharded_bytes(v8, [S(0)], [2]) == 4 * 8 * 1
+
+
+def build_graph(shape=(6, 4)):
+    g = MetaGraph("plan")
+    xv = MetaVar("x", shape, "float32")
+    yv = MetaVar("y", shape, "float32")
+    nx = MetaNode("in_x", "placeholder", [], [xv], is_input=True)
+    n0 = MetaNode("op0", "tanh", [xv], [yv])
+    g.add_input(nx)
+    g.add_op(n0)
+    g.outputs = [yv]
+    return g
+
+
+def test_plan_sizes_are_integer_bytes_on_indivisible_shards():
+    g = build_graph()
+    ch = {"in_x": NodeStrategy([], [S(0)]),
+          "op0": NodeStrategy([S(0)], [S(0)])}
+    plan = plan_graph_memory(g, [ch], [4])
+    assert plan.sizes.dtype == np.int64
+    for i, name in enumerate(plan.var_names):
+        assert int(plan.sizes[i]) == 2 * 4 * 4, (name, plan.sizes[i])
+    # exact skyline: two disjoint-in-address live buffers
+    assert plan.validate() == []
+    assert plan.peak_bytes == 2 * (2 * 4 * 4)
+
+
+def test_input_escaping_as_output_pinned_to_end():
+    """An input var that IS a graph output stays live to the final op."""
+    g = MetaGraph("thread")
+    xv = MetaVar("x", (4, 4), "float32")
+    av = MetaVar("a", (4, 4), "float32")
+    bv = MetaVar("b", (4, 4), "float32")
+    nx = MetaNode("in_x", "placeholder", [], [xv], is_input=True)
+    n0 = MetaNode("op0", "tanh", [xv], [av])
+    n1 = MetaNode("op1", "tanh", [av], [bv])
+    g.add_input(nx)
+    g.add_op(n0)
+    g.add_op(n1)
+    g.outputs = [bv, xv]  # x escapes unchanged (state passthrough)
+    plan = plan_graph_memory(g, [{}], [1])
+    i = plan.var_names.index("x")
+    assert int(plan.ends[i]) == 1  # pinned to the last op, not op0

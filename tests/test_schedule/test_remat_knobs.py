@@ -1,0 +1,115 @@
+"""`schedule/remat.py` config-knob coverage (satellite of the analyze
+layer-3 PR): the chain-length cap is respected, candidates are taken in
+largest-bytes-per-recompute-second order, the FLOP proxy prices dots by
+their contraction, and planning is deterministic."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from easydist_tpu import config as edconfig
+from easydist_tpu.jaxfront.interpreter import VarNames
+from easydist_tpu.schedule.remat import (_eqn_flops, candidate_score,
+                                         plan_remat)
+
+
+@pytest.fixture
+def knobs(monkeypatch):
+    return monkeypatch
+
+
+def _plan(closed, cap):
+    names = VarNames()
+    for v in closed.jaxpr.invars:
+        names.name(v)
+    return plan_remat(closed, names, [{}], [1], cap, {})
+
+
+def make_program():
+    """Two equal-size 256KB activations span the peak: `a` rebuilds from a
+    1KB vector through broadcast+tanh (cheap), `b` through broadcast+dot
+    (expensive).  The bytes-per-recompute-second ranking must evict `a`
+    and stop; its far consumer is a dot so the XLA-fusion sizing model
+    keeps both charged."""
+    xs = jnp.ones((256,), jnp.float32)
+    w = jnp.eye(256, dtype=jnp.float32)
+
+    def f(xs, w):
+        a = jnp.tanh(jnp.broadcast_to(xs, (256, 256)))
+        b = jnp.broadcast_to(xs, (256, 256)) @ w
+        big = jnp.concatenate([w, w], 0)
+        big2 = jnp.concatenate([big, big], 0)
+        r = big2.sum()
+        ya = (a @ w).sum()
+        yb = (b @ w).sum()
+        return r + ya + yb
+
+    return jax.make_jaxpr(f)(xs, w)
+
+
+def test_candidates_ordered_by_bytes_per_recompute_second():
+    closed = make_program()
+    probe = _plan(closed, 1)  # impossible cap: exposes the base peak
+    assert probe is not None and probe.base_peak > 0
+    cap = probe.base_peak - 50_000  # one 256KB eviction suffices
+    plan = _plan(closed, cap)
+    assert plan is not None and plan.predicted_peak <= cap
+    # the cheap candidate won: every recomputed chain is broadcast/tanh,
+    # never the dot that rebuilds `b`
+    prims = {closed.jaxpr.eqns[e].primitive.name
+             for ch in plan.recompute.values() for e in ch}
+    assert "dot_general" not in prims, prims
+    assert plan.n_remat_vars == 1
+
+
+def test_candidate_score_metric():
+    assert candidate_score(100.0, 1.0) > candidate_score(100.0, 2.0)
+    assert candidate_score(200.0, 1.0) > candidate_score(100.0, 1.0)
+    # the epsilon keeps zero-cost chains finite
+    assert candidate_score(100.0, 0.0) == pytest.approx(100.0 / 1e-6)
+
+
+def test_chain_length_cap_respected(knobs):
+    closed = make_program()
+    cap = _plan(closed, 1).base_peak - 50_000
+    # `a`'s chain needs 2 equations (broadcast + tanh): a cap of 1 bans it
+    # (and everything else), so planning finds nothing
+    knobs.setattr(edconfig, "remat_max_chain_len", 1)
+    assert _plan(closed, cap) is None
+    knobs.setattr(edconfig, "remat_max_chain_len", 96)
+    assert _plan(closed, cap) is not None
+
+
+def test_plan_deterministic():
+    closed = make_program()
+    cap = _plan(closed, 1).base_peak - 50_000
+    p1, p2 = _plan(closed, cap), _plan(closed, cap)
+    assert p1.recompute == p2.recompute
+    assert p1.overlay_last_use == p2.overlay_last_use
+    assert p1.predicted_peak == p2.predicted_peak
+
+
+def test_eqn_flops_proxy():
+    def f(x, w):
+        return jnp.tanh(x @ w)
+
+    closed = jax.make_jaxpr(f)(jnp.ones((8, 16)), jnp.ones((16, 4)))
+    eqns = {e.primitive.name: e for e in closed.jaxpr.eqns}
+    # dot: 2*M*N*K over the contraction recorded in dimension_numbers
+    assert _eqn_flops(eqns["dot_general"]) == 2.0 * (8 * 4) * 16
+    # elementwise: one VPU op per output element
+    assert _eqn_flops(eqns["tanh"]) == 8 * 4
+
+
+def test_flop_proxy_drives_seconds(knobs):
+    """Halving peak_flops doubles every chain's priced seconds — the knob
+    reaches the planner's cost dimension."""
+    closed = make_program()
+    cap = _plan(closed, 1).base_peak - 50_000
+    knobs.setattr(edconfig, "use_op_cost_db", False)
+    knobs.setattr(edconfig, "peak_flops", 1e12)
+    s1 = _plan(closed, cap).recompute_seconds
+    knobs.setattr(edconfig, "peak_flops", 5e11)
+    s2 = _plan(closed, cap).recompute_seconds
+    assert s1 > 0
+    assert s2 == pytest.approx(2.0 * s1)
