@@ -36,6 +36,8 @@ from .jaxpr_rules import lint_bucket_plan, lint_fn, lint_jaxpr
 from .memory_rules import (audit_remat_plan, check_hbm_budget,
                            recompute_liveness, remat_advisory,
                            resolve_hbm_budget, verify_memory_plan)
+from .overlap_rules import (lint_overlap_fn, lint_overlap_jaxpr,
+                            lint_overlap_plan)
 from .schedule_rules import (gpipe_schedule_tables, schedule_stats,
                              verify_schedule_tables)
 from .strategy_rules import audit_solver_objective, verify_axis
@@ -50,6 +52,8 @@ __all__ = [
     "recompute_liveness", "remat_advisory", "resolve_hbm_budget",
     "verify_schedule_tables", "gpipe_schedule_tables", "schedule_stats",
     "check_schedule_tables",
+    "lint_overlap_plan", "lint_overlap_jaxpr", "lint_overlap_fn",
+    "check_overlap_plan",
 ]
 
 
@@ -59,6 +63,23 @@ def check_bucket_plan(leaves, buckets) -> None:
     from easydist_tpu import config as edconfig
 
     findings = lint_bucket_plan(leaves, buckets)
+    if not findings:
+        return
+    report = AnalysisReport(findings)
+    if edconfig.analyze_raise:
+        report.raise_on_errors()
+    for f in findings:
+        logger.warning("[analyze] %s", f)
+
+
+def check_overlap_plan(leaves, order, buckets=None) -> None:
+    """Trace-time self-check hook for `comm.overlap`: validate the
+    emission-order permutation and the reordered bucket plan, raising (or
+    logging, with the escape hatch) on error findings.  `leaves` are the
+    ORDERED leaves when `buckets` is given."""
+    from easydist_tpu import config as edconfig
+
+    findings = lint_overlap_plan(leaves, order, buckets)
     if not findings:
         return
     report = AnalysisReport(findings)

@@ -86,6 +86,19 @@ RULES: Dict[str, tuple] = {
                  "microbatches exceed the residual ring or the 1F1B limit"),
     "SCHED003": (SEV_WARNING,
                  "pipeline bubble fraction above the report threshold"),
+    # ---- layer 2b: overlapped-flush verifier (comm/overlap.py plans &
+    #      isolated flush programs)
+    "OVL001": (SEV_ERROR,
+               "emission order is not a permutation of the gradient "
+               "leaves (a reordered flush would drop/duplicate leaves)"),
+    "OVL002": (SEV_ERROR,
+               "overlapped flush chain unpinned: consecutive reducing "
+               "collectives have no ordering dependency (the "
+               "optimization_barrier token chain is broken)"),
+    "OVL003": (SEV_WARNING,
+               "predict_comm_overlap is on without a measured overlap "
+               "fraction for this backend (discount rests on the flat "
+               "config guess)"),
 }
 
 

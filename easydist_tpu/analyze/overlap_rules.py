@@ -1,0 +1,117 @@
+"""Layer 2b: overlapped-flush verifier (the OVL rule family).
+
+`comm.overlap` reorders gradient buckets into backward emission order and
+chains their collectives through `optimization_barrier` tokens.  Two
+things can silently go wrong with that transformation, and both are
+statically checkable:
+
+  OVL001  the emission order is not a permutation of the flat leaves —
+          a reordered flush would then drop some gradients and duplicate
+          others at unpack;
+  OVL002  the barrier token chain is broken: two consecutive reducing
+          collectives in the flush have NO ordering dependency (neither a
+          barrier token nor a data dependence), so XLA is free to clump
+          them back into one post-backward group and the overlap is lost.
+
+OVL002 is scoped to ISOLATED flush programs (a traced
+`overlapped_reduce_gradients` / `chain_leaf_reduces` call): a whole train
+step legitimately contains unchained collectives (the loss pmean), so
+linting it here would be all false positives — whole-program collective
+linting stays with layer 2 (`jaxpr_rules`).
+
+OVL003 (warning) is emitted by the compile pipeline (`jaxfront.api`), not
+here: it flags `predict_comm_overlap` running on the flat config guess
+rather than a `runtime.calibrate.calibrate_overlap` measurement.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from .findings import Finding, make_finding
+from .jaxpr_rules import _REDUCING_COLLECTIVES, lint_bucket_plan
+
+
+def lint_overlap_plan(leaves: Sequence, order: Sequence[int],
+                      buckets: Optional[Sequence] = None,
+                      node: str = "overlap") -> List[Finding]:
+    """Validate an overlapped-flush plan: the emission order must permute
+    the leaves (OVL001) and, when given, the bucket plan over the ORDERED
+    leaves must tile exactly (COLL003 via `lint_bucket_plan`)."""
+    findings: List[Finding] = []
+    n = len(leaves)
+    try:
+        perm = sorted(int(i) for i in order) == list(range(n))
+    except (TypeError, ValueError):
+        perm = False
+    if not perm:
+        findings.append(make_finding(
+            "OVL001", node,
+            f"order {list(order)[:16]}{'...' if len(list(order)) > 16 else ''} "
+            f"is not a permutation of range({n})"))
+        return findings  # bucket indices are meaningless under a bad order
+    if buckets is not None:
+        findings.extend(lint_bucket_plan(leaves, buckets))
+    return findings
+
+
+def _ancestor_eqns(jaxpr):
+    """eqn index -> set of transitively reachable producer eqn indices."""
+    producer = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for ov in eqn.outvars:
+            producer[ov] = i
+    cache: dict = {}
+
+    def ancestors(i: int) -> set:
+        got = cache.get(i)
+        if got is not None:
+            return got
+        out: set = set()
+        cache[i] = out  # jaxprs are DAGs; placeholder guards re-entry
+        for v in jaxpr.eqns[i].invars:
+            if hasattr(v, "val"):  # literal
+                continue
+            j = producer.get(v)
+            if j is not None:
+                out.add(j)
+                out |= ancestors(j)
+        return out
+
+    return ancestors
+
+
+def lint_overlap_jaxpr(jaxpr, node: str = "overlap") -> List[Finding]:
+    """OVL002 over an ISOLATED flush jaxpr: every pair of consecutive
+    reducing collectives must be ordered by a dependency path (the barrier
+    token chain, or a direct data dependence).  An unordered pair means
+    the pin was dropped and the latency-hiding schedule is not the one
+    the cost model was calibrated against."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)  # ClosedJaxpr -> core jaxpr
+    reducing = [i for i, eqn in enumerate(jaxpr.eqns)
+                if eqn.primitive.name in _REDUCING_COLLECTIVES]
+    if len(reducing) < 2:
+        return []
+    ancestors = _ancestor_eqns(jaxpr)
+    findings: List[Finding] = []
+    for a, b in zip(reducing, reducing[1:]):
+        if a not in ancestors(b):
+            pa = jaxpr.eqns[a].primitive.name
+            pb = jaxpr.eqns[b].primitive.name
+            findings.append(make_finding(
+                "OVL002", node,
+                f"consecutive reducing collectives eqn#{a} ({pa}) and "
+                f"eqn#{b} ({pb}) have no ordering dependency — the "
+                "optimization_barrier token chain is broken"))
+    return findings
+
+
+def lint_overlap_fn(fn, *args, axis_sizes=None, node: str = "overlap",
+                    **kwargs) -> List[Finding]:
+    """Trace `fn(*args, **kwargs)` (an isolated flush builder) under the
+    given axis environment and lint the chain structure (OVL002)."""
+    import jax
+
+    axis_env = list((axis_sizes or {}).items())
+    closed = jax.make_jaxpr(fn, axis_env=axis_env)(*args, **kwargs)
+    return lint_overlap_jaxpr(closed.jaxpr, node=node)

@@ -61,6 +61,38 @@ def _all_to_all(x: float, n: int) -> float:
     return factor * x * (n - 1) / (n * n)
 
 
+def overlap_ratio_is_measured() -> bool:
+    """True when a runtime-measured overlap fraction is available for this
+    backend (runtime.calibrate.calibrate_overlap ran, or apply_calibration
+    loaded one from the PerfDB)."""
+    return edconfig.comm_overlap_ratio_measured is not None
+
+
+def overlap_discount_ratio() -> float:
+    """The comm/compute overlap fraction the solver may discount
+    reduction-edge costs by, resolved per `comm_overlap_ratio_source`:
+
+      "auto"      the MEASURED fraction when one exists for this backend,
+                  else the configured `comm_overlap_ratio` guess;
+      "measured"  only a measured fraction — 0.0 (discount off) until
+                  `runtime.calibrate.calibrate_overlap` has run, so an
+                  uncalibrated compile never trades real bytes for
+                  imagined overlap;
+      "config"    always the configured `comm_overlap_ratio` (the
+                  reference's flat-guess behavior).
+    """
+    source = (edconfig.comm_overlap_ratio_source or "auto").lower()
+    measured = edconfig.comm_overlap_ratio_measured
+    if source == "config":
+        ratio = edconfig.comm_overlap_ratio
+    elif source == "measured":
+        ratio = measured if measured is not None else 0.0
+    else:  # "auto"
+        ratio = measured if measured is not None \
+            else edconfig.comm_overlap_ratio
+    return float(min(max(ratio, 0.0), 1.0))
+
+
 def comm_compression_ratio() -> float:
     """Wire-bytes ratio of the configured gradient-collective compression
     (easydist_tpu.comm): 1.0 when off, 0.5 for bf16, ~0.26 for int8

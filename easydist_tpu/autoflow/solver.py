@@ -35,7 +35,8 @@ from scipy.optimize import Bounds, LinearConstraint, milp
 from easydist_tpu import config as edconfig
 from easydist_tpu.metashard.metair import (MetaGraph, NodeStrategy,
                                           Placement)
-from .cost_model import MeshAxisSpec, placement_bytes, resharding_cost
+from .cost_model import (MeshAxisSpec, overlap_discount_ratio,
+                         placement_bytes, resharding_cost)
 
 logger = logging.getLogger(__name__)
 
@@ -306,12 +307,16 @@ class SpmdSolver:
                 # as the independent compute can actually hide (the
                 # reference's flat discount, adjust_resharding_cost
                 # solver.py:79-84, fires on ANY parallel flops; here the
-                # hideable seconds bound the reduction per edge)
+                # hideable seconds bound the reduction per edge, and the
+                # ratio comes from overlap_discount_ratio(): the runtime-
+                # MEASURED fraction when calibrate_overlap has recorded
+                # one, else the configured guess (per
+                # comm_overlap_ratio_source)
+                ratio = overlap_discount_ratio()
                 hideable = self.reachability.independent_peer_seconds(
                     e.up_node.name, e.down_node.name)
-                if hideable > 0:
-                    comm = comm - edconfig.comm_overlap_ratio * \
-                        np.minimum(comm, hideable)
+                if hideable > 0 and ratio > 0:
+                    comm = comm - ratio * np.minimum(comm, hideable)
             e.comm, e.mem = comm, mem
 
     def _compute_tie_groups(self):

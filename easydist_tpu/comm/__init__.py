@@ -6,10 +6,15 @@ Layers:
   bucketer.py  fuse many small leaf collectives into few fixed-size buckets
   reduce.py    the grad-path entry points (DDP tree reduce, ZeRO leaf
                reduce_scatter, partial-region fences)
+  overlap.py   backward-ordered, barrier-pinned bucket flush and
+               double-buffered K-microbatch gradient accumulation
   counters.py  trace-time bytes/launch accounting, exported via PerfDB
 """
 
 from .bucketer import Bucket, bucketed_reduce, pack, plan_buckets, unpack  # noqa: F401
+from .overlap import (accumulate_gradients, chain_leaf_reduces,  # noqa: F401
+                      grad_emission_order, overlapped_reduce_gradients,
+                      schedulable_overlap_fraction)
 from .counters import (CommCounters, comm_counters,  # noqa: F401
                        ring_all_gather_bytes, ring_all_reduce_bytes,
                        ring_reduce_scatter_bytes)

@@ -1,0 +1,383 @@
+"""Overlapped gradient collectives: backward-ordered bucket flush and
+double-buffered gradient accumulation.
+
+PR 2's `reduce_gradients` packs leaves into buckets in FLAT TREE order and
+reduces them all after backward finishes, so on a real mesh every wire
+second is exposed.  This module closes that gap in two ways:
+
+  * `overlapped_reduce_gradients` plans buckets over the gradients'
+    EMISSION order in the backward jaxpr (last layer's grads come first)
+    and launches the per-bucket collectives as a chain pinned with
+    `jax.lax.optimization_barrier`.  The chain serializes the collectives
+    against each other — matching the one-channel reality of a ring — but
+    leaves them data-independent from the *rest* of the program, so XLA's
+    latency-hiding scheduler can slide each reduce under whatever backward
+    compute is still outstanding.  Values are bitwise-identical to the
+    sequential flush when quantization is off (pmean/psum are elementwise;
+    pack/unpack and the barrier are bit-preserving), which is what the
+    OVL parity gates in tests/ and `__graft_entry__` assert.
+
+  * `accumulate_gradients` builds a K-microbatch step where microbatch
+    k's backward overlaps the reduction of microbatch k-1's gradients: a
+    `lax.scan` whose carry holds the in-flight (not yet reduced) gradient
+    tree.  The fold order of the accumulator is identical between the
+    overlapped and sequential variants, so the two are bitwise-equal with
+    quantization off.  `parallel/dp.py` exposes this as the opt-in
+    ``grad_accum_microbatches`` knob on ddp/zero2/zero3.
+
+The achieved overlap is *measured* by `runtime.calibrate.calibrate_overlap`
+and fed back into the solver through `autoflow.cost_model.
+overlap_discount_ratio` — see docs/COMM.md ("Overlapped flush").
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from easydist_tpu import config as edconfig
+
+from .bucketer import pack, plan_buckets, unpack
+from .quant import leaf_quantizable, quant_mode
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "grad_emission_order",
+    "overlapped_reduce_gradients",
+    "chain_leaf_reduces",
+    "accumulate_gradients",
+]
+
+
+# ----------------------------------------------------------- emission order
+
+def _call_jaxpr(eqn):
+    """The sub-jaxpr a call-like eqn (pjit/closed_call/remat/custom_vjp)
+    delegates to, or None for plain primitives."""
+    for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+        sub = eqn.params.get(key)
+        if sub is None:
+            continue
+        return getattr(sub, "jaxpr", sub)  # ClosedJaxpr -> core jaxpr
+    return None
+
+
+def _emission_keys(jaxpr, outvars, prefix=()):
+    """Per-outvar sort key: the (possibly nested) index of the producing
+    equation.  Vars produced by the same call-like eqn are disambiguated
+    by recursing into its sub-jaxpr; literals / free vars sort first."""
+    produced = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for ov in eqn.outvars:
+            if not hasattr(ov, "val"):  # skip literals
+                produced[ov] = i
+    keys: List[Optional[tuple]] = [None] * len(outvars)
+    groups = {}
+    for k, v in enumerate(outvars):
+        if hasattr(v, "val") or v not in produced:
+            keys[k] = prefix + (-1,)
+        else:
+            groups.setdefault(produced[v], []).append(k)
+    for i, idxs in groups.items():
+        eqn = jaxpr.eqns[i]
+        sub = _call_jaxpr(eqn)
+        if (sub is not None and len(idxs) > 1
+                and len(sub.outvars) == len(eqn.outvars)):
+            inner = []
+            for k in idxs:
+                pos = next(j for j, o in enumerate(eqn.outvars)
+                           if o is outvars[k])
+                inner.append(sub.outvars[pos])
+            for k, key in zip(idxs, _emission_keys(sub, inner, prefix + (i,))):
+                keys[k] = key
+        else:
+            for k in idxs:
+                keys[k] = prefix + (i,)
+    return keys
+
+
+def grad_emission_order(loss_fn: Callable, params, *batch) -> List[int]:
+    """Flat-leaf permutation of ``jax.grad(loss_fn)(params, *batch)``
+    sorted by gradient EMISSION order in the backward jaxpr.
+
+    The backward pass produces the LAST layer's gradients first, so for a
+    >=2-layer model this is not the identity permutation — flushing
+    buckets in this order lets the first collective launch while earlier
+    layers' backward compute is still running.  Traced abstractly
+    (ShapeDtypeStructs), no FLOPs spent; falls back to identity order if
+    the trace fails (custom pytrees, data-dependent control flow).
+    """
+    flat, _ = jax.tree_util.tree_flatten(params)
+    identity = list(range(len(flat)))
+    try:
+        abstract = jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(jnp.shape(l), jnp.result_type(l)),
+            (params, tuple(batch)))
+        closed = jax.make_jaxpr(
+            lambda p, b: jax.grad(loss_fn)(p, *b))(*abstract)
+        keys = _emission_keys(closed.jaxpr, closed.jaxpr.outvars)
+        order = sorted(identity, key=lambda k: (keys[k], k))
+    except Exception as exc:  # pragma: no cover - defensive
+        logger.warning("grad_emission_order: trace failed (%s); "
+                       "falling back to flat tree order", exc)
+        return identity
+    if sorted(order) != identity:  # pragma: no cover - defensive
+        logger.warning("grad_emission_order: non-permutation result; "
+                       "falling back to flat tree order")
+        return identity
+    return order
+
+
+def schedulable_overlap_fraction(loss_fn: Callable, params, *batch) -> float:
+    """Byte-weighted share of the gradient flush's collective traffic that
+    the backward-ordered chain launches while backward compute is still
+    OUTSTANDING — the program-structure upper bound on what a
+    latency-hiding backend can hide.
+
+    Leaf i's gradient is emitted at top-level equation e_i of the E-eqn
+    backward jaxpr, so when its reduce launches, a (E-1-e_i)/(E-1) share
+    of the backward pass has not yet run; that share (clamped to [0, 1])
+    is weighted by the leaf's wire bytes.  Deterministic — no timing, so
+    single-core CI hosts (where wall-clock concurrency is physically
+    zero) still gate on it; the MEASURED counterpart is
+    `runtime.profiler.measure_collective_overlap`.  The reference's
+    unordered post-backward flush scores exactly 0 by construction.
+    Returns 0.0 when the backward trace fails.
+    """
+    flat, _ = jax.tree_util.tree_flatten(params)
+    try:
+        abstract = jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(jnp.shape(l), jnp.result_type(l)),
+            (params, tuple(batch)))
+        closed = jax.make_jaxpr(
+            lambda p, b: jax.grad(loss_fn)(p, *b))(*abstract)
+        keys = _emission_keys(closed.jaxpr, closed.jaxpr.outvars)
+        n_eqns = len(closed.jaxpr.eqns)
+    except Exception as exc:
+        logger.warning("schedulable_overlap_fraction: trace failed (%s)",
+                       exc)
+        return 0.0
+    if n_eqns <= 1 or not flat or len(keys) != len(flat):
+        return 0.0
+    total = hideable = 0.0
+    for leaf, key in zip(flat, keys):
+        size = 1
+        for d in jnp.shape(leaf):
+            size *= d
+        nbytes = float(size * jnp.dtype(jnp.result_type(leaf)).itemsize)
+        remaining = (n_eqns - 1 - key[0]) / (n_eqns - 1)
+        total += nbytes
+        hideable += nbytes * min(max(remaining, 0.0), 1.0)
+    return hideable / total if total else 0.0
+
+
+def _valid_order(order, n: int) -> bool:
+    try:
+        return sorted(int(i) for i in order) == list(range(n))
+    except (TypeError, ValueError):
+        return False
+
+
+def _maybe_check(leaves, order, buckets) -> None:
+    if not edconfig.enable_analyze:
+        return
+    from easydist_tpu.analyze import check_overlap_plan
+
+    check_overlap_plan(leaves, order, buckets)
+
+
+# --------------------------------------------------------- overlapped flush
+
+def overlapped_reduce_gradients(grads, axis_name: str, axis_size: int,
+                                op: str = "pmean",
+                                emission_order: Optional[Sequence[int]] = None,
+                                pin_chain: bool = True):
+    """Backward-ordered, barrier-pinned bucket flush of a gradient pytree.
+
+    Buckets are planned over the leaves REORDERED by ``emission_order``
+    (from `grad_emission_order`; identity when None), then reduced as a
+    chain: bucket k's packed payload is fused with a one-element token of
+    bucket k-1's result through `optimization_barrier`, which (a) keeps
+    XLA from coalescing the collectives into one post-backward clump and
+    (b) leaves each reduce data-independent from the still-outstanding
+    backward compute so the latency-hiding scheduler can overlap them.
+
+    Value contract: bitwise-identical results to the sequential
+    `reduce_gradients` flush with quantization off, <= quantization error
+    otherwise — the reordering only changes WHEN bytes move, never what
+    is summed.  Runs INSIDE shard_map over ``axis_name``.
+    """
+    from .reduce import reduce_bucket_collective
+
+    if op not in ("pmean", "psum"):
+        raise ValueError(f"op={op!r}; expected pmean|psum")
+    mean = op == "pmean"
+    mode = quant_mode()
+
+    leaves_kp, tdef = jax.tree_util.tree_flatten_with_path(grads)
+    paths = [jax.tree_util.keystr(kp) for kp, _ in leaves_kp]
+    leaves = [leaf for _, leaf in leaves_kp]
+
+    order = list(emission_order) if emission_order is not None \
+        else list(range(len(leaves)))
+    if not _valid_order(order, len(leaves)):
+        # surface through the OVL lint (raises under analyze_raise) before
+        # the safe fallback so a corrupt plan cannot silently reorder
+        _maybe_check(leaves, order, None)
+        logger.warning("overlapped_reduce_gradients: emission_order is not "
+                       "a permutation of %d leaves; using flat tree order",
+                       len(leaves))
+        order = list(range(len(leaves)))
+
+    ordered_leaves = [leaves[i] for i in order]
+    ordered_flags = [leaf_quantizable(paths[i], leaves[i].size, mode)
+                     for i in order]
+    buckets = plan_buckets(ordered_leaves, edconfig.comm_bucket_bytes,
+                           ordered_flags)
+    _maybe_check(ordered_leaves, order, buckets)
+
+    reduced: List[Optional[jax.Array]] = [None] * len(leaves)
+    token = None
+    for bucket in buckets:
+        flat = pack(ordered_leaves, bucket)
+        if pin_chain and token is not None:
+            flat, token = jax.lax.optimization_barrier((flat, token))
+        out = reduce_bucket_collective(flat, bucket, axis_name, axis_size,
+                                       mean, mode)
+        token = out[:1]
+        for j, leaf in unpack(out, bucket, ordered_leaves).items():
+            reduced[order[j]] = leaf
+    return jax.tree_util.tree_unflatten(tdef, reduced)
+
+
+def chain_leaf_reduces(flat_leaves: Sequence, order: Sequence[int],
+                       reduce_leaf_fn: Callable, pin_chain: bool = True):
+    """Barrier-pinned chain over PER-LEAF reductions (the ZeRO paths,
+    where each leaf needs its own reduce_scatter-or-all-reduce choice and
+    bucket packing does not apply).
+
+    ``reduce_leaf_fn(i, leaf)`` performs leaf i's collective; leaves are
+    visited in ``order`` with each launch chained to the previous
+    result's one-element token.  Returns the reduced leaves in ORIGINAL
+    positions.
+    """
+    order = list(order)
+    if not _valid_order(order, len(flat_leaves)):
+        _maybe_check(list(flat_leaves), order, None)
+        logger.warning("chain_leaf_reduces: order is not a permutation of "
+                       "%d leaves; using flat tree order", len(flat_leaves))
+        order = list(range(len(flat_leaves)))
+    reduced: List[Optional[jax.Array]] = [None] * len(flat_leaves)
+    token = None
+    for i in order:
+        leaf = flat_leaves[i]
+        if pin_chain and token is not None:
+            leaf, token = jax.lax.optimization_barrier((leaf, token))
+        out = reduce_leaf_fn(i, leaf)
+        reduced[i] = out
+        token = jnp.ravel(out)[:1]
+    return reduced
+
+
+# ------------------------------------------- double-buffered accumulation
+
+def _split_microbatches(batch, n_micro: int):
+    split = []
+    for x in batch:
+        if x.shape[0] % n_micro:
+            raise ValueError(
+                f"grad_accum_microbatches={n_micro} does not divide the "
+                f"local batch dimension {x.shape[0]}")
+        split.append(x.reshape(n_micro, x.shape[0] // n_micro, *x.shape[1:]))
+    return tuple(split)
+
+
+def accumulate_gradients(loss_fn: Callable, params, batch: Sequence,
+                         *, axis_name: str, axis_size: int, n_micro: int,
+                         reduce_tree: Optional[Callable] = None,
+                         acc_shapes=None, overlapped: Optional[bool] = None,
+                         op: str = "pmean",
+                         emission_order: Optional[Sequence[int]] = None,
+                         pin_chain: bool = True):
+    """K-microbatch gradient accumulation with double-buffered reduction.
+
+    Splits each batch array's leading dim into ``n_micro`` slices and runs
+    a `lax.scan` whose carry holds the IN-FLIGHT gradient tree: iteration
+    k barrier-pins (inflight_{k-1}, microbatch_k) together, then computes
+    microbatch k's backward while reducing inflight_{k-1} — the two are
+    data-independent, so XLA overlaps the wire time of one microbatch
+    under the compute of the next.  ``reduce_tree(grads)`` defaults to
+    this module's overlapped flush (or the sequential `reduce_gradients`
+    when ``overlapped`` is False); ZeRO callers pass their own
+    reduce_tree plus ``acc_shapes`` (a ShapeDtypeStruct tree of its
+    output — reduce_scatter shrinks leaves, and calling the reducer on
+    placeholders here would pollute the trace-time comm counters).
+
+    Returns ``(mean_grads, mean_loss)`` — both averaged over the K
+    microbatches AFTER reduction, with an accumulator fold order chosen
+    to be identical between the overlapped and sequential variants
+    (bitwise-equal with quantization off).  Runs INSIDE shard_map.
+    """
+    if n_micro < 1:
+        raise ValueError(f"n_micro={n_micro}; expected >= 1")
+    if overlapped is None:
+        overlapped = bool(edconfig.comm_overlap)
+
+    mbs = _split_microbatches(batch, n_micro)
+    mb0 = tuple(x[0] for x in mbs)
+
+    if reduce_tree is None:
+        from .reduce import reduce_gradients
+
+        order = emission_order
+        if overlapped and order is None:
+            order = grad_emission_order(loss_fn, params, *mb0)
+
+        def reduce_tree(g):  # noqa: F811 - intentional default binding
+            if overlapped:
+                return overlapped_reduce_gradients(
+                    g, axis_name, axis_size, op=op, emission_order=order,
+                    pin_chain=pin_chain)
+            return reduce_gradients(g, axis_name, axis_size, op=op)
+
+    loss0, g0 = jax.value_and_grad(loss_fn)(params, *mb0)
+    if n_micro == 1:
+        return reduce_tree(g0), loss0
+
+    if acc_shapes is None:
+        acc = jax.tree_util.tree_map(
+            lambda g: jnp.zeros(jnp.shape(g), jnp.result_type(g)), g0)
+    else:
+        acc = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), acc_shapes)
+    rest = tuple(x[1:] for x in mbs)
+
+    if overlapped:
+        def body(carry, mb):
+            inflight, acc, loss_acc = carry
+            if pin_chain:
+                inflight, mb = jax.lax.optimization_barrier((inflight, mb))
+            loss_k, g_k = jax.value_and_grad(loss_fn)(params, *mb)
+            acc = jax.tree_util.tree_map(jnp.add, acc, reduce_tree(inflight))
+            return (g_k, acc, loss_acc + loss_k), None
+
+        (last_g, acc, loss_acc), _ = jax.lax.scan(
+            body, (g0, acc, loss0), rest)
+        acc = jax.tree_util.tree_map(jnp.add, acc, reduce_tree(last_g))
+    else:
+        acc = jax.tree_util.tree_map(jnp.add, acc, reduce_tree(g0))
+
+        def body(carry, mb):
+            acc, loss_acc = carry
+            loss_k, g_k = jax.value_and_grad(loss_fn)(params, *mb)
+            acc = jax.tree_util.tree_map(jnp.add, acc, reduce_tree(g_k))
+            return (acc, loss_acc + loss_k), None
+
+        (acc, loss_acc), _ = jax.lax.scan(body, (acc, loss0), rest)
+
+    grads = jax.tree_util.tree_map(lambda a: a / n_micro, acc)
+    return grads, loss_acc / n_micro

@@ -66,20 +66,59 @@ def _record_reduce_scatter(numel: int, itemsize: int, n: int, mode: str,
 
 # --------------------------------------------------------------- tree reduce
 
+def reduce_bucket_collective(flat, bucket, axis_name: str, axis_size: int,
+                             mean: bool, mode: str):
+    """One bucket's reduction collective (block-scaled int8 / bf16 / exact
+    fp32 per ``bucket.quantize`` and ``mode``), with counter recording —
+    shared by the sequential flush below and the backward-ordered
+    overlapped flush (`comm.overlap`)."""
+    n = axis_size
+    fused = len(bucket.indices)
+    if bucket.quantize and mode == "int8":
+        out = quantized_psum(flat, axis_name, n, mean=mean)
+        _record_all_reduce(flat.size, flat.dtype.itemsize, n, mode,
+                           quantized=True, bucketed_leaves=fused)
+    elif bucket.quantize and mode == "bf16":
+        out = bf16_psum(flat, axis_name, mean=mean, axis_size=n)
+        _record_all_reduce(flat.size, flat.dtype.itemsize, n, mode,
+                           quantized=True, bucketed_leaves=fused)
+    else:
+        out = (jax.lax.pmean(flat, axis_name) if mean
+               else jax.lax.psum(flat, axis_name))
+        _record_all_reduce(flat.size, flat.dtype.itemsize, n, mode,
+                           quantized=False, bucketed_leaves=fused)
+    return out
+
+
 def reduce_gradients(grads, axis_name: str, axis_size: int,
-                     op: str = "pmean"):
+                     op: str = "pmean", emission_order=None):
     """Synchronize a gradient pytree over `axis_name` (the DDP path).
 
     Disabled -> one `jax.lax.pmean`/`psum` per leaf, the exact historical
     program.  Enabled -> leaves are partitioned by quantizability, packed
     into `comm_bucket_bytes` buckets, and each bucket pays ONE collective
     (block-scaled int8, bf16, or exact fp32 per its group).
+
+    With ``edconfig.comm_overlap`` set the flush is handed to
+    `comm.overlap.overlapped_reduce_gradients`: buckets are planned in
+    backward EMISSION order (``emission_order``, a flat-leaf permutation
+    from `comm.overlap.grad_emission_order`) and launched as a
+    barrier-pinned chain so XLA can slide each collective under the
+    remaining backward compute.  Value-identical to the sequential flush
+    (bitwise when quantization is off).
     """
     if op not in ("pmean", "psum"):
         raise ValueError(f"op={op!r}; expected pmean|psum")
     mean = op == "pmean"
     n = axis_size
     mode = quant_mode()
+
+    if edconfig.comm_overlap:
+        from .overlap import overlapped_reduce_gradients
+
+        return overlapped_reduce_gradients(grads, axis_name, axis_size,
+                                           op=op,
+                                           emission_order=emission_order)
 
     if not comm_enabled():
         # exact fp32 fallback: bitwise-identical to the pre-subsystem

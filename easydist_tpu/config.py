@@ -71,15 +71,34 @@ allow_repeated_axis_strategy = _env_bool("EASYDIST_ALLOW_REPEATED_AXIS_STRATEGY"
 # (reference predict_comm_overlap + comm_overlap_ratio, solver.py:74-84);
 # the discount is bounded by the hideable seconds of independent peer work
 # (MXU ops at peak_flops, memory-bound ops at hbm_bandwidth) per edge.
-# Off by default DELIBERATELY: the discount lets the ILP trade wire bytes
-# for assumed overlap, and on GPT dp x tp it picks plans moving ~1.5x the
-# collective bytes of the byte-minimal plan (fails the hand-GSPMD quality
-# gate).  Until overlap is validated against measured step time on real
-# hardware, byte-minimal is the safer default; enable per-compile when the
-# graph has wide independent branches.
+# The ratio the solver applies is resolved by
+# autoflow.cost_model.overlap_discount_ratio() from three sources
+# (`comm_overlap_ratio_source`):
+#   "auto"     (default) the MEASURED fraction when runtime.calibrate.
+#              calibrate_overlap() has recorded one for this backend in the
+#              PerfDB (loaded at compile time by apply_calibration), else
+#              the configured `comm_overlap_ratio`;
+#   "measured" only the measured fraction — the discount is OFF (ratio 0)
+#              until a calibration exists, so an uncalibrated compile can
+#              never trade bytes for imagined overlap;
+#   "config"   always the configured `comm_overlap_ratio` (the reference's
+#              flat-guess behavior).
+# predict_comm_overlap stays off by default: with the UNCALIBRATED flat 0.5
+# guess, the GPT dp x tp solve picks plans moving ~1.5x the collective
+# bytes of the byte-minimal plan (fails the hand-GSPMD quality gate); with
+# a measured fraction the discount reflects what the runtime's
+# backward-ordered bucket flush (comm/overlap.py) actually hides, and the
+# same solve stays byte-minimal (tests/test_autoflow/
+# test_overlap_pricing.py).  Calibrate once on the target, then enable.
 predict_comm_overlap = _env_bool("EASYDIST_PREDICT_COMM_OVERLAP", False)
 comm_overlap_ratio = _env_float("EASYDIST_COMM_OVERLAP_RATIO", 0.5)
-# device peak FLOP/s for overlap bounding (v5e bf16 ~197e12; f32 ~49e12)
+comm_overlap_ratio_source = os.environ.get("EASYDIST_COMM_OVERLAP_SOURCE", "auto")
+# set by runtime.calibrate (calibrate_overlap / apply_calibration), never
+# by hand: achieved overlap fraction measured on THIS backend, or None
+comm_overlap_ratio_measured = None
+# device peak FLOP/s for overlap bounding (v5e bf16 ~197e12; f32 ~49e12);
+# auto-replaced with the real device kind's datasheet value at compile time
+# (runtime.calibrate.apply_device_constants) unless the env var is set
 peak_flops = _env_float("EASYDIST_PEAK_FLOPS", 4.9e13)
 # (mem_cost_weight was removed: the solver derives the memory tie-break
 # weight from the comm-cost scale so it can order comm-equal solutions but
@@ -155,6 +174,23 @@ comm_quant_skip = os.environ.get(
 # per-block scales would move MORE bytes than fp32, and tiny collectives
 # are alpha-bound anyway (bucket them instead)
 comm_quant_min_numel = _env_int("EASYDIST_COMM_QUANT_MIN_NUMEL", 2048)
+# ---------------- overlapped gradient collectives (comm/overlap.py) -------
+# flush gradient buckets in backward EMISSION order, each launch pinned to
+# the previous with optimization_barrier so XLA's latency-hiding scheduler
+# slides the collective under the remaining backward compute.  Off by
+# default: the dp/zero wrappers then emit the historical sequential flush
+# (bitwise-identical programs).  Value-safe when on: reductions are
+# elementwise, so the reordered flush is bitwise-identical to the
+# sequential one whenever quantization is off (docs/COMM.md).
+comm_overlap = _env_bool("EASYDIST_COMM_OVERLAP", False)
+# K-microbatch double-buffered gradient accumulation in the dp/zero step
+# builders: a lax.scan whose carry holds microbatch k-1's in-flight grads,
+# reduced while microbatch k's backward runs.  0/1 = off (single-shot
+# step); per-call kwargs on ddp_step/zero2_step/zero3_step override.
+grad_accum_microbatches = _env_int("EASYDIST_GRAD_ACCUM_MICROBATCHES", 0)
+# replace peak_flops/hbm_bandwidth defaults with the real device kind's
+# datasheet constants at compile time (unknown backends keep the defaults)
+auto_device_constants = _env_bool("EASYDIST_AUTO_DEVICE_CONSTANTS", True)
 # load measured alpha/beta/HBM values from the PerfDB when present
 # (runtime.calibrate.calibrate() records them on the target hardware)
 auto_calibration = _env_bool("EASYDIST_AUTO_CALIBRATION", True)

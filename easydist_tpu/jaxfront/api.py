@@ -269,7 +269,7 @@ def _compile_cache_key(closed_jaxpr, axis_specs) -> str:
     # schema + cost-model salt: cached strategies are only valid for the
     # solver/cost-model that produced them; a version bump or a tuned
     # bandwidth/latency knob must miss, not silently serve stale plans
-    h.update(("v3|" + "|".join(
+    h.update(("v4|" + "|".join(
         f"{k}={getattr(edconfig, k)}" for k in
         ("ici_bandwidth", "dcn_bandwidth", "ici_latency", "dcn_latency",
          "hbm_bandwidth", "all_to_all_punish_factor",
@@ -281,7 +281,12 @@ def _compile_cache_key(closed_jaxpr, axis_specs) -> str:
          # comm compression changes reduction-edge prices (cost_model
          # min(exact, compressed)), so cached strategies are mode-specific
          "comm_quant_dtype", "comm_quant_block",
-         "comm_quant_min_numel"))).encode())
+         "comm_quant_min_numel",
+         # overlap knobs: the runtime flush/accum shape and the solver's
+         # calibrated discount ratio both change the plan's economics
+         "comm_overlap", "grad_accum_microbatches",
+         "comm_overlap_ratio_source",
+         "comm_overlap_ratio_measured"))).encode())
     names = VarNames()
     for v in closed_jaxpr.jaxpr.invars:
         names.name(v)
@@ -645,6 +650,26 @@ def solve_axes(closed_jaxpr, axis_specs, world, rules, shape_info, names,
                 findings.append(audit_finding)
             if audits is not None and "reported" in audit_record:
                 audits.append(audit_record)
+            if edconfig.predict_comm_overlap:
+                from easydist_tpu.analyze import make_finding
+                from easydist_tpu.autoflow.cost_model import (
+                    overlap_discount_ratio, overlap_ratio_is_measured)
+
+                if (not overlap_ratio_is_measured()
+                        and not any(f.rule_id == "OVL003"
+                                    for f in findings)):
+                    ratio = overlap_discount_ratio()
+                    findings.append(make_finding(
+                        "OVL003", f"axis:{axis.name}",
+                        "predict_comm_overlap is on but no measured "
+                        "overlap fraction exists for this backend "
+                        f"(source={edconfig.comm_overlap_ratio_source!r} "
+                        f"resolves to ratio={ratio:g}"
+                        + (", the flat config guess that fails the "
+                           "byte-quality gate" if ratio > 0
+                           else ", so the discount is inert")
+                        + "); run runtime.calibrate.calibrate_overlap() "
+                        "on the target to ground the discount"))
         per_axis[axis_idx] = chosen
         prev_chosen.append(chosen)
         logger.info("[solve] axis %s (%d devices) in %.2fs", axis.name,

@@ -17,18 +17,42 @@ default config the wrappers emit the exact historical collectives
 set, gradients travel block-quantized and/or fused into fixed-size
 buckets (docs/COMM.md), with sensitive leaves (`comm_quant_skip`) kept at
 full precision.
+
+Two opt-in latency-hiding knobs ride on top (docs/COMM.md "Overlapped
+flush"):
+
+  * ``edconfig.comm_overlap`` — gradients are flushed in backward
+    EMISSION order as a barrier-pinned chain (`comm.overlap`), letting
+    XLA slide each collective under the remaining backward compute.
+    Values are bitwise-identical to the sequential flush with
+    quantization off.
+  * ``grad_accum_microbatches=K`` (kwarg or the config default) — the
+    batch is split into K microbatches accumulated in a scan; with
+    overlap on, microbatch k's backward hides the reduction of
+    microbatch k-1's gradients (double buffering).
+
+With both knobs at their defaults the emitted programs are unchanged.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 from easydist_tpu import comm
+from easydist_tpu import config as edconfig
 from easydist_tpu.utils.jax_compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _accum_k(grad_accum_microbatches: Optional[int]) -> int:
+    """Effective microbatch count: the kwarg wins, else the config knob;
+    0/1 both mean no accumulation."""
+    k = (edconfig.grad_accum_microbatches if grad_accum_microbatches is None
+         else grad_accum_microbatches)
+    return int(k) if k else 0
 
 
 def _grad_paths(grads):
@@ -38,14 +62,24 @@ def _grad_paths(grads):
             for kp, _ in jax.tree_util.tree_flatten_with_path(grads)[0]]
 
 
-def ddp_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2):
+def ddp_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2,
+             grad_accum_microbatches: Optional[int] = None):
     """SGD DDP step: batch sharded over `axis`, grads averaged with psum.
     Returns step(params, batch...) -> (new_params, loss)."""
     n = mesh.shape[axis]
 
     def local_step(params, *batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
-        grads = comm.reduce_gradients(grads, axis, n, op="pmean")
+        k = _accum_k(grad_accum_microbatches)
+        if k > 1:
+            grads, loss = comm.accumulate_gradients(
+                loss_fn, params, batch, axis_name=axis, axis_size=n,
+                n_micro=k)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+            order = (comm.grad_emission_order(loss_fn, params, *batch)
+                     if edconfig.comm_overlap else None)
+            grads = comm.reduce_gradients(grads, axis, n, op="pmean",
+                                          emission_order=order)
         loss = jax.lax.pmean(loss, axis)
         new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g,
                                             params, grads)
@@ -77,7 +111,8 @@ def zero_shard_params(params, mesh, axis: str = "dp"):
 
 
 def zero3_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2,
-               b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8):
+               b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+               grad_accum_microbatches: Optional[int] = None):
     """Adam ZeRO-3: parameters AND optimizer moments sharded over dp.
 
     Params live sharded on dim 0; each step all_gathers them for the
@@ -110,27 +145,67 @@ def zero3_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2,
 
     # local_step needs static knowledge of which leaves are sharded; build
     # it per params structure via a factory
-    def make_step(shard_flags, tdef):
+    def make_step(shard_flags, tdef, grad_accum_microbatches=None):
         def local_step(flat_ps, flat_mu, flat_nu, count, *batch):
             full = [jax.lax.all_gather(p, axis, axis=0, tiled=True)
                     if flag else p
                     for p, flag in zip(flat_ps, shard_flags)]
             params = jax.tree_util.tree_unflatten(tdef, full)
-            loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+            k = _accum_k(grad_accum_microbatches)
+            overlap = bool(edconfig.comm_overlap)
+            g_paths = _grad_paths(params)
+
+            def reduce_leaf(i, g):
+                if shard_flags[i]:
+                    return comm.reduce_scatter_grad(g, axis, n,
+                                                    path=g_paths[i])
+                return comm.all_reduce_grad(g, axis, n, path=g_paths[i])
+
+            if k > 1:
+                # the reducer output is shard-shaped for flagged leaves —
+                # exactly the local param shards' shapes
+                order = (comm.grad_emission_order(loss_fn, params, *batch)
+                         if overlap else None)
+
+                def reduce_tree(gtree):
+                    fg = jax.tree_util.tree_flatten(gtree)[0]
+                    if overlap:
+                        fg = comm.chain_leaf_reduces(fg, order, reduce_leaf)
+                    else:
+                        fg = [reduce_leaf(i, g) for i, g in enumerate(fg)]
+                    return jax.tree_util.tree_unflatten(tdef, fg)
+
+                acc_shapes = jax.tree_util.tree_unflatten(tdef, [
+                    jax.ShapeDtypeStruct(jnp.shape(p), jnp.result_type(p))
+                    for p in flat_ps])
+                grads, loss = comm.accumulate_gradients(
+                    loss_fn, params, batch, axis_name=axis, axis_size=n,
+                    n_micro=k, reduce_tree=reduce_tree,
+                    acc_shapes=acc_shapes, overlapped=overlap)
+                flat_g = jax.tree_util.tree_flatten(grads)[0]
+                pre_reduced = True
+            else:
+                loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+                flat_g = jax.tree_util.tree_flatten(grads)[0]
+                if overlap:
+                    # pre-reduce as a backward-ordered pinned chain; the
+                    # Adam update below then consumes reduced shards
+                    order = comm.grad_emission_order(loss_fn, params,
+                                                     *batch)
+                    flat_g = comm.chain_leaf_reduces(flat_g, order,
+                                                     reduce_leaf)
+                    pre_reduced = True
+                else:
+                    pre_reduced = False
             loss = jax.lax.pmean(loss, axis)
             count = count + 1
             c1 = 1 - b1 ** count.astype(jnp.float32)
             c2 = 1 - b2 ** count.astype(jnp.float32)
-            flat_g = jax.tree_util.tree_flatten(grads)[0]
-            g_paths = _grad_paths(grads)
             new_p, new_m, new_v = [], [], []
-            for p_shard, g, m, v, flag, gpath in zip(flat_ps, flat_g, flat_mu,
-                                                     flat_nu, shard_flags,
-                                                     g_paths):
-                if flag:
-                    g = comm.reduce_scatter_grad(g, axis, n, path=gpath)
-                else:
-                    g = comm.all_reduce_grad(g, axis, n, path=gpath)
+            for i, (p_shard, g, m, v, flag) in enumerate(
+                    zip(flat_ps, flat_g, flat_mu, flat_nu, shard_flags)):
+                if not pre_reduced:
+                    g = reduce_leaf(i, g)
                 m = b1 * m + (1 - b1) * g
                 v = b2 * v + (1 - b2) * g * g
                 new_p.append(p_shard - lr * (m / c1) / (jnp.sqrt(v / c2) + eps))
@@ -147,7 +222,7 @@ def zero3_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2,
         # init_state the leaf still has GLOBAL shape (sharded array), so
         # shardable() applies directly
         shard_flags = tuple(shardable(p) for p in flat_p)
-        local = make_step(shard_flags, tdef)
+        local = make_step(shard_flags, tdef, grad_accum_microbatches)
 
         def spec_for(p, flag):
             return P(axis) if flag else P()
@@ -174,7 +249,8 @@ def zero3_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2,
 
 
 def zero2_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2,
-               b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8):
+               b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+               grad_accum_microbatches: Optional[int] = None):
     """Adam ZeRO-2: params replicated, optimizer moments sharded over dp.
 
     reduce_scatter(grads) -> local Adam shard update -> all_gather(params)
@@ -201,16 +277,57 @@ def zero2_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2,
                 "nu": jax.tree_util.tree_map(moment, params)}
 
     def local_step(params, mu, nu, count, *batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+        flat_p, tdef = jax.tree_util.tree_flatten(params)
+        g_paths = _grad_paths(params)
+        k = _accum_k(grad_accum_microbatches)
+        overlap = bool(edconfig.comm_overlap)
+
+        def reduce_leaf(i, g):
+            if shardable(flat_p[i]):
+                # grads: [d0, ...] -> reduce_scatter -> [d0/n, ...]
+                return comm.reduce_scatter_grad(g, axis, n, path=g_paths[i])
+            return comm.all_reduce_grad(g, axis, n, path=g_paths[i])
+
+        if k > 1:
+            order = (comm.grad_emission_order(loss_fn, params, *batch)
+                     if overlap else None)
+
+            def reduce_tree(gtree):
+                fg = jax.tree_util.tree_flatten(gtree)[0]
+                if overlap:
+                    fg = comm.chain_leaf_reduces(fg, order, reduce_leaf)
+                else:
+                    fg = [reduce_leaf(i, g) for i, g in enumerate(fg)]
+                return jax.tree_util.tree_unflatten(tdef, fg)
+
+            acc_shapes = jax.tree_util.tree_unflatten(tdef, [
+                jax.ShapeDtypeStruct(
+                    (p.shape[0] // n,) + p.shape[1:] if shardable(p)
+                    else p.shape, jnp.result_type(p))
+                for p in flat_p])
+            grads, loss = comm.accumulate_gradients(
+                loss_fn, params, batch, axis_name=axis, axis_size=n,
+                n_micro=k, reduce_tree=reduce_tree, acc_shapes=acc_shapes,
+                overlapped=overlap)
+            flat_g = jax.tree_util.tree_flatten(grads)[0]
+            pre_reduced = True
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+            flat_g = jax.tree_util.tree_flatten(grads)[0]
+            if overlap:
+                order = comm.grad_emission_order(loss_fn, params, *batch)
+                flat_g = comm.chain_leaf_reduces(flat_g, order, reduce_leaf)
+                pre_reduced = True
+            else:
+                pre_reduced = False
         loss = jax.lax.pmean(loss, axis)
         count = count + 1
         c1 = 1 - b1 ** count.astype(jnp.float32)
         c2 = 1 - b2 ** count.astype(jnp.float32)
 
-        def update(p, g, m, v, gpath):
+        def update(i, p, g, m, v):
             if shardable(p):
-                # grads: [d0, ...] -> reduce_scatter -> [d0/n, ...]
-                g_shard = comm.reduce_scatter_grad(g, axis, n, path=gpath)
+                g_shard = g if pre_reduced else reduce_leaf(i, g)
                 m, v = m[0], v[0]
                 p_shard = jax.lax.dynamic_slice_in_dim(
                     p, jax.lax.axis_index(axis) * g_shard.shape[0],
@@ -220,18 +337,15 @@ def zero2_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2,
                 p_new = p_shard - lr * (m / c1) / (jnp.sqrt(v / c2) + eps)
                 p_full = jax.lax.all_gather(p_new, axis, axis=0, tiled=True)
                 return p_full, m[None], v[None]
-            g = comm.all_reduce_grad(g, axis, n, path=gpath)
+            g = g if pre_reduced else reduce_leaf(i, g)
             m = b1 * m + (1 - b1) * g
             v = b2 * v + (1 - b2) * g * g
             return p - lr * (m / c1) / (jnp.sqrt(v / c2) + eps), m, v
 
-        flat_p, tdef = jax.tree_util.tree_flatten(params)
-        flat_g = jax.tree_util.tree_flatten(grads)[0]
         flat_m = jax.tree_util.tree_flatten(mu)[0]
         flat_v = jax.tree_util.tree_flatten(nu)[0]
-        g_paths = _grad_paths(grads)
-        new = [update(p, g, m, v, gp) for p, g, m, v, gp in
-               zip(flat_p, flat_g, flat_m, flat_v, g_paths)]
+        new = [update(i, p, g, m, v) for i, (p, g, m, v) in
+               enumerate(zip(flat_p, flat_g, flat_m, flat_v))]
         new_params = jax.tree_util.tree_unflatten(tdef, [t[0] for t in new])
         new_mu = jax.tree_util.tree_unflatten(tdef, [t[1] for t in new])
         new_nu = jax.tree_util.tree_unflatten(tdef, [t[2] for t in new])

@@ -9,7 +9,10 @@ pickle DB keeps its shape.
 from .checkpoint import save_checkpoint, load_checkpoint, latest_step  # noqa: F401
 from .perfdb import PerfDB  # noqa: F401
 from .profiler import (profile_compiled, op_cost_analysis,  # noqa: F401
-                       memory_analysis, serving_history)
+                       memory_analysis, serving_history,
+                       measure_collective_overlap)
 from .elastic import run_training, multihost_setup  # noqa: F401
 from .data import TokenLoader  # noqa: F401
-from .calibrate import calibrate, apply_calibration  # noqa: F401
+from .calibrate import (calibrate, apply_calibration,  # noqa: F401
+                        apply_device_constants, calibrate_overlap,
+                        detect_device_constants)

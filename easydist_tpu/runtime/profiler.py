@@ -64,6 +64,84 @@ def serving_history(sub_key: str = "engine",
     return db.get_op_perf("serving", sub_key) or []
 
 
+def measure_collective_overlap(mesh, axis: Optional[str] = None,
+                               n_elems: int = 1 << 22,
+                               compute_dim: int = 256,
+                               iters: int = 12,
+                               repeats: int = 3) -> Dict[str, float]:
+    """Measure how much of an all-reduce's wire time this backend hides
+    under independent compute.
+
+    Times three compiled programs on `mesh` over `axis`:
+      t_comm     an all-reduce of an ``n_elems`` f32 vector, alone;
+      t_compute  a chained matmul on an independent operand, alone;
+      t_both     both in ONE program with no data dependence between them,
+                 so the latency-hiding scheduler MAY overlap them.
+
+    overlap_fraction = clamp((t_comm + t_compute - t_both)
+                             / min(t_comm, t_compute), 0, 1):
+    0 means fully serialized (every wire second exposed), 1 means the
+    shorter of the two is fully hidden.  This is the ground truth behind
+    the solver's overlap discount (`autoflow.cost_model.
+    overlap_discount_ratio`); `runtime.calibrate.calibrate_overlap`
+    persists it per backend.
+
+    Each timing is the MIN over ``repeats`` independent two-point samples:
+    scheduler noise only inflates wall time, and a transient spike on
+    t_both alone would otherwise read as negative overlap.  The default
+    sizes put t_comm and t_compute within ~2x of each other on both the
+    virtual CPU mesh and a single TPU host — the numerator is a
+    DIFFERENCE, so wildly imbalanced operands would bury the overlap
+    signal in the larger term's noise floor.
+    """
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from easydist_tpu.utils.jax_compat import shard_map
+    from easydist_tpu.utils.timer import two_point_time
+
+    axis = axis or mesh.axis_names[0]
+    world = mesh.shape[axis]
+    n_elems = max(world, n_elems - n_elems % world)
+
+    def matmuls(a):
+        for _ in range(4):
+            a = a @ a * 1e-3
+        return a
+
+    def comm_body(v):
+        return jax.lax.psum(v, axis)
+
+    def both_body(v, a):
+        return jax.lax.psum(v, axis), matmuls(a)
+
+    comm_fn = jax.jit(shard_map(comm_body, mesh=mesh, in_specs=P(axis),
+                                out_specs=P(), check_vma=False))
+    comp_fn = jax.jit(shard_map(matmuls, mesh=mesh, in_specs=P(),
+                                out_specs=P(), check_vma=False))
+    both_fn = jax.jit(shard_map(both_body, mesh=mesh,
+                                in_specs=(P(axis), P()),
+                                out_specs=(P(), P()), check_vma=False))
+
+    v = jnp.ones((n_elems,), jnp.float32)
+    a = jnp.ones((compute_dim, compute_dim), jnp.float32) * 1e-2
+    n1, n2 = max(2, iters // 4), iters
+    repeats = max(1, repeats)
+    # interleaved rounds so slow machine-load drift hits all three alike
+    t_comm = t_compute = t_both = float("inf")
+    for _ in range(repeats):
+        t_comm = min(t_comm, two_point_time(comm_fn, (v,), n1=n1, n2=n2))
+        t_compute = min(t_compute,
+                        two_point_time(comp_fn, (a,), n1=n1, n2=n2))
+        t_both = min(t_both, two_point_time(both_fn, (v, a), n1=n1, n2=n2))
+
+    hidden = t_comm + t_compute - t_both
+    frac = hidden / max(min(t_comm, t_compute), 1e-12)
+    return {"t_comm": float(t_comm), "t_compute": float(t_compute),
+            "t_both": float(t_both),
+            "overlap_fraction": float(min(max(frac, 0.0), 1.0))}
+
+
 def profile_compiled(fn, args, key: Optional[str] = None,
                      trials: int = 5, warmup: int = 2,
                      db: Optional[PerfDB] = None,

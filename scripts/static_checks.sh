@@ -52,5 +52,39 @@ else
     echo "static_checks: jax not importable; skipping bench.py --analyze"
 fi
 
+# overlapped-collectives gate: the backward-ordered barrier-pinned flush
+# must stay bitwise-identical to the sequential one (quantization off) and
+# the emission-ordered bucket chain must expose a nonzero SCHEDULABLE
+# overlap fraction (bench.py --overlap `value`; program-structure bound,
+# deterministic — measured wall-clock fractions and step-time deltas on
+# virtual CPU meshes are noise, so only the deterministic bits gate)
+if python -c "import jax" >/dev/null 2>&1; then
+    echo "== bench.py --overlap (overlapped-flush parity gate)"
+    out=$(python bench.py --overlap 2>/dev/null) || rc=1
+    echo "$out"
+    verdict=$(python - "$out" <<'EOF'
+import json, sys
+try:
+    r = json.loads(sys.argv[1].strip().splitlines()[-1])
+    if "error" in r:
+        print("error: " + r["error"])
+    elif not r.get("parity_bitwise"):
+        print("parity_bitwise false")
+    elif not r.get("value", 0) > 0:
+        print("overlap_fraction not > 0")
+    else:
+        print("ok")
+except Exception as e:
+    print(f"unparseable: {e}")
+EOF
+)
+    if [ "$verdict" != "ok" ]; then
+        echo "static_checks: overlap gate failed ($verdict)"
+        rc=1
+    fi
+else
+    echo "static_checks: jax not importable; skipping bench.py --overlap"
+fi
+
 [ "$ran" = 0 ] && echo "static_checks: no external linters ran (configs still validated by CI tests)"
 exit $rc

@@ -1,0 +1,104 @@
+"""Overlap-discount pricing gate: `predict_comm_overlap=1` with the
+reference's flat `comm_overlap_ratio=0.5` guess discounts hideable
+reduction edges so hard that the ILP trades them for MORE wire bytes than
+the hand-GSPMD megatron sharding — failing the byte-quality gate
+(test_quality_gate.py).  With a CALIBRATED ratio (what
+`runtime.calibrate.calibrate_overlap` measures on real backends) the same
+discount stays honest and the chosen plan passes the gate."""
+
+import jax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from easydist_tpu import config as edconfig
+from easydist_tpu.jaxfront import easydist_compile, make_device_mesh
+from easydist_tpu.models import GPTConfig, make_gpt_train_step
+from easydist_tpu.utils.hlo import (collective_summary,
+                                    total_collective_bytes,
+                                    total_collective_count)
+
+
+def _gpt_case():
+    cfg = GPTConfig.tiny(seq=64, dim=64, heads=4, layers=2, vocab=256)
+    step, init_state = make_gpt_train_step(cfg)
+    state = init_state(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (16, cfg.seq), 0,
+                                cfg.vocab)
+    return step, state, tokens
+
+
+def _hand_megatron_bytes(step, state, tokens, mesh):
+    rep = NamedSharding(mesh, P())
+    dp = NamedSharding(mesh, P("dp"))
+
+    def spec(path, leaf):
+        name = "/".join(str(getattr(p, "key", p)) for p in path)
+        if leaf.ndim == 2 and ("qkv" in name or "fc" in name):
+            return NamedSharding(mesh, P(None, "tp"))
+        if leaf.ndim == 2 and "proj" in name:
+            return NamedSharding(mesh, P("tp", None))
+        return rep
+
+    params, opt = state
+    psh = jax.tree_util.tree_map_with_path(spec, params)
+    osh = jax.tree_util.tree_map_with_path(lambda p, l: spec(p[1:], l), opt)
+    hand = collective_summary(
+        jax.jit(step, in_shardings=((psh, osh), dp, dp))
+        .lower(state, tokens, tokens).compile().as_text())
+    return total_collective_bytes(hand), total_collective_count(hand)
+
+
+def _solve_bytes(step, state, tokens, mesh):
+    res = easydist_compile(step, mesh=mesh).get_compiled(
+        state, tokens, tokens)
+    ours = collective_summary(res.executable().as_text())
+    return total_collective_bytes(ours), total_collective_count(ours)
+
+
+@pytest.mark.world_8
+@pytest.mark.long_duration
+def test_calibrated_overlap_discount_passes_gate_flat_guess_fails(
+        cpu_devices, monkeypatch):
+    step, state, tokens = _gpt_case()
+    mesh = make_device_mesh((4, 2), ("dp", "tp"), devices=cpu_devices)
+    hand_bytes, hand_count = _hand_megatron_bytes(step, state, tokens, mesh)
+
+    monkeypatch.setattr(edconfig, "predict_comm_overlap", True)
+    monkeypatch.setattr(edconfig, "comm_overlap_ratio", 0.5)
+
+    # the reference behavior: the flat 0.5 guess halves every hideable
+    # reduction edge, so the ILP happily picks a layout that moves ~2.3x
+    # the hand sharding's bytes — the gate this test exists to document
+    monkeypatch.setattr(edconfig, "comm_overlap_ratio_source", "config")
+    monkeypatch.setattr(edconfig, "comm_overlap_ratio_measured", None)
+    flat_bytes, _ = _solve_bytes(step, state, tokens, mesh)
+    assert flat_bytes > hand_bytes, (
+        f"flat-guess plan moved {flat_bytes}B <= hand {hand_bytes}B; the "
+        "0.5 guess no longer mis-prices this case — update the fixture")
+
+    # the calibrated path: a measured overlap fraction (the order of what
+    # calibrate_overlap reports for a bandwidth-bound flush) keeps the
+    # discount honest and the plan byte-minimal
+    monkeypatch.setattr(edconfig, "comm_overlap_ratio_source", "measured")
+    monkeypatch.setattr(edconfig, "comm_overlap_ratio_measured", 0.15)
+    cal_bytes, cal_count = _solve_bytes(step, state, tokens, mesh)
+    assert cal_bytes <= hand_bytes, (cal_bytes, hand_bytes)
+    assert cal_count <= hand_count, (cal_count, hand_count)
+
+
+@pytest.mark.world_8
+@pytest.mark.long_duration
+def test_measured_source_uncalibrated_is_inert(cpu_devices, monkeypatch):
+    """source="measured" with no calibration resolves the discount to 0.0:
+    the solve must be byte-identical to predict_comm_overlap=0."""
+    step, state, tokens = _gpt_case()
+    mesh = make_device_mesh((4, 2), ("dp", "tp"), devices=cpu_devices)
+
+    monkeypatch.setattr(edconfig, "predict_comm_overlap", False)
+    off_bytes, off_count = _solve_bytes(step, state, tokens, mesh)
+
+    monkeypatch.setattr(edconfig, "predict_comm_overlap", True)
+    monkeypatch.setattr(edconfig, "comm_overlap_ratio_source", "measured")
+    monkeypatch.setattr(edconfig, "comm_overlap_ratio_measured", None)
+    on_bytes, on_count = _solve_bytes(step, state, tokens, mesh)
+    assert (on_bytes, on_count) == (off_bytes, off_count)
