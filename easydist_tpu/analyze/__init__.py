@@ -17,7 +17,11 @@ A rule-based analyzer that runs after solving and before execution
            of the graph memory plan, skyline soundness, the MEM004 HBM
            budget gate with its remat advisory, the remat-rewrite audit,
            and deadlock/stash-bound/bubble checks over pipeline tick
-           schedules.
+           schedules;
+  layer 4  resilience auditor (`audit_guard_parity`,
+           `audit_checkpoint_root`) — guard-off jaxpr parity (RES001) and
+           checkpoint commit-protocol integrity over a checkpoint root
+           (RES002 corrupt COMMITTED, RES003 stale debris).
 
 Surfaced via `CompiledFunction.analyze()`, `bench.py --analyze`, and the
 dryrun gate; findings export through the runtime PerfDB under
@@ -38,6 +42,8 @@ from .memory_rules import (audit_remat_plan, check_hbm_budget,
                            resolve_hbm_budget, verify_memory_plan)
 from .overlap_rules import (lint_overlap_fn, lint_overlap_jaxpr,
                             lint_overlap_plan)
+from .resilience_rules import (audit_checkpoint_root, audit_guard_parity,
+                               guard_off_jaxpr)
 from .schedule_rules import (gpipe_schedule_tables, schedule_stats,
                              verify_schedule_tables)
 from .strategy_rules import audit_solver_objective, verify_axis
@@ -54,6 +60,7 @@ __all__ = [
     "check_schedule_tables",
     "lint_overlap_plan", "lint_overlap_jaxpr", "lint_overlap_fn",
     "check_overlap_plan",
+    "audit_guard_parity", "audit_checkpoint_root", "guard_off_jaxpr",
 ]
 
 

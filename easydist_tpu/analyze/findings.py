@@ -99,6 +99,19 @@ RULES: Dict[str, tuple] = {
                "predict_comm_overlap is on without a measured overlap "
                "fraction for this backend (discount rests on the flat "
                "config guess)"),
+    # ---- layer 4: resilience auditor (guard trace parity + checkpoint
+    #      commit-protocol integrity, analyze/resilience_rules.py)
+    "RES001": (SEV_ERROR,
+               "guard-off trace parity broken: a builder's guard-off "
+               "program differs from the pre-guard build (the guard must "
+               "be a strict opt-in, bitwise-identical when off)"),
+    "RES002": (SEV_ERROR,
+               "COMMITTED checkpoint fails manifest verification "
+               "(missing/corrupt files — resume from it would poison "
+               "training state)"),
+    "RES003": (SEV_WARNING,
+               "stale uncommitted checkpoint debris (dead .tmp_* write "
+               "dirs or superseded torn step_N dirs awaiting GC)"),
 }
 
 

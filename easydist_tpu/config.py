@@ -222,6 +222,75 @@ enable_donation = _env_bool("EASYDIST_ENABLE_DONATION", True)
 # jax.remat policy applied to the emitted function: "none" | "dots" | "all"
 remat_policy = os.environ.get("EASYDIST_REMAT_POLICY", "none")
 
+# ---------------- resilience (easydist_tpu.resilience) ----------------
+# deterministic fault schedule, e.g. "step.nan_grad@7,ckpt.write.partial@2"
+# — names must come from resilience.faultinject.FAULT_POINTS (validated at
+# arm time AND at import time by the faultinject module); empty = disarmed
+fault_plan = os.environ.get("EASYDIST_FAULT_PLAN", "")
+# NaN/Inf step guard: lax.cond skip-and-hold folded into the compiled step
+# (dp/zero builders + GuardedStep for the auto path).  Off by default —
+# guard-off programs are bitwise-identical to pre-guard builds.
+# TRACE-AFFECTING: part of the strategy-cache salt.
+resilience_step_guard = _env_bool("EASYDIST_STEP_GUARD", False)
+# consecutive non-finite steps the guard holds before raising
+resilience_guard_max_skips = _env_int("EASYDIST_GUARD_MAX_SKIPS", 8)
+# overflow scale decays by this factor on each held step ...
+resilience_guard_scale_decay = _env_float("EASYDIST_GUARD_SCALE_DECAY", 0.5)
+# ... and doubles back (capped at its initial value) after this many clean
+# steps
+resilience_guard_scale_growth_every = _env_int(
+    "EASYDIST_GUARD_GROWTH_EVERY", 200)
+# checkpoint save/load I/O retry policy: exponential backoff with jitter
+resilience_ckpt_retries = _env_int("EASYDIST_CKPT_RETRIES", 3)
+resilience_ckpt_backoff_s = _env_float("EASYDIST_CKPT_BACKOFF", 0.05)
+resilience_ckpt_backoff_jitter = _env_float("EASYDIST_CKPT_JITTER", 0.25)
+# SIGTERM grace budget: the final synchronous checkpoint must land inside
+# this window (GCE preemptible gives 30s; TPU spot similar)
+resilience_preempt_grace_s = _env_float("EASYDIST_PREEMPT_GRACE", 30.0)
+# data-stall watchdog for the elastic loop: a batch fetch exceeding this
+# raises DataStallError (0 = watchdog off)
+resilience_data_timeout_s = _env_float("EASYDIST_DATA_TIMEOUT", 0.0)
+
+
+def _validate_resilience() -> None:
+    """Fail at import on out-of-range resilience knobs: a bad env var must
+    not surface as a wedged recovery path mid-incident."""
+    if resilience_guard_max_skips < 1:
+        raise ValueError(
+            f"EASYDIST_GUARD_MAX_SKIPS must be >= 1, got "
+            f"{resilience_guard_max_skips}")
+    if not 0.0 < resilience_guard_scale_decay <= 1.0:
+        raise ValueError(
+            f"EASYDIST_GUARD_SCALE_DECAY must be in (0, 1], got "
+            f"{resilience_guard_scale_decay}")
+    if resilience_guard_scale_growth_every < 1:
+        raise ValueError(
+            f"EASYDIST_GUARD_GROWTH_EVERY must be >= 1, got "
+            f"{resilience_guard_scale_growth_every}")
+    if resilience_ckpt_retries < 0:
+        raise ValueError(
+            f"EASYDIST_CKPT_RETRIES must be >= 0, got "
+            f"{resilience_ckpt_retries}")
+    if resilience_ckpt_backoff_s < 0:
+        raise ValueError(
+            f"EASYDIST_CKPT_BACKOFF must be >= 0, got "
+            f"{resilience_ckpt_backoff_s}")
+    if not 0.0 <= resilience_ckpt_backoff_jitter <= 1.0:
+        raise ValueError(
+            f"EASYDIST_CKPT_JITTER must be in [0, 1], got "
+            f"{resilience_ckpt_backoff_jitter}")
+    if resilience_preempt_grace_s <= 0:
+        raise ValueError(
+            f"EASYDIST_PREEMPT_GRACE must be > 0, got "
+            f"{resilience_preempt_grace_s}")
+    if resilience_data_timeout_s < 0:
+        raise ValueError(
+            f"EASYDIST_DATA_TIMEOUT must be >= 0, got "
+            f"{resilience_data_timeout_s}")
+
+
+_validate_resilience()
+
 # ---------------- profiling / perf db ----------------
 prof_db_path = os.environ.get("EASYDIST_PERF_DB", os.path.expanduser("~/.easydist_tpu/perf.db"))
 enable_runtime_prof = _env_bool("EASYDIST_RUNTIME_PROF", False)

@@ -269,7 +269,7 @@ def _compile_cache_key(closed_jaxpr, axis_specs) -> str:
     # schema + cost-model salt: cached strategies are only valid for the
     # solver/cost-model that produced them; a version bump or a tuned
     # bandwidth/latency knob must miss, not silently serve stale plans
-    h.update(("v4|" + "|".join(
+    h.update(("v5|" + "|".join(
         f"{k}={getattr(edconfig, k)}" for k in
         ("ici_bandwidth", "dcn_bandwidth", "ici_latency", "dcn_latency",
          "hbm_bandwidth", "all_to_all_punish_factor",
@@ -286,7 +286,11 @@ def _compile_cache_key(closed_jaxpr, axis_specs) -> str:
          # calibrated discount ratio both change the plan's economics
          "comm_overlap", "grad_accum_microbatches",
          "comm_overlap_ratio_source",
-         "comm_overlap_ratio_measured"))).encode())
+         "comm_overlap_ratio_measured",
+         # the NaN-step guard rewrites the traced step (lax.cond
+         # skip-and-hold around the update), so guarded and unguarded
+         # builds must not share cached strategies
+         "resilience_step_guard"))).encode())
     names = VarNames()
     for v in closed_jaxpr.jaxpr.invars:
         names.name(v)

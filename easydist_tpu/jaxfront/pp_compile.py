@@ -385,3 +385,44 @@ class PPCompiledFunction:
                 f"build a separate easydist_compile(pp_stages=...) "
                 f"instance per batch geometry")
         return self._built[0](state, *batch)
+
+    def export_state_dict(self, state):
+        """Unpack a live train state back to the LOGICAL params pytree.
+
+        `init_state` packs stage-exclusive float leaves into the sharded
+        [n_stages, max_elems] transport buffer; a checkpoint of the raw
+        state is therefore useless to anything but the exact same build
+        (eval harnesses, exporters, a re-build at different pp_stages).
+        This inverts it: gather the packed rows, slice each leaf back out
+        per the stage layouts, merge the shared leaves and the baked
+        non-float constants, and unflatten to the original params tree.
+
+        The f32 transport holds f32/bf16/f16 leaves exactly, so
+        init_state(export_state_dict(state)) repacks BITWISE-identically
+        (tested in tests/test_resilience/test_export_state.py); optimizer
+        state is intentionally not exported — it lives in the packed
+        representation and only round-trips through a same-build
+        checkpoint.
+        """
+        if self._built is None:
+            raise RuntimeError("call init_state(params, *batch) first")
+        pack_params = self._built[2]
+        unpack = getattr(pack_params, "unpack_params", None)
+        if unpack is None:
+            raise RuntimeError(
+                "this build did not pack params (shard_params off); the "
+                "state already holds logical leaves")
+        packed, shared = state[0]
+        # host gather first: the packed buffer is sharded pp x siblings,
+        # and the slicing below is host-side bookkeeping, not device work
+        packed = jax.device_get(packed)
+        shared = tuple(jax.device_get(s) for s in shared)
+        diff_leaves = unpack((jnp.asarray(packed),
+                              tuple(jnp.asarray(s) for s in shared)))
+        n_all = len(self._diff_idx) + len(self._const_baked)
+        out = [None] * n_all
+        for i, leaf in zip(self._diff_idx, diff_leaves):
+            out[i] = leaf
+        for i, baked in self._const_baked.items():
+            out[i] = baked
+        return jax.tree_util.tree_unflatten(self._params_treedef, out)

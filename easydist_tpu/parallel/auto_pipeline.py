@@ -574,6 +574,30 @@ def _prepare_pipeline(fn, example_params, example_mb, mesh, n_stages,
                 for lay in stage_layouts]
         return jnp.stack(rows), tuple(leaves[i] for i in shared_pos)
 
+    def unpack_params(packed_params):
+        """Inverse of pack_params: (packed [n_stages, max_elems], shared
+        leaves) -> flat param leaves in the ORIGINAL tree order (the caller
+        unflattens with its params treedef).  Every leaf is covered by
+        construction — plan_params assigns each index to exactly one stage
+        layout or to the shared set — and the f32 wire holds f32/bf16/f16
+        exactly, so pack -> unpack -> pack is bitwise-stable (the
+        export_state_dict contract in jaxfront/pp_compile.py)."""
+        packed, shared_vals = packed_params
+        leaves: list = [None] * n_param_leaves
+        for s, lay in enumerate(stage_layouts):
+            row = packed[s]
+            off = 0
+            for i in lay:
+                aval = param_vars[i].aval
+                n = math.prod(aval.shape)
+                leaves[i] = row[off:off + n].reshape(aval.shape) \
+                    .astype(aval.dtype)
+                off += n
+        for pos, val in zip(shared_pos, shared_vals):
+            leaves[pos] = val
+        return leaves
+
+    pack_params.unpack_params = unpack_params
     prep.pack_params = pack_params if shard_params else None
 
     # shard_map front matter shared by the gpipe and 1f1b builders:

@@ -32,6 +32,15 @@ flush"):
     microbatch k-1's gradients (double buffering).
 
 With both knobs at their defaults the emitted programs are unchanged.
+
+A third opt-in, ``step_guard`` (kwarg, default from
+``EASYDIST_STEP_GUARD``), folds the NaN/Inf skip-and-hold guard
+(resilience/guard.py) into the jitted step: the carry becomes
+``(state, guard_state)`` (seed the second element with
+``resilience.init_guard_state()``) and a non-finite step holds the
+previous state instead of committing garbage.  Guard OFF takes the
+historical code path untouched — the emitted program is bitwise-identical
+(tested by jaxpr identity in tests/test_resilience/test_guard.py).
 """
 
 from __future__ import annotations
@@ -55,6 +64,19 @@ def _accum_k(grad_accum_microbatches: Optional[int]) -> int:
     return int(k) if k else 0
 
 
+def _maybe_guard(step: Callable, step_guard: Optional[bool]) -> Callable:
+    """Fold the NaN/Inf skip-and-hold guard into the (unjitted) step when
+    requested; OFF returns `step` itself, so the guard-off trace cannot
+    differ from pre-guard builds by construction."""
+    on = (edconfig.resilience_step_guard if step_guard is None
+          else bool(step_guard))
+    if not on:
+        return step
+    from easydist_tpu.resilience.guard import guard_train_step
+
+    return guard_train_step(step)
+
+
 def _grad_paths(grads):
     """keystr paths of the grad tree's leaves, flat order (the
     comm_quant_skip opt-out matches against these)."""
@@ -63,9 +85,11 @@ def _grad_paths(grads):
 
 
 def ddp_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2,
-             grad_accum_microbatches: Optional[int] = None):
+             grad_accum_microbatches: Optional[int] = None,
+             step_guard: Optional[bool] = None):
     """SGD DDP step: batch sharded over `axis`, grads averaged with psum.
-    Returns step(params, batch...) -> (new_params, loss)."""
+    Returns step(params, batch...) -> (new_params, loss); with the guard
+    on, step((params, guard_state), batch...) -> ((..., ...), loss)."""
     n = mesh.shape[axis]
 
     def local_step(params, *batch):
@@ -94,7 +118,7 @@ def ddp_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2,
                        check_vma=False)
         return fn(params, *batch)
 
-    return jax.jit(step)
+    return jax.jit(_maybe_guard(step, step_guard))
 
 
 def zero_shard_params(params, mesh, axis: str = "dp"):
@@ -112,7 +136,8 @@ def zero_shard_params(params, mesh, axis: str = "dp"):
 
 def zero3_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2,
                b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
-               grad_accum_microbatches: Optional[int] = None):
+               grad_accum_microbatches: Optional[int] = None,
+               step_guard: Optional[bool] = None):
     """Adam ZeRO-3: parameters AND optimizer moments sharded over dp.
 
     Params live sharded on dim 0; each step all_gathers them for the
@@ -245,12 +270,13 @@ def zero3_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2,
                "nu": jax.tree_util.tree_unflatten(tdef, list(new_v))}
         return (params, opt, count), loss
 
-    return jax.jit(step), init_state
+    return jax.jit(_maybe_guard(step, step_guard)), init_state
 
 
 def zero2_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2,
                b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
-               grad_accum_microbatches: Optional[int] = None):
+               grad_accum_microbatches: Optional[int] = None,
+               step_guard: Optional[bool] = None):
     """Adam ZeRO-2: params replicated, optimizer moments sharded over dp.
 
     reduce_scatter(grads) -> local Adam shard update -> all_gather(params)
@@ -365,4 +391,4 @@ def zero2_step(loss_fn: Callable, mesh, axis: str = "dp", lr: float = 1e-2,
                                              count, *batch)
         return (new_params, {"mu": mu, "nu": nu}, count), loss
 
-    return jax.jit(step), init_opt
+    return jax.jit(_maybe_guard(step, step_guard)), init_opt

@@ -1,0 +1,39 @@
+"""`easydist_tpu.resilience`: fault-injection-first robustness layer.
+
+The reference has no recovery story at all (SURVEY §5: "Failure detection /
+elastic recovery — Absent") and delegates failure to torchrun killing
+peers.  This package turns recovery into a TESTED CONTRACT, DistIR-style
+(PAPERS.md arXiv:2111.05426): every failure mode is a named, deterministic
+fault point (`faultinject`) that CPU CI arms on a schedule, so each
+recovery path below runs as an ordinary test:
+
+  faultinject   named fault points + `EASYDIST_FAULT_PLAN` schedules;
+                zero-overhead no-ops when disarmed
+  guard         NaN/Inf step guard: lax.cond skip-and-hold inside the
+                compiled step, overflow-scale decay, bounded skip budget
+  preempt       SIGTERM -> flag -> final checkpoint within a grace budget
+  breaker       serving circuit breaker (consecutive-failure / p99 trips)
+
+The hardened checkpoint commit protocol lives with the checkpoint code
+(`runtime/checkpoint.py`), the guarded loop in `runtime/elastic.py`, the
+serving degradation in `serve/engine.py`; this package holds the shared
+machinery.  Catalog + recovery semantics: docs/RESILIENCE.md.
+"""
+
+from . import faultinject  # noqa: F401
+from .breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker  # noqa: F401
+from .faultinject import (FAULT_POINTS, FaultPlanError,  # noqa: F401
+                          InjectedFault, fault_plan)
+from .guard import (GuardBudgetExceededError, GuardedStep,  # noqa: F401
+                    all_finite, guard_train_step, init_guard_state,
+                    poison_batch)
+from .preempt import PreemptedError, PreemptionHandler  # noqa: F401
+
+__all__ = [
+    "faultinject", "FAULT_POINTS", "FaultPlanError", "InjectedFault",
+    "fault_plan",
+    "GuardBudgetExceededError", "GuardedStep", "all_finite",
+    "guard_train_step", "init_guard_state", "poison_batch",
+    "PreemptedError", "PreemptionHandler",
+    "CircuitBreaker", "CLOSED", "OPEN", "HALF_OPEN",
+]

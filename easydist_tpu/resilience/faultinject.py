@@ -1,0 +1,196 @@
+"""Deterministic fault-injection harness (DistIR principle, PAPERS.md
+arXiv:2111.05426: distributed-execution behavior verified by deterministic
+simulation instead of real hardware).
+
+Every recovery path in the resilience layer is exercised by NAMED fault
+points armed from a schedule string — the same code path a real failure
+takes, reproduced on the single-core CPU CI mesh.  A fault point is a call
+site like::
+
+    if faultinject.fire("ckpt.manifest.corrupt"):
+        <site-specific corruption>
+
+or, for sites whose fault is simply "the process died here"::
+
+    faultinject.crash_point("ckpt.write.partial")   # raises InjectedFault
+
+Schedule syntax (``EASYDIST_FAULT_PLAN`` / ``arm()``):
+
+    "step.nan_grad@7"                 fire on the 7th hit of that point
+    "ckpt.write.partial@2,data.stall@1"   multiple points, comma-separated
+    "serve.exec_timeout@*"            fire on EVERY hit
+
+Counting is per-point and 1-based: ``name@N`` fires exactly once, when the
+Nth execution of that fault point is reached.  Disarmed (the default), every
+fault point is a single attribute check + ``False`` — zero overhead and no
+behavioral difference, which is what lets the instrumented code paths stay
+in production builds.
+
+The catalog below is closed: arming an unknown point name raises
+immediately (a typo'd plan must not silently test nothing).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional
+
+# closed catalog: every instrumented fault point, with the recovery
+# contract it exercises (docs/RESILIENCE.md keeps the long-form table)
+FAULT_POINTS = frozenset({
+    # checkpoint commit protocol (runtime/checkpoint.py)
+    "ckpt.write.partial",     # crash mid-write: tempdir left, no commit
+    "ckpt.manifest.corrupt",  # bit rot in a COMMITTED checkpoint's data
+    # training loop (runtime/elastic.py + resilience/guard.py)
+    "preempt.sigterm",        # host preemption signal at a step boundary
+    "step.nan_grad",          # poisoned batch -> non-finite gradients
+    "data.stall",             # input pipeline stops producing
+    # serving (serve/engine.py)
+    "serve.exec_timeout",     # executable dispatch exceeds the watchdog
+    "serve.oom_bucket",       # batch-bucket compile exhausts device memory
+})
+
+
+class InjectedFault(RuntimeError):
+    """Raised by `crash_point` sites: the deterministic stand-in for "the
+    process died here".  Deliberately a RuntimeError so generic
+    `except Exception` recovery paths treat it like any real failure."""
+
+    def __init__(self, point: str):
+        self.point = point
+        super().__init__(f"injected fault at {point!r}")
+
+
+class FaultPlanError(ValueError):
+    """The schedule string is malformed or names an uncatalogued point."""
+
+
+_lock = threading.Lock()
+# None = disarmed (the zero-overhead fast path checks only this);
+# else {point: occurrence int or "*"}
+_plan: Optional[Dict[str, object]] = None
+_hits: Dict[str, int] = {}
+_fired: Dict[str, int] = {}
+
+
+def parse_plan(spec: str) -> Dict[str, object]:
+    """``"a@2,b@*"`` -> ``{"a": 2, "b": "*"}``; raises FaultPlanError on
+    unknown names / malformed entries."""
+    out: Dict[str, object] = {}
+    for entry in filter(None, (e.strip() for e in spec.split(","))):
+        name, sep, occ = entry.partition("@")
+        if not sep:
+            raise FaultPlanError(
+                f"fault plan entry {entry!r} missing '@occurrence' "
+                f"(use 'name@N' or 'name@*')")
+        if name not in FAULT_POINTS:
+            raise FaultPlanError(
+                f"unknown fault point {name!r}; catalogued points: "
+                f"{sorted(FAULT_POINTS)}")
+        if occ == "*":
+            out[name] = "*"
+        else:
+            try:
+                n = int(occ)
+            except ValueError:
+                raise FaultPlanError(
+                    f"fault plan occurrence {occ!r} for {name!r} is not an "
+                    f"integer or '*'") from None
+            if n < 1:
+                raise FaultPlanError(
+                    f"fault occurrence must be >= 1 (1-based), got {n} "
+                    f"for {name!r}")
+            out[name] = n
+    return out
+
+
+def arm(spec: str) -> None:
+    """Arm the harness with a schedule string; empty string disarms."""
+    global _plan
+    plan = parse_plan(spec) if spec else None
+    with _lock:
+        _plan = plan or None
+        _hits.clear()
+        _fired.clear()
+
+
+def disarm() -> None:
+    arm("")
+
+
+def armed() -> bool:
+    return _plan is not None
+
+
+def fire(point: str) -> bool:
+    """Count a hit of `point`; True iff the armed schedule says this hit
+    is the faulty one.  Disarmed: a single load + compare, no locking."""
+    if _plan is None:  # fast path: production / faults-off CI
+        return False
+    if point not in FAULT_POINTS:
+        raise FaultPlanError(f"uncatalogued fault point {point!r} in code")
+    with _lock:
+        if _plan is None:
+            return False
+        _hits[point] = _hits.get(point, 0) + 1
+        occ = _plan.get(point)
+        hit = occ == "*" or (occ is not None and _hits[point] == occ)
+        if hit:
+            _fired[point] = _fired.get(point, 0) + 1
+        return hit
+
+
+def crash_point(point: str) -> None:
+    """`fire` + raise: for sites whose injected fault is process death."""
+    if fire(point):
+        raise InjectedFault(point)
+
+
+def stats() -> Dict[str, Dict[str, int]]:
+    """{"hits": {...}, "fired": {...}} snapshot (bench/test reporting)."""
+    with _lock:
+        return {"hits": dict(_hits), "fired": dict(_fired)}
+
+
+class fault_plan:
+    """Context manager for tests: arm on enter, restore on exit.
+
+        with faultinject.fault_plan("step.nan_grad@3"):
+            run_training(...)
+    """
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        self._saved: Optional[Dict[str, object]] = None
+
+    def __enter__(self) -> "fault_plan":
+        global _plan
+        self._saved = _plan
+        arm(self.spec)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global _plan
+        with _lock:
+            _plan = self._saved
+            _hits.clear()
+            _fired.clear()
+
+
+def arm_from_config() -> None:
+    """Arm from `edconfig.fault_plan` (the EASYDIST_FAULT_PLAN schedule).
+    Called by the entry points that own a process lifetime (elastic loop,
+    bench scenarios) — library code never arms implicitly."""
+    from easydist_tpu import config as edconfig
+
+    spec = getattr(edconfig, "fault_plan", "") or ""
+    if spec:
+        arm(spec)
+
+
+# arming at import time would make library import order matter; instead the
+# env plan is validated eagerly (a typo'd plan fails fast, before any run)
+_env_spec = os.environ.get("EASYDIST_FAULT_PLAN", "")
+if _env_spec:
+    parse_plan(_env_spec)

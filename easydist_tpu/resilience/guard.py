@@ -1,0 +1,195 @@
+"""NaN/Inf step guard: skip-and-hold folded into the compiled train step.
+
+A single poisoned batch (or an overflowed bf16 reduction) otherwise writes
+NaN into every parameter and the run is dead from that step on — the
+classic silent-loss-of-progress failure.  The guard computes the candidate
+step normally, checks every inexact leaf of the candidate state (and the
+loss) for finiteness, and `lax.cond`-selects between candidate and previous
+state: a non-finite step HOLDS the previous parameters instead of
+committing garbage.
+
+Guard state (a small pytree threaded through the step, so the whole thing
+lives inside one jit):
+
+    consecutive   int32  non-finite steps in a row (reset on a clean step)
+    skips         int32  total held steps
+    steps         int32  total steps seen
+    scale         f32    overflow scale: decays on each skip, recovers
+                         after `scale_growth_every` clean steps — exposed
+                         for callers that fold it into their loss as a
+                         dynamic loss scale
+
+Budget enforcement is host-side (`GuardedStep`): tracing cannot raise, so
+the wrapper reads the consecutive-skip counter after each step and raises
+`GuardBudgetExceededError` once it exceeds the bounded budget — a step
+function that produces NaN every time must kill the job loudly, not spin
+holding stale state forever.
+
+With the guard OFF nothing here is traced at all — the dp/zero builders and
+`run_training` bypass this module entirely, so guard-off programs stay
+bitwise-identical to pre-guard builds (tested by jaxpr identity in
+tests/test_resilience/test_guard.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import faultinject
+
+
+class GuardBudgetExceededError(RuntimeError):
+    """More consecutive non-finite steps than the guard budget allows."""
+
+    def __init__(self, consecutive: int, budget: int):
+        self.consecutive = consecutive
+        self.budget = budget
+        super().__init__(
+            f"step guard held {consecutive} consecutive non-finite steps "
+            f"(budget {budget}); the step function is producing NaN/Inf "
+            f"every step — aborting instead of silently spinning")
+
+
+def init_guard_state(scale: float = 1.0):
+    """Fresh guard-state pytree (goes into the guarded step's carry)."""
+    return {"consecutive": jnp.zeros((), jnp.int32),
+            "skips": jnp.zeros((), jnp.int32),
+            "steps": jnp.zeros((), jnp.int32),
+            "scale": jnp.asarray(scale, jnp.float32)}
+
+
+def all_finite(*trees):
+    """Traced scalar bool: every inexact leaf of every tree is finite.
+    Non-float leaves (step counters, int tables) are exempt — integer
+    arithmetic cannot produce NaN and wraps silently either way."""
+    flags = []
+    for tree in trees:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if jnp.issubdtype(jnp.result_type(leaf), jnp.inexact):
+                flags.append(jnp.all(jnp.isfinite(leaf)))
+    if not flags:
+        return jnp.bool_(True)
+    return functools.reduce(jnp.logical_and, flags)
+
+
+def guard_train_step(step_fn: Callable, *,
+                     scale_decay: Optional[float] = None,
+                     scale_growth_every: Optional[int] = None,
+                     scale_max: float = 1.0) -> Callable:
+    """Wrap `step_fn(state, *batch) -> (state, loss)` into
+    `gstep((state, guard_state), *batch) -> ((state, guard_state), loss)`
+    with the skip-and-hold fold.  Pure and traceable: compose under
+    jax.jit / shard_map freely.
+
+    The returned loss is the CANDIDATE loss untouched (NaN on a skipped
+    step) — hiding it would blind host-side monitoring to the overflow the
+    guard just absorbed.
+    """
+    from easydist_tpu import config as edconfig
+
+    decay = (edconfig.resilience_guard_scale_decay if scale_decay is None
+             else scale_decay)
+    growth_every = (edconfig.resilience_guard_scale_growth_every
+                    if scale_growth_every is None else scale_growth_every)
+    if not 0.0 < decay <= 1.0:
+        raise ValueError(f"scale_decay must be in (0, 1], got {decay}")
+    if growth_every < 1:
+        raise ValueError(
+            f"scale_growth_every must be >= 1, got {growth_every}")
+
+    def gstep(carry, *batch):
+        state, gs = carry
+        cand_state, loss = step_fn(state, *batch)
+        finite = all_finite(cand_state, loss)
+        # lax.cond skip-and-hold: commit the candidate only when every
+        # inexact leaf survived; both operands exist either way, so the
+        # cond is the SELECT, not a recomputation
+        new_state = jax.lax.cond(finite,
+                                 lambda c, p: c, lambda c, p: p,
+                                 cand_state, state)
+        consecutive = jnp.where(finite, 0, gs["consecutive"] + 1)
+        clean_run = jnp.where(finite, gs["steps"] - gs["skips"] + 1, 0)
+        grown = jnp.where(
+            finite & (clean_run % growth_every == 0),
+            jnp.minimum(gs["scale"] * 2.0, scale_max), gs["scale"])
+        new_gs = {
+            "consecutive": consecutive.astype(jnp.int32),
+            "skips": gs["skips"] + jnp.where(finite, 0, 1).astype(jnp.int32),
+            "steps": gs["steps"] + 1,
+            "scale": jnp.where(finite, grown, gs["scale"] * decay),
+        }
+        return (new_state, new_gs), loss
+
+    return gstep
+
+
+def poison_batch(batch):
+    """Replace the first inexact array arg with NaN of the same
+    shape/dtype (the `step.nan_grad` fault action: a poisoned input is the
+    deterministic stand-in for an overflowed gradient).  Falls back to an
+    integer arg (poisoned with an out-of-range sentinel is NOT safe — int
+    lookups would just gather garbage), so an all-int batch poisons the
+    LOSS path by scaling instead; callers with all-int batches should
+    inject at the loss."""
+    import numpy as np
+
+    out = list(batch)
+    for i, a in enumerate(out):
+        if hasattr(a, "dtype") and jnp.issubdtype(
+                jnp.result_type(a), jnp.inexact):
+            out[i] = jnp.full(jnp.shape(a), jnp.nan, jnp.result_type(a))
+            return tuple(out)
+    raise ValueError(
+        "step.nan_grad needs at least one float batch argument to poison; "
+        "this batch has none (int token batches: inject at the loss "
+        "instead)")
+
+
+class GuardedStep:
+    """Host wrapper owning the guard state and the skip budget.
+
+    Works over ANY `step_fn(state, *batch) -> (state, loss)` — a dp/zero
+    builder's jitted step, an `easydist_compile` CompiledFunction (the auto
+    path), or a plain function.  The guard arithmetic runs as traced jax
+    ops; only the budget check reads one scalar back per step.
+
+        guarded = GuardedStep(step)
+        for batch in data:
+            state, loss = guarded(state, *batch)
+    """
+
+    def __init__(self, step_fn: Callable,
+                 max_consecutive_skips: Optional[int] = None, *,
+                 scale_decay: Optional[float] = None,
+                 scale_growth_every: Optional[int] = None,
+                 init_scale: float = 1.0):
+        from easydist_tpu import config as edconfig
+
+        self.budget = (edconfig.resilience_guard_max_skips
+                       if max_consecutive_skips is None
+                       else max_consecutive_skips)
+        if self.budget < 1:
+            raise ValueError(
+                f"max_consecutive_skips must be >= 1, got {self.budget}")
+        self._gstep = guard_train_step(
+            step_fn, scale_decay=scale_decay,
+            scale_growth_every=scale_growth_every, scale_max=init_scale)
+        self.guard_state = init_guard_state(init_scale)
+
+    def __call__(self, state, *batch):
+        if faultinject.fire("step.nan_grad"):
+            batch = poison_batch(batch)
+        (state, self.guard_state), loss = self._gstep(
+            (state, self.guard_state), *batch)
+        consecutive = int(self.guard_state["consecutive"])
+        if consecutive > self.budget:
+            raise GuardBudgetExceededError(consecutive, self.budget)
+        return state, loss
+
+    def stats(self) -> dict:
+        return {k: (float(v) if k == "scale" else int(v))
+                for k, v in self.guard_state.items()}

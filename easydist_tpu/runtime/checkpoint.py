@@ -1,21 +1,76 @@
-"""Sharded checkpoint save/restore via orbax.
+"""Sharded checkpoint save/restore via orbax, hardened with an atomic
+commit protocol.
 
 The reference has NO file-based checkpointing (SURVEY.md §5: "Checkpoint /
-resume — No file-based checkpoint I/O"); it only exposes distributed state
-access (compile_auto.py:778-815) and PP state dicts with resharding on load
-(pp/runtime.py:509-544).  Here checkpoint/resume is first-class: the sharded
-train-state pytree saves in parallel from every host, and restore reshards
-to whatever mesh/sharding the restoring job uses — that is the
-failure-recovery story (job restart from checkpoint).
+resume — No file-based checkpoint I/O").  Here checkpoint/resume is
+first-class AND crash-safe; a checkpoint is only ever observed in one of
+two states — fully committed or invisible:
+
+    path/
+      .tmp_step_42_ab12ef/        in-flight write (never read)
+        arrays/                   orbax array tree
+        MANIFEST.json             per-file sha256 + step + data cursor
+      step_42/                    os.replace(tmpdir) -> atomic appearance
+        arrays/  MANIFEST.json
+        COMMITTED                 marker written+fsynced after the rename
+
+Write protocol: orbax-save into the tempdir -> checksum every file into
+MANIFEST.json (fsync) -> `os.replace` the tempdir to `step_N` -> write the
+COMMITTED marker (fsync file and directory).  A crash at ANY point leaves
+either a dead `.tmp_*` (GC'd later) or a committed checkpoint; `latest_step`
+only ever counts COMMITTED directories, so a half-written checkpoint can
+never be resumed from.
+
+Read protocol: verify the manifest checksums before restoring; a corrupt or
+partial checkpoint falls back to the previous COMMITTED step automatically
+(bit rot and torn writes surface as a logged fallback, not a poisoned
+resume).  Save/restore I/O retries with exponential backoff + jitter
+(`EASYDIST_CKPT_RETRIES`/`_BACKOFF`/`_JITTER`) — GCS and NFS both throw
+transient errors under load.
+
+The manifest also carries caller metadata — the elastic loop records the
+data cursor (`batches_consumed`) there, so "which batches did this state
+see" commits ATOMICALLY with the state itself (resume can never
+double-sample, even if the process dies between save and any host-side
+bookkeeping).
+
+Fault points (resilience/faultinject): `ckpt.write.partial` truncates a
+just-written array file and dies before commit; `ckpt.manifest.corrupt`
+flips bytes in a committed file so verification must catch it.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
+import logging
 import os
+import random
 import re
-from typing import Any, Optional
+import shutil
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
+
+from easydist_tpu import config as edconfig
+from easydist_tpu.resilience import faultinject
+
+logger = logging.getLogger(__name__)
+
+MANIFEST_NAME = "MANIFEST.json"
+COMMITTED_NAME = "COMMITTED"
+ARRAYS_SUBDIR = "arrays"
+MANIFEST_FORMAT = 1
+# dead .tmp_* write dirs are GC'd once they are plausibly not a concurrent
+# writer's in-flight save anymore
+_TMP_GC_AGE_S = 3600.0
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """Every candidate checkpoint failed manifest verification (or an
+    explicitly requested step did)."""
 
 
 def _ocp():
@@ -24,37 +79,282 @@ def _ocp():
     return ocp
 
 
-def save_checkpoint(path: str, state: Any, step: int, keep: int = 3) -> str:
-    """Save `state` (arbitrary pytree of arrays, possibly sharded) under
-    `path/step_{step}`.  Synchronous; returns the checkpoint dir."""
+def _retry_io(fn, what: str):
+    """Run `fn()` retrying OSErrors with exponential backoff + jitter.
+    Injected faults and logic errors propagate immediately — only I/O
+    transients are worth re-driving."""
+    retries = edconfig.resilience_ckpt_retries
+    backoff = edconfig.resilience_ckpt_backoff_s
+    jitter = edconfig.resilience_ckpt_backoff_jitter
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except OSError as e:
+            if attempt >= retries:
+                raise
+            delay = backoff * (2 ** attempt)
+            delay *= 1.0 + jitter * random.random()
+            logger.warning(
+                "checkpoint: %s failed (%s: %s); retry %d/%d in %.3fs",
+                what, type(e).__name__, e, attempt + 1, retries, delay)
+            time.sleep(delay)
+            attempt += 1
+
+
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # some filesystems refuse O_RDONLY on dirs; best-effort
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _sha256_file(path: str) -> Tuple[str, int]:
+    h = hashlib.sha256()
+    n = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+            n += len(chunk)
+    return h.hexdigest(), n
+
+
+def _walk_files(root: str) -> List[str]:
+    """Relative paths of every regular file under root (sorted, stable)."""
+    out = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in filenames:
+            full = os.path.join(dirpath, name)
+            out.append(os.path.relpath(full, root))
+    return sorted(out)
+
+
+def save_checkpoint(path: str, state: Any, step: int, keep: int = 3,
+                    meta: Optional[Dict[str, Any]] = None) -> str:
+    """Atomically save `state` (arbitrary pytree of arrays, possibly
+    sharded) under `path/step_{step}`.  Synchronous; returns the committed
+    checkpoint dir.  `meta` lands in the manifest (the elastic loop stores
+    the data cursor there)."""
     ocp = _ocp()
     path = os.path.abspath(path)
     os.makedirs(path, exist_ok=True)
-    ckpt_dir = os.path.join(path, f"step_{step}")
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(ckpt_dir, state, force=True)
-    _gc_old(path, keep)
-    return ckpt_dir
+    tmp = os.path.join(path, f".tmp_step_{step}_{uuid.uuid4().hex[:8]}")
+    final = os.path.join(path, f"step_{step}")
+    arrays_dir = os.path.join(tmp, ARRAYS_SUBDIR)
+
+    try:
+        def do_save():
+            # uniform dict wrapper: orbax's StandardCheckpointer rejects a
+            # bare (container-less) leaf as the root ("Found empty item");
+            # wrapping makes scalar states first-class
+            with ocp.StandardCheckpointer() as ckptr:
+                ckptr.save(arrays_dir, {"state": state}, force=True)
+
+        _retry_io(do_save, f"save step {step}")
+
+        if faultinject.fire("ckpt.write.partial"):
+            # simulate dying mid-write: tear one array file, then "crash"
+            # before any commit — the tempdir must never become resumable
+            files = [f for f in _walk_files(tmp) if f != MANIFEST_NAME]
+            if files:
+                victim = os.path.join(tmp, max(
+                    files, key=lambda f: os.path.getsize(
+                        os.path.join(tmp, f))))
+                with open(victim, "r+b") as fh:
+                    fh.truncate(max(0, os.path.getsize(victim) // 2))
+            raise faultinject.InjectedFault("ckpt.write.partial")
+
+        manifest = {
+            "format": MANIFEST_FORMAT,
+            "step": int(step),
+            "created": time.time(),
+            "meta": dict(meta or {}),
+            "files": {},
+        }
+        for rel in _walk_files(tmp):
+            if rel == MANIFEST_NAME:
+                continue
+            digest, nbytes = _sha256_file(os.path.join(tmp, rel))
+            manifest["files"][rel] = {"sha256": digest, "bytes": nbytes}
+        man_path = os.path.join(tmp, MANIFEST_NAME)
+        with open(man_path, "w") as f:
+            json.dump(manifest, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+    except BaseException:
+        # a failed write must not leave the tempdir to be mistaken for a
+        # live writer; a CRASH would, which is what the .tmp GC is for
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+    # ---- commit: atomic appearance, then the marker
+    if os.path.isdir(final):  # re-save of the same step (force semantics)
+        shutil.rmtree(final, ignore_errors=True)
+    os.replace(tmp, final)
+    committed = os.path.join(final, COMMITTED_NAME)
+    with open(committed, "w") as f:
+        json.dump({"step": int(step), "committed": time.time()}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    _fsync_dir(final)
+    _fsync_dir(path)
+
+    if faultinject.fire("ckpt.manifest.corrupt"):
+        # simulate post-commit bit rot: flip bytes in the largest data
+        # file; load-time verification MUST catch this and fall back
+        files = sorted(
+            ((os.path.getsize(os.path.join(final, r)), r)
+             for r in _walk_files(final)
+             if r not in (MANIFEST_NAME, COMMITTED_NAME)), reverse=True)
+        if files:
+            victim = os.path.join(final, files[0][1])
+            with open(victim, "r+b") as fh:
+                data = fh.read()
+                fh.seek(len(data) // 2)
+                fh.write(bytes(b ^ 0xFF for b in data[
+                    len(data) // 2:len(data) // 2 + 8]) or b"\xff")
+
+    _gc_old(path, keep, protect=step)
+    return final
+
+
+def _step_dirs(path: str) -> List[Tuple[int, str]]:
+    try:
+        entries = os.listdir(path)
+    except FileNotFoundError:
+        return []
+    out = []
+    for d in entries:
+        m = re.fullmatch(r"step_(\d+)", d)
+        if m:
+            out.append((int(m.group(1)), os.path.join(path, d)))
+    return sorted(out)
+
+
+def _is_committed(ckpt_dir: str) -> bool:
+    return os.path.isfile(os.path.join(ckpt_dir, COMMITTED_NAME))
 
 
 def latest_step(path: str) -> Optional[int]:
-    if not os.path.isdir(path):
-        return None
-    steps = [int(m.group(1)) for d in os.listdir(path)
-             if (m := re.fullmatch(r"step_(\d+)", d))]
+    """Newest COMMITTED step under `path` (uncommitted/partial directories
+    are invisible to resume by construction)."""
+    steps = [s for s, d in _step_dirs(path) if _is_committed(d)]
     return max(steps) if steps else None
 
 
-def load_checkpoint(path: str, like: Any, step: Optional[int] = None) -> Any:
-    """Restore into the structure/shardings of `like` (a pytree of arrays or
-    ShapeDtypeStruct+sharding) — loading reshards automatically, so a job may
-    restart on a different mesh than it saved from."""
+def checkpoint_meta(path: str, step: int) -> Dict[str, Any]:
+    """Caller metadata recorded in the manifest at save time (e.g. the
+    elastic loop's `batches_consumed` cursor).  {} for legacy checkpoints
+    without a manifest."""
+    man = os.path.join(os.path.abspath(path), f"step_{step}", MANIFEST_NAME)
+    try:
+        with open(man) as f:
+            return dict(json.load(f).get("meta", {}))
+    except (FileNotFoundError, json.JSONDecodeError):
+        return {}
+
+
+def verify_checkpoint(ckpt_dir: str) -> List[str]:
+    """Commit-protocol + integrity audit of one checkpoint directory.
+    Returns a list of human-readable problems (empty = verified)."""
+    problems: List[str] = []
+    if not os.path.isdir(ckpt_dir):
+        return [f"missing directory {ckpt_dir}"]
+    if not _is_committed(ckpt_dir):
+        problems.append("no COMMITTED marker")
+    man_path = os.path.join(ckpt_dir, MANIFEST_NAME)
+    try:
+        with open(man_path) as f:
+            manifest = json.load(f)
+    except FileNotFoundError:
+        problems.append("no MANIFEST.json")
+        return problems
+    except json.JSONDecodeError as e:
+        problems.append(f"manifest unparsable: {e}")
+        return problems
+    for rel, want in manifest.get("files", {}).items():
+        full = os.path.join(ckpt_dir, rel)
+        try:
+            digest, nbytes = _sha256_file(full)
+        except FileNotFoundError:
+            problems.append(f"listed file missing: {rel}")
+            continue
+        if nbytes != want.get("bytes"):
+            problems.append(
+                f"size mismatch {rel}: {nbytes} != {want.get('bytes')}")
+        elif digest != want.get("sha256"):
+            problems.append(f"checksum mismatch {rel}")
+    return problems
+
+
+def load_checkpoint(path: str, like: Any, step: Optional[int] = None,
+                    verify: bool = True, fallback: bool = True,
+                    with_meta: bool = False) -> Any:
+    """Restore into the structure/shardings of `like` (a pytree of arrays
+    or ShapeDtypeStruct+sharding) — loading reshards automatically, so a
+    job may restart on a different mesh than it saved from.
+
+    With `step=None`, candidates are tried newest-committed first; a
+    checkpoint failing manifest verification is skipped with a warning
+    (automatic fallback to the last good step).  An explicitly requested
+    `step` that fails verification raises `CheckpointCorruptionError`
+    (the caller asked for THAT state; silently substituting another would
+    be worse than failing).  `with_meta=True` returns (state, step, meta).
+    """
+    path = os.path.abspath(path)
+    if step is not None:
+        candidates = [step]
+        explicit = True
+    else:
+        candidates = sorted(
+            (s for s, d in _step_dirs(path) if _is_committed(d)),
+            reverse=True)
+        explicit = False
+        if not candidates:
+            raise FileNotFoundError(f"no committed checkpoints under {path}")
+
+    last_err: Optional[str] = None
+    for cand in candidates:
+        ckpt_dir = os.path.join(path, f"step_{cand}")
+        if verify:
+            problems = verify_checkpoint(ckpt_dir)
+            if problems:
+                msg = f"step {cand}: " + "; ".join(problems)
+                if explicit or not fallback:
+                    raise CheckpointCorruptionError(msg)
+                logger.warning(
+                    "checkpoint: %s — falling back to the previous "
+                    "committed step", msg)
+                last_err = msg
+                continue
+        state = _restore(ckpt_dir, like)
+        if with_meta:
+            return state, cand, checkpoint_meta(path, cand)
+        return state
+    raise CheckpointCorruptionError(
+        f"every committed checkpoint under {path} failed verification "
+        f"(last: {last_err})")
+
+
+def _restore(ckpt_dir: str, like: Any) -> Any:
     ocp = _ocp()
-    if step is None:
-        step = latest_step(path)
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {path}")
-    ckpt_dir = os.path.join(os.path.abspath(path), f"step_{step}")
+    arrays_dir = os.path.join(ckpt_dir, ARRAYS_SUBDIR)
+    wrapped = True
+    if not os.path.isdir(arrays_dir):
+        arrays_dir = ckpt_dir  # legacy layout (pre-commit-protocol)
+        wrapped = False
 
     def replicated_sharding():
         from jax.sharding import Mesh, NamedSharding, PartitionSpec
@@ -69,28 +369,82 @@ def load_checkpoint(path: str, like: Any, step: Optional[int] = None) -> Any:
     def as_abstract(x):
         if hasattr(x, "shape") and hasattr(x, "dtype"):
             sharding = getattr(x, "sharding", None)
-            # A single-device sharding in the template usually means "freshly
-            # initialized host arrays".  Restoring committed to device 0
-            # clashes with multi-device jits, and sharding=None makes orbax
-            # fall back to the SAVED topology (which may no longer exist on
-            # an elastic restart).  Restore replicated over the CURRENT
-            # devices instead — valid on any topology, and jit reshards from
-            # there per its constraints.
+            # A single-device sharding in the template usually means
+            # "freshly initialized host arrays".  Restoring committed to
+            # device 0 clashes with multi-device jits, and sharding=None
+            # makes orbax fall back to the SAVED topology (which may no
+            # longer exist on an elastic restart).  Restore replicated
+            # over the CURRENT devices instead — valid on any topology,
+            # and jit reshards from there per its constraints.
             if sharding is None or getattr(sharding, "num_devices", 1) <= 1:
                 sharding = rep
             return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
         return x
 
     abstract = jax.tree_util.tree_map(as_abstract, like)
-    with ocp.StandardCheckpointer() as ckptr:
-        return ckptr.restore(ckpt_dir, abstract)
+    if wrapped:
+        abstract = {"state": abstract}
+
+    def do_restore():
+        with ocp.StandardCheckpointer() as ckptr:
+            return ckptr.restore(arrays_dir, abstract)
+
+    out = _retry_io(do_restore, f"restore {ckpt_dir}")
+    return out["state"] if wrapped else out
 
 
-def _gc_old(path: str, keep: int) -> None:
-    steps = sorted(
-        int(m.group(1)) for d in os.listdir(path)
-        if (m := re.fullmatch(r"step_(\d+)", d)))
-    import shutil
+def _gc_old(path: str, keep: int, protect: Optional[int] = None) -> None:
+    """Collect old checkpoints.  Invariants:
 
-    for s in steps[:-keep] if keep > 0 else []:
+      * keep-count applies ONLY to COMMITTED checkpoints — a torn/partial
+        directory can never crowd a good one out of the window;
+      * the step just written (`protect`) is never collected, whatever the
+        keep-count says;
+      * concurrent deletion (another process GC'ing the same root) is
+        tolerated: every removal ignores FileNotFoundError;
+      * dead `.tmp_*` write dirs older than an hour are swept, and
+        uncommitted `step_N` dirs superseded by a committed step are dead
+        by construction and swept too.
+    """
+    try:
+        entries = os.listdir(path)
+    except FileNotFoundError:
+        return
+
+    committed, uncommitted = [], []
+    for d in entries:
+        m = re.fullmatch(r"step_(\d+)", d)
+        if not m:
+            continue
+        full = os.path.join(path, d)
+        try:
+            (committed if _is_committed(full) else uncommitted).append(
+                int(m.group(1)))
+        except FileNotFoundError:
+            continue  # raced with a concurrent deleter
+    committed.sort()
+
+    doomed = committed[:-keep] if keep > 0 else []
+    for s in doomed:
+        if protect is not None and s == protect:
+            continue
         shutil.rmtree(os.path.join(path, f"step_{s}"), ignore_errors=True)
+
+    newest_committed = committed[-1] if committed else None
+    for s in uncommitted:
+        if protect is not None and s == protect:
+            continue
+        if newest_committed is not None and s <= newest_committed:
+            shutil.rmtree(os.path.join(path, f"step_{s}"),
+                          ignore_errors=True)
+
+    now = time.time()
+    for d in entries:
+        if not d.startswith(".tmp_step_"):
+            continue
+        full = os.path.join(path, d)
+        try:
+            if now - os.path.getmtime(full) > _TMP_GC_AGE_S:
+                shutil.rmtree(full, ignore_errors=True)
+        except FileNotFoundError:
+            continue

@@ -1,22 +1,59 @@
-"""Minimal failure handling: periodic checkpointing + restart-resume.
+"""Fault-tolerant training loop: periodic atomic checkpoints, exact
+restart-resume, preemption handling, NaN-step guarding, data-stall watchdog.
 
 The reference has no recovery story (SURVEY.md §5: "Failure detection /
 elastic recovery — Absent"; it delegates to torchrun and kills peers on
 failure).  On TPU pods the practical contract is: persist sharded state
-every N steps, re-`jax.distributed.initialize` on restart, restore onto the
-(possibly different) mesh, continue from the last step.  `run_training`
-implements that loop; `multihost_setup` is the DCN control-plane bring-up.
+every N steps through the atomic commit protocol (runtime/checkpoint.py),
+re-`jax.distributed.initialize` on restart, restore onto the (possibly
+different) mesh, continue from the last COMMITTED step.  `run_training`
+implements that loop hardened end-to-end:
+
+  * the data cursor (`batches_consumed`) commits atomically WITH the state
+    in the checkpoint manifest — resume skips the deterministic sample
+    stream to exactly where the restored state left it, so a restart never
+    double-samples and never skips data (the old heuristic "steps == batches"
+    only held for one-batch-per-step loops);
+  * SIGTERM (spot/preemptible grace notice) is converted to a flag; the
+    loop takes one final checkpoint at the next step boundary and exits
+    through `PreemptedError` (resilience/preempt.py);
+  * with `EASYDIST_STEP_GUARD=1` the step is wrapped in the NaN/Inf
+    skip-and-hold guard (resilience/guard.py) with a bounded skip budget;
+  * a stalled input pipeline (`EASYDIST_DATA_TIMEOUT_S` > 0) raises
+    `DataStallError` instead of hanging the job silently forever.
+
+Every path above is exercised deterministically by the fault points
+`preempt.sigterm`, `step.nan_grad`, `data.stall`, `ckpt.write.partial` and
+`ckpt.manifest.corrupt` (resilience/faultinject.py).
 """
 
 from __future__ import annotations
 
 import logging
+import signal
 import time
 from typing import Callable, Optional
+
+from easydist_tpu import config as edconfig
+from easydist_tpu.resilience import faultinject
+from easydist_tpu.resilience.guard import GuardedStep
+from easydist_tpu.resilience.preempt import PreemptedError, PreemptionHandler
 
 from .checkpoint import latest_step, load_checkpoint, save_checkpoint
 
 logger = logging.getLogger(__name__)
+
+
+class DataStallError(RuntimeError):
+    """The input pipeline took longer than the stall budget to produce one
+    batch — the loop fails loudly instead of wedging on `next()`."""
+
+    def __init__(self, elapsed_s: float, budget_s: float):
+        self.elapsed_s = elapsed_s
+        self.budget_s = budget_s
+        super().__init__(
+            f"data loader stalled: one batch took {elapsed_s:.2f}s "
+            f"(budget {budget_s:.2f}s, EASYDIST_DATA_TIMEOUT_S)")
 
 
 def multihost_setup(coordinator: Optional[str] = None,
@@ -34,45 +71,112 @@ def multihost_setup(coordinator: Optional[str] = None,
     jax.distributed.initialize(**kwargs)
 
 
+def _draw_batch(data_iter, timeout_s: float):
+    """One batch from the iterator, with the stall fault point and the
+    after-the-fact watchdog.  (The draw itself stays on this thread — a
+    reaper thread can't cancel a wedged C++ read anyway; detection + a
+    typed raise is the recoverable contract.)"""
+    t0 = time.perf_counter()
+    if faultinject.fire("data.stall"):
+        time.sleep((timeout_s * 1.5) if timeout_s > 0 else 0.05)
+    batch = next(data_iter)
+    elapsed = time.perf_counter() - t0
+    if timeout_s > 0 and elapsed > timeout_s:
+        raise DataStallError(elapsed, timeout_s)
+    return batch
+
+
 def run_training(step_fn: Callable, init_state: Callable, data_iter,
                  ckpt_dir: str, total_steps: int,
                  checkpoint_every: int = 100,
-                 on_step: Optional[Callable] = None):
+                 on_step: Optional[Callable] = None,
+                 step_guard: Optional[bool] = None,
+                 preempt_grace_s: Optional[float] = None,
+                 data_timeout_s: Optional[float] = None,
+                 keep: int = 3):
     """Fault-tolerant training loop.
 
     step_fn(state, *batch) -> (state, loss); init_state() -> fresh state.
-    Resumes from the latest checkpoint under `ckpt_dir` when one exists
-    (restore reshards onto the current mesh automatically).  Returns the
-    final state.
+    Resumes from the newest COMMITTED checkpoint under `ckpt_dir` when one
+    exists (a corrupt newest checkpoint falls back to the previous good
+    one; restore reshards onto the current mesh automatically).  Returns
+    the final state.
+
+    `step_guard`/`preempt_grace_s`/`data_timeout_s` default to the
+    EASYDIST_STEP_GUARD / EASYDIST_PREEMPT_GRACE_S /
+    EASYDIST_DATA_TIMEOUT_S knobs.  With the guard OFF, `step_fn` is
+    called directly — the trace is bitwise-identical to pre-guard builds.
     """
+    if step_guard is None:
+        step_guard = edconfig.resilience_step_guard
+    if preempt_grace_s is None:
+        preempt_grace_s = edconfig.resilience_preempt_grace_s
+    if data_timeout_s is None:
+        data_timeout_s = edconfig.resilience_data_timeout_s
+    faultinject.arm_from_config()
+
     start = latest_step(ckpt_dir)
     if start is None:
         state = init_state()
         start = 0
+        cursor = 0
         logger.info("elastic: fresh start")
     else:
-        state = load_checkpoint(ckpt_dir, init_state(), step=start)
+        # step=None so a corrupt newest checkpoint falls back to the
+        # previous committed step; `start` is whatever actually restored
+        state, start, meta = load_checkpoint(
+            ckpt_dir, init_state(), with_meta=True)
         logger.info("elastic: resumed from step %d", start)
+        cursor = meta.get("batches_consumed")
+        if cursor is None:
+            # legacy checkpoint without a manifest cursor: the old
+            # steps==batches heuristic is the only information available
+            cursor = start
         # position the data stream: without this, a restart re-trains on
-        # batches 0..start (silent double-sampling)
+        # batches the restored state already saw (silent double-sampling)
         if hasattr(data_iter, "skip"):
             already = getattr(data_iter, "batches_consumed", 0)
-            if already < start:
-                data_iter.skip(start - already)
+            if already < cursor:
+                data_iter.skip(cursor - already)
                 logger.info("elastic: data cursor advanced to batch %d",
-                            start)
+                            cursor)
 
     if hasattr(data_iter, "skip") and not hasattr(data_iter, "__next__"):
         data_iter = iter(data_iter)
 
+    stepper = GuardedStep(step_fn) if step_guard else step_fn
+    drawn = int(cursor)
+
+    def checkpoint(state, step: int, extra: Optional[dict] = None) -> None:
+        meta = {"batches_consumed": drawn}
+        if step_guard:
+            meta["guard"] = stepper.stats()
+        if extra:
+            meta.update(extra)
+        save_checkpoint(ckpt_dir, state, step, keep=keep, meta=meta)
+
     t0 = time.perf_counter()
-    for step in range(start, total_steps):
-        batch = next(data_iter)
-        state, loss = step_fn(state, *batch)
-        if on_step is not None:
-            on_step(step, loss)
-        if (step + 1) % checkpoint_every == 0 or step + 1 == total_steps:
-            save_checkpoint(ckpt_dir, state, step + 1)
-            logger.info("elastic: checkpointed step %d (%.1fs elapsed)",
-                        step + 1, time.perf_counter() - t0)
+    with PreemptionHandler(grace_s=preempt_grace_s) as pre:
+        for step in range(start, total_steps):
+            if faultinject.fire("preempt.sigterm"):
+                signal.raise_signal(signal.SIGTERM)
+            if pre.requested:
+                t_ck = time.perf_counter()
+                checkpoint(state, step, extra={"preempted": True})
+                dt = time.perf_counter() - t_ck
+                if dt > pre.grace_s:
+                    logger.error(
+                        "preempt: final checkpoint took %.2fs, over the "
+                        "%.1fs grace budget — the kill may have raced it",
+                        dt, pre.grace_s)
+                raise PreemptedError(step, dt)
+            batch = _draw_batch(data_iter, data_timeout_s)
+            drawn += 1
+            state, loss = stepper(state, *batch)
+            if on_step is not None:
+                on_step(step, loss)
+            if (step + 1) % checkpoint_every == 0 or step + 1 == total_steps:
+                checkpoint(state, step + 1)
+                logger.info("elastic: checkpointed step %d (%.1fs elapsed)",
+                            step + 1, time.perf_counter() - t0)
     return state

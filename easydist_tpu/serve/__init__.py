@@ -4,15 +4,17 @@ The first request-shaped (rather than step-shaped) layer of the stack:
 `ServeEngine` turns any easydist-compiled inference function into a served
 endpoint with a shape-bucketed executable cache, a continuous micro-batcher
 draining a bounded request queue, admission control (backpressure, deadlines,
-transient-failure retry, OOM bucket degradation), and serving metrics
-exported through the runtime PerfDB.
+jittered transient-failure retry, OOM bucket degradation), degradation
+machinery (execute watchdog, circuit breaker, `health()` readiness), and
+serving metrics exported through the runtime PerfDB.
 
 The reference (alibaba/easydist) has no serving layer — see docs/SERVING.md
 and the AoiZora/DistIR pointers in PAPERS.md for why an auto-parallel
 framework pays off at inference time behind a dispatch layer like this.
 """
 
-from .admission import (DeadlineExceededError, EngineStoppedError,  # noqa: F401
+from .admission import (CircuitOpenError, DeadlineExceededError,  # noqa: F401
+                        EngineStoppedError, ExecTimeoutError,
                         QueueFullError, RequestTooLargeError, ServeError,
                         is_oom_error, is_transient_error, retry_transient)
 from .batcher import (MicroBatcher, PackMeta, Request,  # noqa: F401

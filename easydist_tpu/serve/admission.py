@@ -6,14 +6,20 @@ Three concerns live here, all independent of how batches are packed or run:
   buffering unboundedly — the caller sheds load or retries upstream;
 - deadlines: every request carries an absolute expiry; an expired request
   surfaces `DeadlineExceededError` instead of occupying a batch slot;
-- failure policy: transient executor failures are retried with exponential
-  backoff (`retry_transient`), and a bucket whose compile exhausts device
-  memory is classified by `is_oom_error` so the engine can degrade to
-  smaller batch buckets rather than failing every request routed to it.
+- failure policy: transient executor failures are retried with jittered
+  exponential backoff (`retry_transient`) that never sleeps past the
+  request's deadline, and a bucket whose compile exhausts device memory is
+  classified by `is_oom_error` so the engine can degrade to smaller batch
+  buckets rather than failing every request routed to it;
+- degradation signals: `ExecTimeoutError` (the execute watchdog fired) and
+  `CircuitOpenError` (the breaker is shedding load, with a retry-after
+  hint) give clients STRUCTURED failure they can route on, instead of a
+  hang or an opaque stack.
 """
 
 from __future__ import annotations
 
+import random
 import time
 from typing import Callable, Optional
 
@@ -36,6 +42,23 @@ class EngineStoppedError(ServeError):
 
 class RequestTooLargeError(ServeError):
     """A request dimension exceeds the largest configured bucket."""
+
+
+class ExecTimeoutError(ServeError):
+    """One device execution exceeded the per-batch watchdog deadline.
+    The dispatch itself cannot be cancelled (XLA has no cancellation); the
+    engine abandons the wedged call on its worker thread and fails the
+    batch so clients stop waiting."""
+
+
+class CircuitOpenError(ServeError):
+    """The engine's circuit breaker is OPEN: the executor is persistently
+    failing (or browned out on latency) and load is shed at the door.
+    `retry_after_s` hints when the breaker will next admit a probe."""
+
+    def __init__(self, msg: str, retry_after_s: float = 0.0):
+        self.retry_after_s = retry_after_s
+        super().__init__(msg)
 
 
 def is_oom_error(exc: BaseException) -> bool:
@@ -64,10 +87,21 @@ def is_transient_error(exc: BaseException) -> bool:
 def retry_transient(fn: Callable, *, max_retries: int, backoff_s: float,
                     is_transient: Callable[[BaseException], bool]
                     = is_transient_error,
-                    sleep: Callable[[float], None] = time.sleep):
-    """Call `fn()` retrying transient failures with exponential backoff
-    (backoff_s, 2*backoff_s, 4*backoff_s, ...).  Non-transient failures and
-    the final attempt's failure propagate."""
+                    sleep: Callable[[float], None] = time.sleep,
+                    jitter: float = 0.0,
+                    deadline_t: Optional[float] = None,
+                    clock: Callable[[], float] = time.monotonic,
+                    rng: Callable[[], float] = random.random):
+    """Call `fn()` retrying transient failures with jittered exponential
+    backoff (backoff_s, 2*backoff_s, 4*backoff_s, ..., each stretched by up
+    to `jitter` fraction — synchronized retry storms from many batchers
+    hitting one wedged device are worse than the failure itself).
+
+    Non-transient failures and the final attempt's failure propagate.  With
+    `deadline_t` (absolute `clock()` seconds), a retry whose backoff would
+    land past the deadline is NOT taken: the prior failure propagates
+    immediately — sleeping through the caller's deadline to deliver a
+    result nobody is waiting for helps no one."""
     attempt = 0
     while True:
         try:
@@ -75,7 +109,12 @@ def retry_transient(fn: Callable, *, max_retries: int, backoff_s: float,
         except Exception as e:  # noqa: BLE001 - classification decides
             if attempt >= max_retries or not is_transient(e):
                 raise
-            sleep(backoff_s * (2 ** attempt))
+            delay = backoff_s * (2 ** attempt)
+            if jitter:
+                delay *= 1.0 + jitter * rng()
+            if deadline_t is not None and clock() + delay >= deadline_t:
+                raise
+            sleep(delay)
             attempt += 1
 
 

@@ -8,24 +8,42 @@ The engine pads every packed batch up to configured `batch_buckets` x
 compiles exactly once (the `jaxfront` signature cache guarantees it) and
 every subsequent request is a cache hit.
 
-Robustness is layered in from `admission.py`: bounded-queue backpressure at
-submit, per-request deadlines enforced by the batcher, transient-failure
-retry with exponential backoff around execution, and graceful degradation
-— a batch bucket whose compile exhausts device memory is disabled and its
-requests re-packed into smaller enabled buckets.
+Robustness is layered in from `admission.py` and `resilience/`: bounded-
+queue backpressure at submit, per-request deadlines enforced by the
+batcher, transient-failure retry with jittered deadline-respecting backoff
+around execution, and graceful degradation on three axes —
+
+  * a batch bucket whose compile exhausts device memory is disabled and
+    its requests re-packed into smaller enabled buckets;
+  * a per-batch execute watchdog (`exec_timeout_ms`) abandons a wedged
+    dispatch and fails the batch with `ExecTimeoutError` instead of
+    pinning every downstream request behind it;
+  * a circuit breaker (`breaker_failure_threshold` > 0) sheds load at
+    submit with `CircuitOpenError` once the executor fails persistently
+    (or p99 execute latency brows out past `breaker_p99_threshold_ms`),
+    probing recovery after `breaker_cooldown_ms`.
+
+`health()` summarizes all of it for a readiness endpoint.  The paths are
+exercised deterministically by the `serve.exec_timeout` and
+`serve.oom_bucket` fault points (resilience/faultinject.py).
 """
 
 from __future__ import annotations
 
 import logging
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .admission import (AdmissionController, QueueFullError,
+from easydist_tpu.resilience import faultinject
+from easydist_tpu.resilience.breaker import CircuitBreaker
+
+from .admission import (AdmissionController, CircuitOpenError,
+                        ExecTimeoutError, QueueFullError,
                         RequestTooLargeError, ServeError, is_oom_error,
                         is_transient_error, retry_transient)
 from .batcher import (MicroBatcher, Request, RequestQueue, pack_requests,
@@ -46,9 +64,16 @@ class ServeConfig:
         open for stragglers (latency floor vs occupancy knob).
     max_queue: bounded queue depth; submits beyond it raise QueueFullError.
     default_deadline_ms: deadline applied when submit() passes none.
-    max_retries / retry_backoff_ms: transient-failure policy per batch.
+    max_retries / retry_backoff_ms / retry_jitter: transient-failure policy
+        per batch (jitter stretches each backoff by up to that fraction).
     pad_value: fill for seq padding (e.g. the pad token id).
     unpad_outputs: slice outputs back to each request's original length.
+    exec_timeout_ms: per-batch execute watchdog; None disables.
+    breaker_failure_threshold: consecutive executor failures before the
+        circuit opens; 0 disables the breaker entirely.
+    breaker_cooldown_ms: how long the open circuit sheds before probing.
+    breaker_p99_threshold_ms / breaker_min_samples: optional brownout trip
+        on observed p99 execute latency.
     """
     batch_buckets: Tuple[int, ...] = (1, 2, 4, 8)
     seq_buckets: Optional[Tuple[int, ...]] = None
@@ -57,8 +82,14 @@ class ServeConfig:
     default_deadline_ms: Optional[float] = None
     max_retries: int = 2
     retry_backoff_ms: float = 10.0
+    retry_jitter: float = 0.25
     pad_value: object = 0
     unpad_outputs: bool = True
+    exec_timeout_ms: Optional[float] = None
+    breaker_failure_threshold: int = 0
+    breaker_cooldown_ms: float = 1000.0
+    breaker_p99_threshold_ms: Optional[float] = None
+    breaker_min_samples: int = 20
 
     def __post_init__(self):
         if not self.batch_buckets:
@@ -68,6 +99,19 @@ class ServeConfig:
                              f"{self.batch_buckets}")
         if self.seq_buckets is not None and not self.seq_buckets:
             raise ValueError("seq_buckets must be None or non-empty")
+        if not 0.0 <= self.retry_jitter <= 1.0:
+            raise ValueError(
+                f"retry_jitter must be in [0, 1], got {self.retry_jitter}")
+        if self.exec_timeout_ms is not None and self.exec_timeout_ms <= 0:
+            raise ValueError(f"exec_timeout_ms must be > 0 or None, "
+                             f"got {self.exec_timeout_ms}")
+        if self.breaker_failure_threshold < 0:
+            raise ValueError(
+                f"breaker_failure_threshold must be >= 0 (0 disables), "
+                f"got {self.breaker_failure_threshold}")
+        if self.breaker_cooldown_ms <= 0:
+            raise ValueError(f"breaker_cooldown_ms must be > 0, "
+                             f"got {self.breaker_cooldown_ms}")
 
 
 class ServeEngine:
@@ -114,6 +158,22 @@ class ServeEngine:
         self._disabled_buckets: set = set()
         self._seen_exec_keys: set = set()
         self._started = False
+        self.breaker: Optional[CircuitBreaker] = None
+        if self.config.breaker_failure_threshold > 0:
+            p99_ms = self.config.breaker_p99_threshold_ms
+            self.breaker = CircuitBreaker(
+                failure_threshold=self.config.breaker_failure_threshold,
+                cooldown_s=self.config.breaker_cooldown_ms / 1e3,
+                p99_threshold_s=(p99_ms / 1e3 if p99_ms is not None
+                                 else None),
+                min_samples=self.config.breaker_min_samples,
+                p99=lambda: self.metrics.execute.percentile(99),
+                clock=clock)
+        # watchdog pool: one worker — executions are serial anyway; a
+        # timed-out dispatch abandons the whole pool (shutdown(wait=False))
+        # so the next batch gets a fresh worker instead of queueing behind
+        # the wedged call
+        self._watchdog: Optional[ThreadPoolExecutor] = None
 
     # ---------------------------------------------------------- lifecycle
     def start(self) -> "ServeEngine":
@@ -124,6 +184,9 @@ class ServeEngine:
     def stop(self) -> None:
         self._started = False
         self.batcher.stop()
+        if self._watchdog is not None:
+            self._watchdog.shutdown(wait=False)
+            self._watchdog = None
 
     def __enter__(self) -> "ServeEngine":
         return self.start()
@@ -134,9 +197,16 @@ class ServeEngine:
     # ---------------------------------------------------------- submission
     def submit(self, *args, deadline_ms: Optional[float] = None) -> Future:
         """Enqueue one unbatched request; returns its result future.
-        Raises QueueFullError (backpressure) or RequestTooLargeError (no
-        bucket fits) synchronously — load shedding happens at the door."""
+        Raises QueueFullError (backpressure), RequestTooLargeError (no
+        bucket fits) or CircuitOpenError (the breaker is shedding)
+        synchronously — load shedding happens at the door."""
         self._reject_oversized(args)
+        if self.breaker is not None and not self.breaker.allow():
+            self.metrics.inc("requests_shed")
+            retry_after = self.breaker.retry_after_s()
+            raise CircuitOpenError(
+                f"circuit open: executor failing persistently; retry in "
+                f"{retry_after:.2f}s", retry_after_s=retry_after)
         try:
             self.admission.check_depth(self.queue.depth())
         except QueueFullError:
@@ -232,10 +302,42 @@ class ServeEngine:
 
     def _run_batched(self, batched):
         """One device execution of a packed batch, with executable-cache
-        accounting.  Blocks until the result is ready (the scatter needs
-        host values anyway, and execute-latency should include it)."""
+        accounting and the optional execute watchdog.  Blocks until the
+        result is ready (the scatter needs host values anyway, and
+        execute-latency should include it)."""
+        if faultinject.fire("serve.oom_bucket"):
+            # deterministic stand-in for an XLA compile/alloc failure at
+            # this bucket shape — must route through the degrade path
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: injected fake device OOM "
+                "(serve.oom_bucket fault point)")
+        timeout_ms = self.config.exec_timeout_ms
+        if timeout_ms is None:
+            return self._dispatch(batched)
+        if self._watchdog is None:
+            self._watchdog = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="serve-exec")
+        fut = self._watchdog.submit(self._dispatch, batched)
+        try:
+            return fut.result(timeout=timeout_ms / 1e3)
+        except FutureTimeoutError:
+            # the dispatch cannot be cancelled (no XLA cancellation);
+            # abandon the pool — the wedged thread finishes into the void,
+            # the next batch gets a fresh worker
+            self.metrics.inc("exec_timeouts")
+            self._watchdog.shutdown(wait=False)
+            self._watchdog = None
+            raise ExecTimeoutError(
+                f"batch execution exceeded the {timeout_ms:.0f}ms "
+                f"watchdog; dispatch abandoned") from None
+
+    def _dispatch(self, batched):
         import jax
 
+        if faultinject.fire("serve.exec_timeout"):
+            # simulate a wedged dispatch: sleep well past the watchdog
+            t_ms = self.config.exec_timeout_ms
+            time.sleep((t_ms * 3 / 1e3) if t_ms is not None else 0.05)
         key = self._exec_key(batched)
         if key in self._seen_exec_keys:
             self.metrics.inc("compile_cache_hits")
@@ -276,18 +378,29 @@ class ServeEngine:
                 self.metrics.inc("transient_retries")
             return ok
 
+        # a retry whose backoff outlives every waiter is pure waste: bound
+        # the retry loop by the earliest request deadline in the group
+        deadlines = [r.deadline_t for r in reqs if r.deadline_t is not None]
+        group_deadline = min(deadlines) if deadlines else None
+
         t0 = self.clock()
         try:
             out = retry_transient(
                 attempt, max_retries=self.config.max_retries,
                 backoff_s=self.config.retry_backoff_ms / 1e3,
-                is_transient=transient_and_count)
+                is_transient=transient_and_count,
+                jitter=self.config.retry_jitter,
+                deadline_t=group_deadline, clock=self.clock)
         except Exception as e:
+            if self.breaker is not None:
+                self.breaker.record_failure()
             if is_oom_error(e):
                 self._degrade(reqs, meta.batch_bucket, e)
                 return
             self._fail(reqs, e)
             return
+        if self.breaker is not None:
+            self.breaker.record_success()
         self.metrics.record_batch(meta.n_real, meta.batch_bucket,
                                   self.clock() - t0)
         try:
@@ -328,9 +441,40 @@ class ServeEngine:
         out = self.metrics.snapshot()
         out["distinct_executables"] = len(self._seen_exec_keys)
         out["disabled_batch_buckets"] = sorted(self._disabled_buckets)
+        if self.breaker is not None:
+            out["breaker"] = self.breaker.snapshot()
         if self._compiled is not None:
             out["backend_cache"] = self._compiled.cache_stats()
         return out
+
+    def health(self) -> dict:
+        """Liveness/readiness summary for an external health endpoint.
+
+        ready: the engine accepts new work right now (started, circuit not
+        open, at least one batch bucket still enabled).
+        degraded: serving, but with reduced capability (disabled buckets,
+        watchdog timeouts or shed requests observed, half-open circuit).
+        """
+        breaker_state = (self.breaker.state if self.breaker is not None
+                         else "disabled")
+        enabled = tuple(b for b in self.config.batch_buckets
+                        if b not in self._disabled_buckets)
+        m = self.metrics
+        ready = bool(self._started and enabled and breaker_state != "open")
+        degraded = bool(
+            self._disabled_buckets or breaker_state in ("open", "half_open")
+            or m.counter("exec_timeouts") or m.counter("requests_shed"))
+        return {
+            "started": self._started,
+            "ready": ready,
+            "degraded": degraded,
+            "breaker_state": breaker_state,
+            "enabled_batch_buckets": list(enabled),
+            "disabled_batch_buckets": sorted(self._disabled_buckets),
+            "exec_timeouts": m.counter("exec_timeouts"),
+            "requests_shed": m.counter("requests_shed"),
+            "oom_degradations": m.counter("oom_degradations"),
+        }
 
     def export_metrics(self, db=None, sub_key: Optional[str] = None):
         """Push the snapshot into the runtime PerfDB (serving history lands

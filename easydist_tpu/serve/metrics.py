@@ -67,10 +67,10 @@ class ServeMetrics:
     """Thread-safe counters/gauges/histograms for one `ServeEngine`.
 
     Counter names (all monotonically increasing):
-      requests_submitted / completed / failed / timed_out / rejected,
-      batches_executed, batch_rows_real, batch_rows_padded,
-      compile_cache_hits, compile_cache_misses, oom_degradations,
-      transient_retries.
+      requests_submitted / completed / failed / timed_out / rejected /
+      shed (circuit open), batches_executed, batch_rows_real,
+      batch_rows_padded, compile_cache_hits, compile_cache_misses,
+      oom_degradations, transient_retries, exec_timeouts (watchdog).
     Histograms: queue_wait (submit->drain), execute (device time incl.
     host roundtrip), e2e (submit->future resolution)."""
 

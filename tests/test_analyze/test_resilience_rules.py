@@ -1,0 +1,67 @@
+"""Analyze layer 4: RES001 guard-parity audit, RES002/RES003 checkpoint
+commit-protocol audit."""
+
+import json
+import os
+
+import jax.numpy as jnp
+
+from easydist_tpu.analyze import (audit_checkpoint_root, audit_guard_parity,
+                                  guard_off_jaxpr)
+from easydist_tpu.analyze.findings import SEV_ERROR, SEV_WARNING
+from easydist_tpu.runtime.checkpoint import MANIFEST_NAME, save_checkpoint
+
+
+def _f(x):
+    return x * 2.0 + 1.0
+
+
+def _g(x):
+    return x * 3.0 + 1.0
+
+
+def test_res001_identical_programs_pass():
+    assert audit_guard_parity(_f, _f, (jnp.ones(4),)) == []
+    assert "mul" in guard_off_jaxpr(_f, (jnp.ones(4),))
+
+
+def test_res001_divergent_programs_flagged():
+    findings = audit_guard_parity(_f, _g, (jnp.ones(4),), node="ddp")
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule_id == "RES001" and f.severity == SEV_ERROR
+    assert f.node == "ddp"
+    assert "divergence" in f.message
+
+
+def test_checkpoint_root_clean(tmp_path):
+    save_checkpoint(str(tmp_path), {"w": jnp.ones(4)}, step=1)
+    assert audit_checkpoint_root(str(tmp_path)) == []
+    assert audit_checkpoint_root(str(tmp_path / "nonexistent")) == []
+
+
+def test_res002_corrupt_committed(tmp_path):
+    final = save_checkpoint(str(tmp_path), {"w": jnp.ones(4)}, step=1)
+    with open(os.path.join(final, MANIFEST_NAME)) as f:
+        rels = list(json.load(f)["files"])
+    victim = os.path.join(final, rels[0])
+    with open(victim, "ab") as fh:
+        fh.write(b"\x00rot")
+    findings = audit_checkpoint_root(str(tmp_path))
+    assert any(f.rule_id == "RES002" and f.severity == SEV_ERROR
+               for f in findings)
+
+
+def test_res003_debris(tmp_path):
+    save_checkpoint(str(tmp_path), {"w": jnp.ones(4)}, step=5)
+    os.makedirs(tmp_path / "step_2")            # superseded torn dir
+    os.makedirs(tmp_path / "step_9")            # torn, newest
+    os.makedirs(tmp_path / ".tmp_step_5_dead")  # crash debris
+    findings = audit_checkpoint_root(str(tmp_path))
+    res3 = [f for f in findings if f.rule_id == "RES003"]
+    assert len(res3) == 3
+    assert all(f.severity == SEV_WARNING for f in res3)
+    msgs = " | ".join(f.message for f in res3)
+    assert "superseded" in msgs and "in-flight" in msgs
+    # the COMMITTED step itself is clean: no RES002
+    assert not any(f.rule_id == "RES002" for f in findings)

@@ -1,0 +1,168 @@
+"""Atomic checkpoint commit protocol: crash-safety, verification fallback,
+GC invariants (the ckpt.* fault points + the _gc_old satellites)."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from easydist_tpu.resilience import faultinject
+from easydist_tpu.resilience.faultinject import InjectedFault
+from easydist_tpu.runtime.checkpoint import (ARRAYS_SUBDIR, COMMITTED_NAME,
+                                             MANIFEST_NAME,
+                                             CheckpointCorruptionError,
+                                             _gc_old, _retry_io,
+                                             checkpoint_meta, latest_step,
+                                             load_checkpoint, save_checkpoint,
+                                             verify_checkpoint)
+
+
+def _state(seed=0):
+    return {"w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4) + seed,
+            "count": jnp.asarray(seed, jnp.int32)}
+
+
+def _bitwise_equal(a, b):
+    la, lb = (np.asarray(x) for x in (a["w"], b["w"]))
+    return la.tobytes() == lb.tobytes() and int(a["count"]) == int(b["count"])
+
+
+def test_commit_protocol_layout(tmp_path):
+    root = str(tmp_path)
+    final = save_checkpoint(root, _state(), step=7,
+                            meta={"batches_consumed": 7})
+    assert final == os.path.join(root, "step_7")
+    assert os.path.isdir(os.path.join(final, ARRAYS_SUBDIR))
+    assert os.path.isfile(os.path.join(final, COMMITTED_NAME))
+    with open(os.path.join(final, MANIFEST_NAME)) as f:
+        manifest = json.load(f)
+    assert manifest["step"] == 7
+    assert manifest["meta"] == {"batches_consumed": 7}
+    # every data file is checksummed
+    assert manifest["files"]
+    for rel, want in manifest["files"].items():
+        assert len(want["sha256"]) == 64
+        assert want["bytes"] == os.path.getsize(os.path.join(final, rel))
+    assert latest_step(root) == 7
+    assert verify_checkpoint(final) == []
+    assert checkpoint_meta(root, 7) == {"batches_consumed": 7}
+
+
+def test_partial_write_is_invisible(tmp_path):
+    root = str(tmp_path)
+    save_checkpoint(root, _state(0), step=0)
+    with faultinject.fault_plan("ckpt.write.partial@1"):
+        with pytest.raises(InjectedFault):
+            save_checkpoint(root, _state(1), step=1)
+    # the torn write never became a resumable checkpoint
+    assert latest_step(root) == 0
+    assert not os.path.isdir(os.path.join(root, "step_1"))
+    restored = load_checkpoint(root, _state(99))
+    assert _bitwise_equal(restored, _state(0))
+
+
+def test_corrupt_newest_falls_back(tmp_path):
+    root = str(tmp_path)
+    save_checkpoint(root, _state(0), step=3, meta={"batches_consumed": 3})
+    with faultinject.fault_plan("ckpt.manifest.corrupt@1"):
+        save_checkpoint(root, _state(1), step=6,
+                        meta={"batches_consumed": 6})
+    # step 6 IS committed (bit rot happens after commit) ...
+    assert latest_step(root) == 6
+    assert verify_checkpoint(os.path.join(root, "step_6")) != []
+    # ... but load falls back to the last verifiable step
+    state, step, meta = load_checkpoint(root, _state(99), with_meta=True)
+    assert step == 3
+    assert meta == {"batches_consumed": 3}
+    assert _bitwise_equal(state, _state(0))
+    # asking for the corrupt step EXPLICITLY must refuse, not substitute
+    with pytest.raises(CheckpointCorruptionError):
+        load_checkpoint(root, _state(99), step=6)
+
+
+def test_all_corrupt_raises(tmp_path):
+    root = str(tmp_path)
+    with faultinject.fault_plan("ckpt.manifest.corrupt@*"):
+        save_checkpoint(root, _state(0), step=1)
+        save_checkpoint(root, _state(1), step=2)
+    with pytest.raises(CheckpointCorruptionError):
+        load_checkpoint(root, _state(99))
+
+
+def test_gc_keep_counts_only_committed(tmp_path):
+    root = str(tmp_path)
+    for s in range(5):
+        save_checkpoint(root, _state(s), step=s, keep=2)
+    # a torn dir must not crowd a good checkpoint out of the keep window,
+    # and one newer than every committed step is a possibly-live writer
+    os.makedirs(os.path.join(root, "step_2"))       # superseded torn dir
+    os.makedirs(os.path.join(root, "step_10"))      # torn, newest
+    save_checkpoint(root, _state(5), step=5, keep=2)
+    steps = sorted(d for d in os.listdir(root) if d.startswith("step_"))
+    assert steps == ["step_10", "step_4", "step_5"]
+    assert latest_step(root) == 5  # the torn step_10 stays invisible
+
+
+def test_gc_never_collects_the_protected_step(tmp_path):
+    root = str(tmp_path)
+    save_checkpoint(root, _state(0), step=5, keep=1)
+    # re-saving an OLDER step with keep=1 would nominate it for deletion —
+    # the just-written step must survive regardless
+    save_checkpoint(root, _state(1), step=3, keep=1)
+    assert os.path.isdir(os.path.join(root, "step_3"))
+
+
+def test_gc_tolerates_missing_root():
+    _gc_old("/definitely/not/a/path", keep=2)  # no raise
+
+
+def test_gc_sweeps_aged_tmp_debris(tmp_path):
+    root = str(tmp_path)
+    dead = os.path.join(root, ".tmp_step_9_deadbeef")
+    fresh = os.path.join(root, ".tmp_step_9_feedface")
+    os.makedirs(dead)
+    os.makedirs(fresh)
+    old = time.time() - 7200
+    os.utime(dead, (old, old))
+    save_checkpoint(root, _state(0), step=0)
+    assert not os.path.isdir(dead)   # aged-out crash debris collected
+    assert os.path.isdir(fresh)      # plausibly a live writer: kept
+
+
+def test_verify_reports_truncation_and_missing(tmp_path):
+    root = str(tmp_path)
+    final = save_checkpoint(root, _state(0), step=0)
+    with open(os.path.join(final, MANIFEST_NAME)) as f:
+        rels = list(json.load(f)["files"])
+    victim = max(rels, key=lambda r: os.path.getsize(
+        os.path.join(final, r)))
+    with open(os.path.join(final, victim), "r+b") as fh:
+        fh.truncate(os.path.getsize(os.path.join(final, victim)) // 2)
+    problems = verify_checkpoint(final)
+    assert any("size mismatch" in p for p in problems)
+    os.remove(os.path.join(final, victim))
+    assert any("missing" in p for p in verify_checkpoint(final))
+
+
+def test_retry_io_redrives_transients_only(monkeypatch):
+    calls = {"n": 0}
+    monkeypatch.setattr(time, "sleep", lambda s: None)
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient NFS hiccup")
+        return "ok"
+
+    assert _retry_io(flaky, "test") == "ok"
+    assert calls["n"] == 3
+
+    def broken():
+        raise ValueError("logic bug")
+
+    with pytest.raises(ValueError):
+        _retry_io(broken, "test")

@@ -1,0 +1,100 @@
+"""Fault-harness semantics: schedule parsing, counting, arming lifecycle."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from easydist_tpu.resilience import faultinject
+from easydist_tpu.resilience.faultinject import (FAULT_POINTS,
+                                                 FaultPlanError,
+                                                 InjectedFault)
+
+
+def test_disarmed_is_noop():
+    faultinject.disarm()
+    assert not faultinject.armed()
+    for p in FAULT_POINTS:
+        assert faultinject.fire(p) is False
+    faultinject.crash_point("ckpt.write.partial")  # no raise
+
+
+def test_parse_plan():
+    plan = faultinject.parse_plan("step.nan_grad@7,data.stall@*")
+    assert plan == {"step.nan_grad": 7, "data.stall": "*"}
+
+
+@pytest.mark.parametrize("bad", [
+    "nope.unknown@1",          # uncatalogued name
+    "step.nan_grad",           # missing @occurrence
+    "step.nan_grad@0",         # occurrences are 1-based
+    "step.nan_grad@x",         # not an int
+])
+def test_bad_plans_raise(bad):
+    with pytest.raises(FaultPlanError):
+        faultinject.parse_plan(bad)
+
+
+def test_nth_occurrence_fires_exactly_once():
+    with faultinject.fault_plan("step.nan_grad@3"):
+        hits = [faultinject.fire("step.nan_grad") for _ in range(6)]
+        assert hits == [False, False, True, False, False, False]
+        assert faultinject.stats()["fired"]["step.nan_grad"] == 1
+    assert not faultinject.armed()
+
+
+def test_star_fires_every_hit():
+    with faultinject.fault_plan("serve.exec_timeout@*"):
+        assert all(faultinject.fire("serve.exec_timeout")
+                   for _ in range(4))
+
+
+def test_crash_point_raises_with_point():
+    with faultinject.fault_plan("ckpt.write.partial@1"):
+        with pytest.raises(InjectedFault) as ei:
+            faultinject.crash_point("ckpt.write.partial")
+        assert ei.value.point == "ckpt.write.partial"
+
+
+def test_nested_fault_plan_restores_outer():
+    with faultinject.fault_plan("data.stall@1"):
+        with faultinject.fault_plan("step.nan_grad@1"):
+            assert faultinject.fire("step.nan_grad")
+        # outer plan restored with fresh counters
+        assert faultinject.armed()
+        assert faultinject.fire("data.stall")
+    assert not faultinject.armed()
+
+
+def test_uncatalogued_code_point_rejected_when_armed():
+    with faultinject.fault_plan("data.stall@1"):
+        with pytest.raises(FaultPlanError):
+            faultinject.fire("not.a.point")
+
+
+def test_env_plan_validated_at_import():
+    """A typo'd EASYDIST_FAULT_PLAN must fail at import, not silently test
+    nothing."""
+    env = dict(os.environ)
+    env["EASYDIST_FAULT_PLAN"] = "definitely.not.real@1"
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import easydist_tpu.resilience.faultinject"],
+        env=env, capture_output=True, text=True)
+    assert proc.returncode != 0
+    assert "definitely.not.real" in proc.stderr
+
+
+def test_arm_from_config(monkeypatch):
+    from easydist_tpu import config as edconfig
+
+    monkeypatch.setattr(edconfig, "fault_plan", "data.stall@2",
+                        raising=False)
+    try:
+        faultinject.arm_from_config()
+        assert faultinject.armed()
+        assert not faultinject.fire("data.stall")
+        assert faultinject.fire("data.stall")
+    finally:
+        faultinject.disarm()

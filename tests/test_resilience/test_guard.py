@@ -1,0 +1,148 @@
+"""NaN/Inf step guard: skip-and-hold semantics, overflow scale, the host
+budget, fault-injected poisoning — and the satellite contract that the
+guard-OFF build emits a bitwise-identical trace (jaxpr identity)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from easydist_tpu.analyze import audit_guard_parity
+from easydist_tpu.jaxfront import make_device_mesh
+from easydist_tpu.resilience import faultinject
+from easydist_tpu.resilience.guard import (GuardBudgetExceededError,
+                                           GuardedStep, all_finite,
+                                           guard_train_step,
+                                           init_guard_state, poison_batch)
+
+
+def _step(state, x):
+    new = state + jnp.mean(x)
+    return new, jnp.mean(x) ** 2
+
+
+def test_all_finite_ignores_int_leaves():
+    assert bool(all_finite({"a": jnp.ones(3), "n": jnp.arange(3)}))
+    assert not bool(all_finite({"a": jnp.array([1.0, jnp.nan])}))
+    assert bool(all_finite({"n": jnp.arange(3)}))  # no inexact leaves
+
+
+def test_skip_and_hold():
+    gstep = jax.jit(guard_train_step(_step, scale_decay=0.5,
+                                     scale_growth_every=100))
+    carry = (jnp.zeros(()), init_guard_state())
+    carry, loss = gstep(carry, jnp.ones(4))
+    assert float(carry[0]) == 1.0
+    carry, loss = gstep(carry, jnp.full(4, jnp.nan))
+    # state HELD, candidate loss surfaced untouched (NaN, not hidden)
+    assert float(carry[0]) == 1.0
+    assert np.isnan(float(loss))
+    gs = carry[1]
+    assert int(gs["consecutive"]) == 1 and int(gs["skips"]) == 1
+    assert float(gs["scale"]) == pytest.approx(0.5)
+    carry, loss = gstep(carry, jnp.ones(4))
+    assert float(carry[0]) == 2.0
+    assert int(carry[1]["consecutive"]) == 0  # reset on a clean step
+
+
+def test_scale_recovers_after_clean_run():
+    gstep = jax.jit(guard_train_step(_step, scale_decay=0.5,
+                                     scale_growth_every=2, scale_max=1.0))
+    carry = (jnp.zeros(()), init_guard_state())
+    carry, _ = gstep(carry, jnp.full(4, jnp.inf))
+    assert float(carry[1]["scale"]) == pytest.approx(0.5)
+    for _ in range(4):
+        carry, _ = gstep(carry, jnp.ones(4))
+    assert float(carry[1]["scale"]) == pytest.approx(1.0)  # capped at max
+
+
+def test_guarded_step_budget_raises():
+    guarded = GuardedStep(_step, max_consecutive_skips=2)
+    state = jnp.zeros(())
+    bad = jnp.full(4, jnp.nan)
+    state, _ = guarded(state, bad)
+    state, _ = guarded(state, bad)
+    with pytest.raises(GuardBudgetExceededError) as ei:
+        guarded(state, bad)
+    assert ei.value.consecutive == 3 and ei.value.budget == 2
+    assert guarded.stats()["skips"] == 3
+
+
+def test_fault_injected_poison_skips_exactly_one_step():
+    with faultinject.fault_plan("step.nan_grad@2"):
+        guarded = GuardedStep(_step, max_consecutive_skips=4)
+        state = jnp.zeros(())
+        for _ in range(4):
+            state, _ = guarded(state, jnp.ones(4))
+    st = guarded.stats()
+    assert st["skips"] == 1 and st["steps"] == 4
+    # held through the poisoned step: 3 clean +1.0 updates applied
+    assert float(state) == pytest.approx(3.0)
+
+
+def test_poison_batch():
+    x, n = jnp.ones((2, 3)), jnp.arange(4)
+    px, pn = poison_batch((x, n))
+    assert np.isnan(np.asarray(px)).all() and px.shape == x.shape
+    assert pn is n
+    with pytest.raises(ValueError):
+        poison_batch((jnp.arange(4),))
+
+
+# ---------------------------------------------------------------- builders
+
+def _loss_fn(params, x, y):
+    return jnp.mean((x @ params["w"] - y) ** 2)
+
+
+def _example(key=0):
+    params = {"w": jax.random.normal(jax.random.PRNGKey(key), (4, 2))}
+    x = jax.random.normal(jax.random.PRNGKey(key + 1), (8, 4))
+    y = jax.random.normal(jax.random.PRNGKey(key + 2), (8, 2))
+    return params, x, y
+
+
+@pytest.mark.world_8
+def test_guard_off_trace_identity_dp_builders(cpu_devices):
+    """Satellite (d): with the guard off, the builders must emit the SAME
+    program as an explicit step_guard=False build — jaxpr identity via the
+    RES001 audit, not allclose."""
+    from easydist_tpu.parallel import ddp_step, zero2_step, zero3_step
+
+    mesh = make_device_mesh((8,), ("dp",))
+    params, x, y = _example()
+
+    default = ddp_step(_loss_fn, mesh, lr=0.1)
+    explicit_off = ddp_step(_loss_fn, mesh, lr=0.1, step_guard=False)
+    assert audit_guard_parity(default, explicit_off, (params, x, y)) == []
+
+    s_def, init_opt = zero2_step(_loss_fn, mesh, lr=1e-3)
+    s_off, _ = zero2_step(_loss_fn, mesh, lr=1e-3, step_guard=False)
+    state = (params, init_opt(params), jnp.zeros((), jnp.int32))
+    assert audit_guard_parity(s_def, s_off, (state, x, y)) == []
+
+    z_def, init_state = zero3_step(_loss_fn, mesh, lr=1e-3)
+    z_off, _ = zero3_step(_loss_fn, mesh, lr=1e-3, step_guard=False)
+    zstate = jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype),
+        jax.eval_shape(init_state, params))
+    ja = jax.make_jaxpr(z_def)(zstate, x, y)
+    jb = jax.make_jaxpr(z_off)(zstate, x, y)
+    assert str(ja) == str(jb)
+
+
+@pytest.mark.world_8
+def test_guard_on_ddp_holds_poisoned_batch(cpu_devices):
+    from easydist_tpu.parallel import ddp_step
+
+    mesh = make_device_mesh((8,), ("dp",))
+    params, x, y = _example()
+    step = ddp_step(_loss_fn, mesh, lr=0.1, step_guard=True)
+    carry = (params, init_guard_state())
+    carry, loss = step(carry, x, y)
+    good = np.asarray(carry[0]["w"])
+    carry, loss = step(carry, jnp.full_like(x, jnp.nan), y)
+    held = np.asarray(carry[0]["w"])
+    np.testing.assert_array_equal(held, good)  # bitwise hold
+    assert int(carry[1]["skips"]) == 1

@@ -1,0 +1,184 @@
+"""Kill-and-resume matrix: every catalogued fault point, injected
+deterministically, must recover to the bitwise-identical result of an
+uninterrupted run (training faults) or the unfaulted response (serving
+faults).  This is the DistIR-style acceptance gate for the whole
+resilience layer."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from easydist_tpu.resilience import faultinject
+from easydist_tpu.resilience.faultinject import InjectedFault
+from easydist_tpu.resilience.preempt import PreemptedError
+from easydist_tpu.runtime.checkpoint import checkpoint_meta, latest_step
+from easydist_tpu.runtime.elastic import DataStallError, run_training
+
+TOTAL = 10
+EVERY = 3
+
+
+def _make_step():
+    def loss_fn(w, x, y):
+        return jnp.mean((x @ w - y) ** 2)
+
+    @jax.jit
+    def step(w, x, y):
+        loss, g = jax.value_and_grad(loss_fn)(w, x, y)
+        return w - 0.1 * g, loss
+
+    return step
+
+
+def _init_w():
+    return jnp.zeros((4, 2), jnp.float32)
+
+
+class Loader:
+    """Deterministic cursor-skippable stream: batch i is a pure function
+    of i, so resume-after-skip replays the exact same samples."""
+
+    def __init__(self):
+        self.batches_consumed = 0
+
+    def skip(self, n):
+        self.batches_consumed += n
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        i = self.batches_consumed
+        self.batches_consumed += 1
+        kx, ky = jax.random.split(jax.random.PRNGKey(i))
+        return (jax.random.normal(kx, (8, 4)),
+                jax.random.normal(ky, (8, 2)))
+
+
+def _run(ckpt_dir, **kw):
+    return run_training(_make_step(), _init_w, Loader(), str(ckpt_dir),
+                        TOTAL, checkpoint_every=EVERY, **kw)
+
+
+def _bits(state):
+    return np.asarray(jax.device_get(state)).tobytes()
+
+
+@pytest.fixture(scope="module")
+def baseline(tmp_path_factory):
+    """One uninterrupted run: the bitwise reference every fault must
+    recover to."""
+    final = _run(tmp_path_factory.mktemp("baseline"))
+    return _bits(final)
+
+
+def test_recover_ckpt_write_partial(tmp_path, baseline):
+    # the 2nd save (the step-6 checkpoint) tears an array file and dies
+    with faultinject.fault_plan("ckpt.write.partial@2"):
+        with pytest.raises(InjectedFault):
+            _run(tmp_path)
+    assert latest_step(str(tmp_path)) == 3  # the torn write never committed
+    final = _run(tmp_path)  # restart, disarmed
+    assert _bits(final) == baseline
+
+
+def test_recover_ckpt_manifest_corrupt(tmp_path, baseline):
+    # bit rot lands in the step-6 checkpoint AFTER it commits; the crash
+    # comes later, and resume must fall back to step 3 and replay
+    with faultinject.fault_plan(
+            "ckpt.manifest.corrupt@2,preempt.sigterm@8"):
+        with pytest.raises(PreemptedError):
+            _run(tmp_path)
+    assert latest_step(str(tmp_path)) == 7  # the preemption checkpoint
+    # ... which is fine; corrupt ONLY the fallback scenario: nuke it so
+    # resume is forced through the corrupt step-6 manifest
+    import shutil
+    shutil.rmtree(str(tmp_path / "step_7"))
+    final = _run(tmp_path)
+    assert _bits(final) == baseline
+
+
+def test_recover_preempt_sigterm(tmp_path, baseline):
+    with faultinject.fault_plan("preempt.sigterm@5"):
+        with pytest.raises(PreemptedError) as ei:
+            _run(tmp_path)
+    step = ei.value.step
+    assert latest_step(str(tmp_path)) == step
+    meta = checkpoint_meta(str(tmp_path), step)
+    assert meta["preempted"] is True
+    assert meta["batches_consumed"] == step  # one batch per step here
+    final = _run(tmp_path)
+    assert _bits(final) == baseline
+
+
+def test_recover_data_stall(tmp_path, baseline):
+    with faultinject.fault_plan("data.stall@5"):
+        with pytest.raises(DataStallError):
+            _run(tmp_path, data_timeout_s=0.2)
+    assert latest_step(str(tmp_path)) == 3
+    final = _run(tmp_path, data_timeout_s=0.2)
+    assert _bits(final) == baseline
+
+
+def test_recover_step_nan_grad(tmp_path):
+    """The guarded run survives a poisoned batch; injected recovery is
+    DETERMINISTIC: the same fault plan reproduces the same final state
+    bitwise, and the guard evidence commits with the checkpoint."""
+    with faultinject.fault_plan("step.nan_grad@4"):
+        final_a = _run(tmp_path / "a", step_guard=True)
+    with faultinject.fault_plan("step.nan_grad@4"):
+        final_b = _run(tmp_path / "b", step_guard=True)
+    assert np.isfinite(np.asarray(final_a)).all()
+    assert _bits(final_a) == _bits(final_b)
+    guard = checkpoint_meta(str(tmp_path / "a"), TOTAL)["guard"]
+    assert guard["skips"] == 1 and guard["steps"] == TOTAL
+
+
+def _echo_engine(**cfg_kw):
+    from easydist_tpu.serve import ServeConfig, ServeEngine
+
+    cfg = ServeConfig(batch_buckets=cfg_kw.pop("batch_buckets", (1,)),
+                      max_wait_ms=1.0, max_retries=0, **cfg_kw)
+    return ServeEngine(lambda a: np.asarray(a) * 2.0, cfg, compile=False)
+
+
+def test_recover_serve_exec_timeout():
+    from easydist_tpu.serve import ExecTimeoutError
+
+    x = np.arange(3, dtype=np.float32)
+    with _echo_engine(exec_timeout_ms=150.0) as engine:
+        with faultinject.fault_plan("serve.exec_timeout@1"):
+            fut = engine.submit(x)
+            with pytest.raises(ExecTimeoutError):
+                fut.result(timeout=30)
+            # the wedged dispatch was abandoned; the next request is served
+            # by a fresh worker and matches the unfaulted answer bitwise
+            out = engine.infer(x, timeout=30)
+    np.testing.assert_array_equal(out, x * 2.0)
+    assert engine.metrics.counter("exec_timeouts") == 1
+
+
+def test_recover_serve_oom_bucket():
+    from easydist_tpu.serve import ServeConfig, ServeEngine
+
+    # generous max_wait so the two requests coalesce into one bucket-2
+    # batch: that compile "OOMs", the bucket is disabled, and the group is
+    # re-packed into two bucket-1 batches that both succeed
+    cfg = ServeConfig(batch_buckets=(1, 2), max_wait_ms=200.0,
+                      max_retries=0)
+    xs = [np.arange(3, dtype=np.float32) + i for i in range(2)]
+    engine = ServeEngine(lambda a: np.asarray(a) * 2.0, cfg, compile=False)
+    with faultinject.fault_plan("serve.oom_bucket@1"):
+        # enqueue BOTH before the batcher starts draining, so they pack
+        # into one bucket-2 batch deterministically
+        futs = [engine.submit(x) for x in xs]
+        with engine:
+            outs = [f.result(timeout=30) for f in futs]
+            h = engine.health()
+    for x, out in zip(xs, outs):
+        np.testing.assert_array_equal(out, x * 2.0)
+    assert h["oom_degradations"] == 1
+    assert h["disabled_batch_buckets"] == [2]
+    assert h["degraded"] and h["ready"]  # degraded but still serving

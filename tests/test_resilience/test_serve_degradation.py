@@ -1,0 +1,142 @@
+"""Serve-layer degradation: the execute watchdog, the circuit breaker
+(trip -> shed -> probe -> close), jittered deadline-respecting retry, and
+the health/readiness surface."""
+
+import time
+
+import numpy as np
+import pytest
+
+from easydist_tpu.serve import (CircuitOpenError, ExecTimeoutError,
+                                ServeConfig, ServeEngine)
+from easydist_tpu.serve.admission import retry_transient
+
+
+def _engine(fn, **cfg_kw):
+    cfg = ServeConfig(batch_buckets=cfg_kw.pop("batch_buckets", (1,)),
+                      max_wait_ms=1.0,
+                      max_retries=cfg_kw.pop("max_retries", 0), **cfg_kw)
+    return ServeEngine(fn, cfg, compile=False)
+
+
+def test_health_happy_path():
+    with _engine(lambda a: np.asarray(a) + 1.0) as engine:
+        out = engine.infer(np.zeros(2, np.float32), timeout=30)
+        np.testing.assert_array_equal(out, np.ones(2, np.float32))
+        h = engine.health()
+    assert h["ready"] and not h["degraded"]
+    assert h["breaker_state"] == "disabled"
+    assert "breaker" not in engine.stats()  # disabled -> not reported
+
+
+def test_watchdog_abandons_wedged_dispatch():
+    sleep_s = {"v": 0.5}
+
+    def wedged(a):
+        time.sleep(sleep_s["v"])
+        return np.asarray(a) * 2.0
+
+    x = np.arange(2, dtype=np.float32)
+    with _engine(wedged, exec_timeout_ms=100.0) as engine:
+        with pytest.raises(ExecTimeoutError):
+            engine.infer(x, timeout=30)
+        # recovery: the abandoned worker finishes into the void; a fresh
+        # worker serves the next (now fast) request
+        sleep_s["v"] = 0.0
+        out = engine.infer(x, timeout=30)
+    np.testing.assert_array_equal(out, x * 2.0)
+    assert engine.metrics.counter("exec_timeouts") == 1
+    assert engine.health()["degraded"]  # timeouts observed -> degraded
+
+
+def test_breaker_trips_sheds_probes_recovers():
+    boom = {"on": True}
+
+    def model(a):
+        if boom["on"]:
+            raise RuntimeError("boom")  # non-transient, non-OOM
+        return np.asarray(a) * 2.0
+
+    x = np.ones(2, np.float32)
+    with _engine(model, breaker_failure_threshold=2,
+                 breaker_cooldown_ms=150.0) as engine:
+        for _ in range(2):
+            with pytest.raises(RuntimeError):
+                engine.infer(x, timeout=30)
+        # threshold reached -> OPEN: load shed AT SUBMIT, synchronously
+        with pytest.raises(CircuitOpenError) as ei:
+            engine.submit(x)
+        assert 0.0 < ei.value.retry_after_s <= 0.15 + 1e-6
+        h = engine.health()
+        assert not h["ready"] and h["breaker_state"] == "open"
+        assert h["requests_shed"] == 1
+
+        time.sleep(0.2)  # past the cooldown: one probe is admitted
+        boom["on"] = False
+        out = engine.infer(x, timeout=30)
+        np.testing.assert_array_equal(out, x * 2.0)
+        assert engine.breaker.state == "closed"
+        assert engine.health()["ready"]
+    snap = engine.stats()["breaker"]
+    assert snap["consecutive_failures"] == 0 and snap["times_opened"] == 1
+
+
+def test_breaker_reopen_on_failed_probe():
+    def model(a):
+        raise RuntimeError("boom")
+
+    x = np.ones(2, np.float32)
+    with _engine(model, breaker_failure_threshold=1,
+                 breaker_cooldown_ms=100.0) as engine:
+        with pytest.raises(RuntimeError):
+            engine.infer(x, timeout=30)
+        time.sleep(0.15)
+        # the half-open probe fails -> straight back to OPEN
+        with pytest.raises(RuntimeError):
+            engine.infer(x, timeout=30)
+        with pytest.raises(CircuitOpenError):
+            engine.submit(x)
+
+
+def test_retry_transient_jittered_backoff():
+    calls, slept = {"n": 0}, []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 4:
+            raise RuntimeError("transient wobble")
+        return "ok"
+
+    out = retry_transient(flaky, max_retries=3, backoff_s=0.1,
+                          jitter=0.5, sleep=slept.append,
+                          rng=lambda: 1.0)
+    assert out == "ok" and calls["n"] == 4
+    # backoff_s * 2^k, each stretched by exactly jitter*rng()=0.5
+    assert slept == pytest.approx([0.15, 0.3, 0.6])
+
+
+def test_retry_respects_deadline():
+    """A retry whose backoff lands past the caller's deadline is not
+    taken: the PRIOR failure propagates instead of sleeping uselessly."""
+    now = {"t": 100.0}
+    slept = []
+
+    def failing():
+        raise RuntimeError("transient wobble")
+
+    with pytest.raises(RuntimeError, match="wobble"):
+        retry_transient(failing, max_retries=5, backoff_s=1.0,
+                        sleep=slept.append, deadline_t=100.5,
+                        clock=lambda: now["t"])
+    assert slept == []  # first backoff (1s) would overshoot: no sleep
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        ServeConfig(retry_jitter=1.5)
+    with pytest.raises(ValueError):
+        ServeConfig(exec_timeout_ms=0.0)
+    with pytest.raises(ValueError):
+        ServeConfig(breaker_failure_threshold=-1)
+    with pytest.raises(ValueError):
+        ServeConfig(breaker_cooldown_ms=0.0)
