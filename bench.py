@@ -1129,6 +1129,119 @@ def resilience_main():
     print(json.dumps(result), flush=True)
 
 
+def decode_main():
+    """Token-level decode scenario (`--decode`): KV-cached generation
+    (serve.GenerationSession) against the naive full-re-forward greedy
+    loop, same model, same prompts, greedy ids compared bitwise.
+
+    Prints ONE JSON line gated on three things at once: tokens/s speedup
+    of cached decode over full re-forward at seq 512 (the O(T) vs O(T^2)
+    economics), bitwise greedy parity (the cache must change nothing but
+    the cost), and decode-signature-cache constancy across tokens (one
+    compiled decode step per bucket, ever).  Forced to CPU — the gate is
+    about asymptotics and compiled-step reuse, not device peak."""
+    result = {"metric": "decode_speedup_vs_full_forward", "value": 0.0,
+              "unit": "x"}
+    try:
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import numpy as np
+
+        from easydist_tpu.models.gpt import GPTConfig, gpt_apply, gpt_init
+        from easydist_tpu.serve import GenerationSession, ServeConfig
+
+        seq, prompt_len, max_new, n_req = 512, 16, 48, 2
+        cfg = GPTConfig(vocab=256, seq=seq, dim=64, heads=4, layers=2,
+                        dtype="float32")
+        params = gpt_init(cfg, jax.random.PRNGKey(0))
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, cfg.vocab, size=prompt_len).tolist()
+                   for _ in range(n_req)]
+
+        # ---- baseline: greedy via full re-forward on a padded buffer,
+        # one compiled executable (seq-512 forward), re-run per token
+        fwd = jax.jit(lambda p, t: gpt_apply(p, cfg, t))
+
+        def full_forward_greedy(prompt):
+            buf = np.zeros((1, seq), np.int32)
+            buf[0, :len(prompt)] = prompt
+            n = len(prompt)
+            ids = []
+            for _ in range(max_new):
+                logits = fwd(params, jnp.asarray(buf))
+                nxt = int(jax.block_until_ready(
+                    jnp.argmax(logits[0, n - 1])))
+                ids.append(nxt)
+                buf[0, n] = nxt
+                n += 1
+            return ids
+
+        full_forward_greedy(prompts[0][:prompt_len])  # warm the executable
+        t0 = time.perf_counter()
+        ref_ids = [full_forward_greedy(p) for p in prompts]
+        t_uncached = time.perf_counter() - t0
+        tps_uncached = n_req * max_new / t_uncached
+        log(f"# decode bench: uncached {tps_uncached:.1f} tok/s "
+            f"({t_uncached:.1f}s for {n_req * max_new} tokens)")
+
+        # ---- cached: GenerationSession, compile-warmed by a throwaway
+        # generation so the timed run is pure steady-state replay
+        sconf = ServeConfig(decode_buckets=(seq,), max_decode_slots=n_req)
+        sess = GenerationSession.for_gpt(params, cfg, config=sconf)
+        warm = [sess.submit(p, max_new_tokens=2) for p in prompts]
+        sess.run_until_drained()
+        [f.result(timeout=5) for f in warm]
+        sigs_warm = sess.stats()["decode_signatures"]["size"]
+
+        futs = [sess.submit(p, max_new_tokens=max_new) for p in prompts]
+        step_times = []
+        t0 = time.perf_counter()
+        while any(not f.done() for f in futs):
+            ts = time.perf_counter()
+            made = sess.step()
+            if made:
+                step_times.append((time.perf_counter() - ts) / 1.0)
+        t_cached = time.perf_counter() - t0
+        got_ids = [f.result(timeout=5)["ids"] for f in futs]
+        tps_cached = n_req * max_new / t_cached
+        sigs_after = sess.stats()["decode_signatures"]["size"]
+
+        parity = got_ids == ref_ids
+        sig_constant = sigs_warm == sigs_after == 1
+        speedup = tps_cached / tps_uncached if tps_uncached else 0.0
+        lat_ms = np.array(step_times) * 1e3
+        snap = sess.metrics.snapshot()
+        log(f"# decode bench: cached {tps_cached:.1f} tok/s, "
+            f"speedup {speedup:.1f}x, parity={parity}, "
+            f"signatures {sigs_warm}->{sigs_after}")
+
+        result.update(
+            value=round(speedup, 2),
+            tokens_per_s_cached=round(tps_cached, 1),
+            tokens_per_s_uncached=round(tps_uncached, 1),
+            per_token_p50_ms=round(float(np.percentile(lat_ms, 50)), 3),
+            per_token_p99_ms=round(float(np.percentile(lat_ms, 99)), 3),
+            parity_greedy=bool(parity),
+            signature_cache_constant=bool(sig_constant),
+            decode_signatures=int(sigs_after),
+            tokens_generated=int(
+                snap["counters"].get("tokens_generated", 0)),
+            slot_occupancy=snap["gauges"].get("decode_slot_occupancy"),
+            seq=seq, prompt_len=prompt_len, max_new_tokens=max_new,
+            verdict="ok" if (speedup >= 5.0 and parity and sig_constant)
+            else "regression")
+        sess.metrics.export(sub_key="decode_bench")
+    except Exception as e:  # always land the JSON line
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        result["error"] = f"{type(e).__name__}: {e}"
+        result["verdict"] = "error"
+    print(json.dumps(result), flush=True)
+
+
 if __name__ == "__main__":
     if "--serve" in sys.argv:
         serve_main()
@@ -1140,6 +1253,8 @@ if __name__ == "__main__":
         overlap_main()
     elif "--resilience" in sys.argv:
         resilience_main()
+    elif "--decode" in sys.argv:
+        decode_main()
     elif "--child" in sys.argv:
         child_main()
     else:

@@ -21,7 +21,10 @@ A rule-based analyzer that runs after solving and before execution
   layer 4  resilience auditor (`audit_guard_parity`,
            `audit_checkpoint_root`) — guard-off jaxpr parity (RES001) and
            checkpoint commit-protocol integrity over a checkpoint root
-           (RES002 corrupt COMMITTED, RES003 stale debris).
+           (RES002 corrupt COMMITTED, RES003 stale debris);
+  layer 5  serving auditor (`audit_decode_donation`) — the SERVE001
+           decode-step KV-cache donation lint (a non-donated cache turns
+           every generated token into a full-cache HBM copy).
 
 Surfaced via `CompiledFunction.analyze()`, `bench.py --analyze`, and the
 dryrun gate; findings export through the runtime PerfDB under
@@ -46,6 +49,7 @@ from .resilience_rules import (audit_checkpoint_root, audit_guard_parity,
                                guard_off_jaxpr)
 from .schedule_rules import (gpipe_schedule_tables, schedule_stats,
                              verify_schedule_tables)
+from .serve_rules import audit_decode_donation
 from .strategy_rules import audit_solver_objective, verify_axis
 
 logger = logging.getLogger(__name__)
@@ -61,6 +65,7 @@ __all__ = [
     "lint_overlap_plan", "lint_overlap_jaxpr", "lint_overlap_fn",
     "check_overlap_plan",
     "audit_guard_parity", "audit_checkpoint_root", "guard_off_jaxpr",
+    "audit_decode_donation", "check_decode_donation",
 ]
 
 
@@ -116,3 +121,16 @@ def check_schedule_tables(tables, n_stages: int, n_virtual: int,
     for f in findings:
         logger.log(logging.INFO if f.severity == SEV_INFO
                    else logging.WARNING, "[analyze] %s", f)
+
+
+def check_decode_donation(result, cache_arg: int = 0,
+                          node: str = "decode"):
+    """Compile-time self-check hook for `serve.generation`: audit the
+    compiled decode step's cache donation (SERVE001, warning severity —
+    logs, never raises; a non-donated cache is slow, not wrong).
+    Returns the findings so callers/tests can assert on them."""
+    findings = audit_decode_donation(result, cache_arg=cache_arg,
+                                     node=node)
+    for f in findings:
+        logger.warning("[analyze] %s", f)
+    return findings

@@ -112,6 +112,12 @@ RULES: Dict[str, tuple] = {
     "RES003": (SEV_WARNING,
                "stale uncommitted checkpoint debris (dead .tmp_* write "
                "dirs or superseded torn step_N dirs awaiting GC)"),
+    # ---- layer 5: serving auditor (decode-step cache donation,
+    #      analyze/serve_rules.py)
+    "SERVE001": (SEV_WARNING,
+                 "decode-step KV cache input not donated (every token "
+                 "pays a full-cache HBM copy instead of an in-place "
+                 "XLA update)"),
 }
 
 

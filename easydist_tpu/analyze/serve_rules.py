@@ -1,0 +1,57 @@
+"""Layer 5: serving auditor.
+
+One rule so far: SERVE001 — the decode-step cache-donation lint.  The
+whole economics of token-level serving (serve/generation.py) rests on the
+KV cache pool being updated *in place* by XLA: a decode step's cost is
+one row write plus attention reads.  If the cache input is not donated,
+every token instead pays a full copy of layers x slots x bucket x dim
+bytes on the cache update — correct, silent, and catastrophically slow.
+This audit checks the compiled decode step's donation vector covers every
+leaf of the cache argument, so the regression is caught at compile time
+rather than in a latency dashboard.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .findings import Finding, make_finding
+
+
+def _arg_leaf_ranges(in_tree) -> List[tuple]:
+    """[(start, stop)) flat-leaf index ranges of each positional arg in a
+    CompileResult's input treedef (structured ((args...), {kwargs}))."""
+    args_tree = in_tree.children()[0]
+    ranges = []
+    base = 0
+    for child in args_tree.children():
+        n = child.num_leaves
+        ranges.append((base, base + n))
+        base += n
+    return ranges
+
+
+def audit_decode_donation(result, cache_arg: int = 0,
+                          node: str = "decode") -> List[Finding]:
+    """SERVE001: verify every flat leaf of positional arg `cache_arg` is
+    in `result.donated_invars`.  Non-donated leaves aggregate into ONE
+    finding (one decode step, one verdict); returns [] when the cache is
+    fully donated."""
+    ranges = _arg_leaf_ranges(result.in_tree)
+    if cache_arg >= len(ranges):
+        return [make_finding(
+            "SERVE001", node,
+            f"cache arg index {cache_arg} out of range: the compiled "
+            f"step has {len(ranges)} positional args")]
+    start, stop = ranges[cache_arg]
+    donated = set(getattr(result, "donated_invars", ()) or ())
+    missing = [i for i in range(start, stop) if i not in donated]
+    if not missing:
+        return []
+    return [make_finding(
+        "SERVE001", node,
+        f"{len(missing)}/{stop - start} cache leaves (flat input indices "
+        f"{missing[:8]}{'...' if len(missing) > 8 else ''}) are not "
+        f"donated; the decode step will copy the full KV cache every "
+        f"token (donate_state/enable_donation off, or the cache is not "
+        f"threaded as a paired state output)")]

@@ -222,6 +222,20 @@ enable_donation = _env_bool("EASYDIST_ENABLE_DONATION", True)
 # jax.remat policy applied to the emitted function: "none" | "dots" | "all"
 remat_policy = os.environ.get("EASYDIST_REMAT_POLICY", "none")
 
+# ---------------- decode serving (easydist_tpu.serve.generation) --------
+# attention backend for the cache-carrying decode step: "auto" (Pallas
+# single-query flash kernel on TPU, masked dot_general elsewhere), "flash"
+# (force the kernel; interpreted off-TPU), "xla" (force the masked
+# dot_general path).  TRACE-AFFECTING: the backends emit different
+# programs for identical input shapes, so this is part of the
+# strategy-cache salt.
+decode_attention_backend = os.environ.get("EASYDIST_DECODE_ATTENTION",
+                                          "auto")
+# K/V rows streamed per grid step by the decode kernel (VMEM residency per
+# program is O(block), independent of cache length).  TRACE-AFFECTING:
+# changes the pallas_call grid, so it salts the strategy cache too.
+decode_block_k = _env_int("EASYDIST_DECODE_BLOCK_K", 256)
+
 # ---------------- resilience (easydist_tpu.resilience) ----------------
 # deterministic fault schedule, e.g. "step.nan_grad@7,ckpt.write.partial@2"
 # — names must come from resilience.faultinject.FAULT_POINTS (validated at

@@ -269,7 +269,7 @@ def _compile_cache_key(closed_jaxpr, axis_specs) -> str:
     # schema + cost-model salt: cached strategies are only valid for the
     # solver/cost-model that produced them; a version bump or a tuned
     # bandwidth/latency knob must miss, not silently serve stale plans
-    h.update(("v5|" + "|".join(
+    h.update(("v6|" + "|".join(
         f"{k}={getattr(edconfig, k)}" for k in
         ("ici_bandwidth", "dcn_bandwidth", "ici_latency", "dcn_latency",
          "hbm_bandwidth", "all_to_all_punish_factor",
@@ -290,7 +290,12 @@ def _compile_cache_key(closed_jaxpr, axis_specs) -> str:
          # the NaN-step guard rewrites the traced step (lax.cond
          # skip-and-hold around the update), so guarded and unguarded
          # builds must not share cached strategies
-         "resilience_step_guard"))).encode())
+         "resilience_step_guard",
+         # decode-attention backend/block choice changes the decode-step
+         # program (pallas_call kernel vs masked dot_general) at identical
+         # input shapes, so serve decode builds must not share strategies
+         # across backends
+         "decode_attention_backend", "decode_block_k"))).encode())
     names = VarNames()
     for v in closed_jaxpr.jaxpr.invars:
         names.name(v)
@@ -1043,6 +1048,11 @@ def _finish_compile(closed_jaxpr, jaxpr, names, per_axis, graph, axis_specs,
                            in_avals=in_avals)
     result.remat_plan = remat_plan
     result.closed_jaxpr = closed_jaxpr
+    # donation audit surface (analyze.audit_decode_donation / SERVE001):
+    # flat input indices donated to XLA, and the whole positional args the
+    # pytree-native wrapper donates
+    result.donated_invars = donate
+    result.donated_args = tuple(donate_args)
     result.replicated_flops_fraction = replicated_fraction
     result.analysis_findings = list(analysis_findings or [])
     result.solver_audits = list(solver_audits or [])

@@ -101,7 +101,7 @@ def _layernorm(x, g, b, eps=1e-5):
     return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
 
 
-def _attention(x, p, cfg: "GPTConfig", dtype):
+def _attention(x, p, cfg: "GPTConfig", dtype, return_kv: bool = False):
     heads = cfg.heads
     b, t, d = x.shape
     hd = d // heads
@@ -136,7 +136,10 @@ def _attention(x, p, cfg: "GPTConfig", dtype):
         att = jax.nn.softmax(att, axis=-1)
         out = jnp.einsum("bhqk,bhkd->bhqd", att, v)
     out = out.transpose(0, 2, 1, 3).reshape(b, t, d)
-    return out @ p["proj"]["w"].astype(dtype) + p["proj"]["b"].astype(dtype)
+    out = out @ p["proj"]["w"].astype(dtype) + p["proj"]["b"].astype(dtype)
+    if return_kv:
+        return out, k, v  # k, v: [b, heads, t, hd], pre-projection
+    return out
 
 
 def gpt_apply(params, cfg: GPTConfig, tokens):
@@ -173,6 +176,135 @@ def gpt_apply(params, cfg: GPTConfig, tokens):
             x = block_fn(blk, x)
     x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
     return x.astype(jnp.float32) @ params["wte"].T
+
+
+# --------------------------------------------------------- KV-cache decode
+#
+# Autoregressive serving forward: `gpt_prefill` runs the prompt once and
+# fills a per-layer K/V cache; `gpt_decode_step` then attends ONE new token
+# against the cache — O(layers * len) per token instead of the O(len^2)
+# full re-forward.  Both are pure functions returning the updated cache, so
+# a jit of the step with the cache input donated updates it in place
+# (analyze rule SERVE001 audits exactly that).
+
+
+def init_kv_cache(cfg: GPTConfig, batch: int, max_len: int, dtype=None):
+    """Zeroed KV cache {"k", "v"}: [layers, batch, heads, max_len,
+    head_dim].  Layer-stacked so the cache is two leaves regardless of
+    depth (donation and sharding specs stay O(1)); the heads axis (dim 2)
+    is the natural tensor-parallel shard dim, matching the solved qkv
+    column-parallel strategy.  `dtype=None`/"auto" stores at the compute
+    dtype; pass e.g. "bfloat16" to halve cache HBM."""
+    if max_len > cfg.seq:
+        raise ValueError(
+            f"max_len {max_len} exceeds the learned position table "
+            f"(cfg.seq={cfg.seq})")
+    hd = cfg.dim // cfg.heads
+    dt = jnp.dtype(cfg.dtype if dtype in (None, "auto") else dtype)
+    shape = (cfg.layers, batch, cfg.heads, max_len, hd)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def _block_list(params, cfg):
+    """Per-layer block pytrees whether `params["blocks"]` is a list or the
+    scan_layers layer-stacked form."""
+    blocks = params["blocks"]
+    if cfg.scan_layers:
+        return [jax.tree_util.tree_map(lambda p, i=i: p[i], blocks)
+                for i in range(cfg.layers)]
+    return list(blocks)
+
+
+def _cache_write_row(cache_layer, new, pos):
+    """Write one new K or V row per sequence: cache_layer [b, h, T, hd],
+    new [b, h, hd], pos int32 [b] -> updated layer.  Per-row
+    dynamic_update_slice touches only each sequence's own position."""
+    return jax.vmap(
+        lambda c, n, p: jax.lax.dynamic_update_slice(
+            c, n[:, None, :].astype(c.dtype), (0, p, 0)))(
+        cache_layer, new, pos.astype(jnp.int32))
+
+
+def gpt_prefill(params, cfg: GPTConfig, cache, tokens, lengths):
+    """Prompt pass: run `tokens` (int32 [batch, t], padded) through the
+    model, write every position's K/V into `cache`, and return
+    (cache, logits) with logits [batch, vocab] taken at each row's last
+    real position (`lengths` - 1).
+
+    The attention is the standard causal forward, so positions < length
+    compute exactly what `gpt_apply` computes; the padded tail writes
+    garbage K/V that the decode-step length mask never attends."""
+    dtype = jnp.dtype(cfg.dtype)
+    b, t = tokens.shape
+    x = params["wte"][tokens].astype(dtype) \
+        + params["wpe"].astype(dtype)[None, :t]
+    ks, vs = [], []
+    for blk in _block_list(params, cfg):
+        attn_out, k, v = _attention(
+            _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"]).astype(dtype),
+            blk["attn"], cfg, dtype, return_kv=True)
+        x = x + attn_out
+        ks.append(k)
+        vs.append(v)
+        h = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"]).astype(dtype)
+        h = jax.nn.gelu(h @ blk["mlp"]["fc"]["w"].astype(dtype)
+                        + blk["mlp"]["fc"]["b"].astype(dtype))
+        x = x + (h @ blk["mlp"]["proj"]["w"].astype(dtype)
+                 + blk["mlp"]["proj"]["b"].astype(dtype))
+    cache = {
+        "k": cache["k"].at[:, :, :, :t, :].set(
+            jnp.stack(ks).astype(cache["k"].dtype)),
+        "v": cache["v"].at[:, :, :, :t, :].set(
+            jnp.stack(vs).astype(cache["v"].dtype)),
+    }
+    x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
+    last = jnp.take_along_axis(
+        x, (lengths.astype(jnp.int32) - 1)[:, None, None], axis=1)[:, 0]
+    return cache, last.astype(jnp.float32) @ params["wte"].T
+
+
+def gpt_decode_step(params, cfg: GPTConfig, cache, token, pos):
+    """One cached decode step: feed `token` (int32 [batch]) at position
+    `pos` (int32 [batch], == current sequence length per row) and return
+    (cache, logits [batch, vocab]) for sampling the next token.
+
+    Per-token work is O(layers * pos) attention reads plus the O(1)
+    matmuls — independent of how many tokens were already generated.  The
+    attention backend is `ops.decode_attention` (Pallas single-query flash
+    kernel on TPU, masked dot_general elsewhere)."""
+    from easydist_tpu.ops import decode_attention
+
+    dtype = jnp.dtype(cfg.dtype)
+    heads = cfg.heads
+    b = token.shape[0]
+    hd = cfg.dim // heads
+    pos = pos.astype(jnp.int32)
+    x = params["wte"][token].astype(dtype) \
+        + params["wpe"][pos].astype(dtype)
+    new_k, new_v = [], []
+    for li, blk in enumerate(_block_list(params, cfg)):
+        p_at = blk["attn"]
+        h_in = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"]).astype(dtype)
+        qkv = h_in @ p_at["qkv"]["w"].astype(dtype) \
+            + p_at["qkv"]["b"].astype(dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, heads, hd)
+        ck = _cache_write_row(cache["k"][li], k.reshape(b, heads, hd), pos)
+        cv = _cache_write_row(cache["v"][li], v.reshape(b, heads, hd), pos)
+        new_k.append(ck)
+        new_v.append(cv)
+        att = decode_attention(q, ck.astype(dtype), cv.astype(dtype),
+                               pos + 1)
+        x = x + (att.reshape(b, cfg.dim) @ p_at["proj"]["w"].astype(dtype)
+                 + p_at["proj"]["b"].astype(dtype))
+        h = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"]).astype(dtype)
+        h = jax.nn.gelu(h @ blk["mlp"]["fc"]["w"].astype(dtype)
+                        + blk["mlp"]["fc"]["b"].astype(dtype))
+        x = x + (h @ blk["mlp"]["proj"]["w"].astype(dtype)
+                 + blk["mlp"]["proj"]["b"].astype(dtype))
+    cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
+    return cache, x.astype(jnp.float32) @ params["wte"].T
 
 
 def gpt_loss(params, cfg: GPTConfig, tokens, targets):

@@ -122,6 +122,138 @@ def llama_apply(params, cfg: LlamaConfig, tokens):
     return x.astype(jnp.float32) @ params["wte"].T
 
 
+# --------------------------------------------------------- KV-cache decode
+#
+# Same contract as models/gpt.py: `init_kv_cache` + `llama_prefill` +
+# `llama_decode_step`, returning the updated cache functionally so the
+# compiled step donates it.  The cache stores ROPED keys at kv_heads
+# granularity (GQA: the repeat to full heads happens at attention time, so
+# cache HBM scales with kv_heads, not heads).
+
+
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=None):
+    """Zeroed KV cache {"k", "v"}: [layers, batch, kv_heads, max_len,
+    head_dim].  No position-table bound — RoPE extends to any max_len."""
+    hd = cfg.dim // cfg.heads
+    dt = jnp.dtype(cfg.dtype if dtype in (None, "auto") else dtype)
+    shape = (cfg.layers, batch, cfg.kv_heads, max_len, hd)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def _rope_at(x, pos, theta):
+    """x: [b, n, d] single-position heads rotated at absolute positions
+    `pos` (int32 [b]) — the decode-time form of `_rope`."""
+    b, n, d = x.shape
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    ang = pos.astype(jnp.float32)[:, None] * freqs[None, :]     # [b, d/2]
+    cos, sin = jnp.cos(ang)[:, None, :], jnp.sin(ang)[:, None, :]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    r1 = x1 * cos - x2 * sin
+    r2 = x1 * sin + x2 * cos
+    return jnp.stack([r1, r2], axis=-1).reshape(b, n, d)
+
+
+def _cache_write_row(cache_layer, new, pos):
+    """cache_layer [b, n, T, hd], new [b, n, hd], pos int32 [b]."""
+    return jax.vmap(
+        lambda c, n_, p: jax.lax.dynamic_update_slice(
+            c, n_[:, None, :].astype(c.dtype), (0, p, 0)))(
+        cache_layer, new, pos.astype(jnp.int32))
+
+
+def llama_prefill(params, cfg: LlamaConfig, cache, tokens, lengths):
+    """Prompt pass: fill `cache` with the prompt's roped K and V and
+    return (cache, logits [batch, vocab]) at each row's last real
+    position.  Positions < length compute exactly what `llama_apply`
+    computes."""
+    dtype = jnp.dtype(cfg.dtype)
+    b, t = tokens.shape
+    hd = cfg.dim // cfg.heads
+    rep = cfg.heads // cfg.kv_heads
+    x = params["wte"][tokens].astype(dtype)
+    ks, vs = [], []
+    for blk in params["blocks"]:
+        hx = _rmsnorm(x, blk["attn_norm"]).astype(dtype)
+
+        def heads(y, n):
+            return y.reshape(b, t, n, hd).transpose(0, 2, 1, 3)
+
+        q = heads(hx @ blk["wq"].astype(dtype), cfg.heads)
+        k = heads(hx @ blk["wk"].astype(dtype), cfg.kv_heads)
+        v = heads(hx @ blk["wv"].astype(dtype), cfg.kv_heads)
+        q = _rope(q.astype(jnp.float32), cfg.rope_theta).astype(dtype)
+        k = _rope(k.astype(jnp.float32), cfg.rope_theta).astype(dtype)
+        ks.append(k)
+        vs.append(v)
+        kf, vf = k, v
+        if rep > 1:
+            kf = jnp.repeat(kf, rep, axis=1)
+            vf = jnp.repeat(vf, rep, axis=1)
+        att = jnp.einsum("bhqd,bhkd->bhqk", q, kf) / math.sqrt(hd)
+        qi = jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
+        ki = jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
+        att = jnp.where(ki <= qi, att, jnp.array(-1e9, att.dtype))
+        att = jax.nn.softmax(att, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", att, vf)
+        out = out.transpose(0, 2, 1, 3).reshape(b, t, cfg.heads * hd)
+        x = x + out @ blk["wo"].astype(dtype)
+        hx = _rmsnorm(x, blk["ffn_norm"]).astype(dtype)
+        gated = jax.nn.silu(hx @ blk["w_gate"].astype(dtype)) \
+            * (hx @ blk["w_up"].astype(dtype))
+        x = x + gated @ blk["w_down"].astype(dtype)
+    cache = {
+        "k": cache["k"].at[:, :, :, :t, :].set(
+            jnp.stack(ks).astype(cache["k"].dtype)),
+        "v": cache["v"].at[:, :, :, :t, :].set(
+            jnp.stack(vs).astype(cache["v"].dtype)),
+    }
+    x = _rmsnorm(x, params["norm_f"])
+    last = jnp.take_along_axis(
+        x, (lengths.astype(jnp.int32) - 1)[:, None, None], axis=1)[:, 0]
+    return cache, last.astype(jnp.float32) @ params["wte"].T
+
+
+def llama_decode_step(params, cfg: LlamaConfig, cache, token, pos):
+    """One cached decode step: (cache, logits [batch, vocab]) for `token`
+    (int32 [batch]) at absolute position `pos` (int32 [batch]).  Q and the
+    new K are roped at `pos`; cached keys were roped at write time, so the
+    cache is read back as-is (the relative-angle property of RoPE is paid
+    at write time, once)."""
+    from easydist_tpu.ops import decode_attention
+
+    dtype = jnp.dtype(cfg.dtype)
+    b = token.shape[0]
+    hd = cfg.dim // cfg.heads
+    rep = cfg.heads // cfg.kv_heads
+    pos = pos.astype(jnp.int32)
+    x = params["wte"][token].astype(dtype)
+    new_k, new_v = [], []
+    for li, blk in enumerate(params["blocks"]):
+        hx = _rmsnorm(x, blk["attn_norm"]).astype(dtype)
+        q = (hx @ blk["wq"].astype(dtype)).reshape(b, cfg.heads, hd)
+        k = (hx @ blk["wk"].astype(dtype)).reshape(b, cfg.kv_heads, hd)
+        v = (hx @ blk["wv"].astype(dtype)).reshape(b, cfg.kv_heads, hd)
+        q = _rope_at(q.astype(jnp.float32), pos, cfg.rope_theta).astype(dtype)
+        k = _rope_at(k.astype(jnp.float32), pos, cfg.rope_theta).astype(dtype)
+        ck = _cache_write_row(cache["k"][li], k, pos)
+        cv = _cache_write_row(cache["v"][li], v, pos)
+        new_k.append(ck)
+        new_v.append(cv)
+        kf, vf = ck.astype(dtype), cv.astype(dtype)
+        if rep > 1:
+            kf = jnp.repeat(kf, rep, axis=1)
+            vf = jnp.repeat(vf, rep, axis=1)
+        att = decode_attention(q, kf, vf, pos + 1)
+        x = x + att.reshape(b, cfg.heads * hd) @ blk["wo"].astype(dtype)
+        hx = _rmsnorm(x, blk["ffn_norm"]).astype(dtype)
+        gated = jax.nn.silu(hx @ blk["w_gate"].astype(dtype)) \
+            * (hx @ blk["w_up"].astype(dtype))
+        x = x + gated @ blk["w_down"].astype(dtype)
+    cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    x = _rmsnorm(x, params["norm_f"])
+    return cache, x.astype(jnp.float32) @ params["wte"].T
+
+
 def llama_loss(params, cfg: LlamaConfig, tokens, targets):
     logits = llama_apply(params, cfg, tokens)
     logp = jax.nn.log_softmax(logits, axis=-1)

@@ -401,3 +401,142 @@ def flash_attention(q, k, v, causal: bool = True,
     out, _ = flash_attention_lse(q, k, v, causal, scale, block_q, block_k,
                                  interpret)
     return out
+
+
+# ------------------------------------------------- single-query decode
+
+
+def _flash_decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, o_scr, m_scr,
+                         l_scr, *, scale: float, block_k: int, n_k: int):
+    """One query row against a streamed K/V cache: the forward kernel with
+    bq=1 and the causal mask replaced by a per-row length mask (cache
+    positions >= length are unwritten slots, not future tokens)."""
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        o_scr[...] = jnp.zeros_like(o_scr)
+
+    length = len_ref[0, 0]
+
+    @pl.when(ki * block_k < length)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale        # [1, d]
+        k_blk = k_ref[0].astype(jnp.float32)            # [bk, d]
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = q @ k_blk.T                                 # [1, bk]
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(k_pos < length, s, _NEG_INF)
+        m_prev = m_scr[...]
+        l_prev = l_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[...] = m_new
+        l_scr[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        o_scr[...] = o_scr[...] * alpha + p @ v_blk
+
+    @pl.when(ki == n_k - 1)
+    def _write():
+        o_ref[0] = (o_scr[...] / jnp.maximum(l_scr[...], 1e-30)).astype(
+            o_ref.dtype)
+
+
+def flash_decode_attention(q, k, v, lengths, scale: Optional[float] = None,
+                           block_k: Optional[int] = None,
+                           interpret: Optional[bool] = None):
+    """Single-query flash attention against a KV cache (the decode step).
+
+    q: [batch, heads, head_dim] — ONE query per sequence; k, v: [batch,
+    heads, max_len, head_dim] cache buffers; lengths: int32 [batch] valid
+    prefix length per row (positions >= length are masked).  Returns
+    [batch, heads, head_dim].  VMEM residency is O(block_k), independent
+    of the cache length.
+    """
+    from easydist_tpu import config as edconfig
+
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if block_k is None:
+        block_k = edconfig.decode_block_k
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, h, d = q.shape
+    t_k = k.shape[2]
+    bk = _pick_block(block_k, t_k)
+    n_k = t_k // bk
+
+    qf = q.reshape(b * h, 1, d)
+    kf = k.reshape(b * h, t_k, d)
+    vf = v.reshape(b * h, t_k, d)
+    # one scalar length per (b, h) row, SMEM-resident for the mask compare
+    lenf = jnp.broadcast_to(
+        lengths.astype(jnp.int32)[:, None], (b, h)).reshape(b * h, 1)
+
+    kernel = functools.partial(_flash_decode_kernel, scale=scale,
+                               block_k=bk, n_k=n_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, n_k),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda bh, ki: (bh, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, d), lambda bh, ki: (bh, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, 1, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((1, d), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+        ],
+        compiler_params=tpu_compiler_params(
+            pltpu, dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(lenf, qf, kf, vf)
+    return out.reshape(b, h, d)
+
+
+def _decode_attention_xla(q, k, v, lengths, scale: float):
+    """Masked dot_general decode path — the off-TPU fallback, and the
+    numerical reference the kernel is tested against.  Masking matches the
+    models' einsum path (-1e30 fill, softmax over the full cache length)
+    so cached and uncached greedy decode agree argmax-exactly."""
+    s = jnp.einsum("bhd,bhkd->bhk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+    s = jnp.where(k_pos < lengths.astype(jnp.int32)[:, None, None], s,
+                  _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhk,bhkd->bhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def decode_attention(q, k, v, lengths, scale: Optional[float] = None,
+                     backend: Optional[str] = None):
+    """Backend-dispatching decode attention (the models' decode steps call
+    this): the Pallas single-query kernel on TPU, the masked dot_general
+    path elsewhere.  `EASYDIST_DECODE_ATTENTION` forces either
+    ("flash"/"xla"); the choice is part of the strategy-cache salt."""
+    from easydist_tpu import config as edconfig
+
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    lengths = jnp.asarray(lengths, jnp.int32)
+    if lengths.ndim == 0:
+        lengths = jnp.broadcast_to(lengths, (q.shape[0],))
+    if backend is None:
+        backend = edconfig.decode_attention_backend
+    if backend == "auto":
+        backend = "flash" if jax.default_backend() == "tpu" else "xla"
+    if backend == "flash":
+        return flash_decode_attention(q, k, v, lengths, scale=scale)
+    if backend == "xla":
+        return _decode_attention_xla(q, k, v, lengths, scale)
+    raise ValueError(f"unknown decode attention backend {backend!r}; "
+                     f"expected auto|flash|xla")

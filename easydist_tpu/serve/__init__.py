@@ -21,4 +21,5 @@ from .batcher import (MicroBatcher, PackMeta, Request,  # noqa: F401
                       RequestQueue, pack_requests, scatter_results,
                       select_bucket)
 from .engine import ServeConfig, ServeEngine  # noqa: F401
+from .generation import GenerationSession, kv_cache_specs  # noqa: F401
 from .metrics import LatencyHistogram, ServeMetrics  # noqa: F401

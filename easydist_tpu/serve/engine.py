@@ -74,6 +74,13 @@ class ServeConfig:
     breaker_cooldown_ms: how long the open circuit sheds before probing.
     breaker_p99_threshold_ms / breaker_min_samples: optional brownout trip
         on observed p99 execute latency.
+    decode_buckets: allowed KV-cache max-lengths for token-level decode
+        (serve/generation.py); each bucket owns one slot pool and exactly
+        one compiled decode step.
+    kv_cache_dtype: cache storage dtype ("auto" = the model's dtype);
+        shape/dtype-visible in every decode signature.
+    max_decode_slots: slots per decode bucket — the fixed decode batch
+        width (idle slots show up as occupancy, never as a new signature).
     """
     batch_buckets: Tuple[int, ...] = (1, 2, 4, 8)
     seq_buckets: Optional[Tuple[int, ...]] = None
@@ -90,6 +97,9 @@ class ServeConfig:
     breaker_cooldown_ms: float = 1000.0
     breaker_p99_threshold_ms: Optional[float] = None
     breaker_min_samples: int = 20
+    decode_buckets: Tuple[int, ...] = (1024,)
+    kv_cache_dtype: str = "auto"
+    max_decode_slots: int = 8
 
     def __post_init__(self):
         if not self.batch_buckets:
@@ -112,6 +122,19 @@ class ServeConfig:
         if self.breaker_cooldown_ms <= 0:
             raise ValueError(f"breaker_cooldown_ms must be > 0, "
                              f"got {self.breaker_cooldown_ms}")
+        if not self.decode_buckets or any(b < 1 for b in self.decode_buckets):
+            raise ValueError(f"decode_buckets must be non-empty with every "
+                             f"bucket >= 1: {self.decode_buckets}")
+        if self.kv_cache_dtype != "auto":
+            try:
+                np.dtype(self.kv_cache_dtype)
+            except TypeError:
+                raise ValueError(
+                    f"kv_cache_dtype must be 'auto' or a numpy-parseable "
+                    f"dtype name, got {self.kv_cache_dtype!r}") from None
+        if self.max_decode_slots < 1:
+            raise ValueError(f"max_decode_slots must be >= 1, "
+                             f"got {self.max_decode_slots}")
 
 
 class ServeEngine:

@@ -1,0 +1,369 @@
+"""Token-level decode serving: `GenerationSession`.
+
+`ServeEngine` serves request-shaped functions — every call re-runs the
+whole forward.  For autoregressive generation that is O(T^2) attention
+flops per sequence; the KV cache makes each token O(T).  This module is
+the serving half of the cache-carrying model API
+(models/gpt.py::gpt_prefill/gpt_decode_step and the llama mirror):
+
+  * **prefill/decode split** — each admitted prompt runs one prefill
+    through a closed set of padded prompt lengths (powers of two, capped
+    at the decode bucket) into a single-row staging cache, then migrates
+    into a slot of the bucket's pooled cache with one
+    `dynamic_update_slice`;
+  * **bucketed KV pool** — one slot pool per `ServeConfig.decode_buckets`
+    entry, shaped [layers, max_decode_slots, heads, bucket, head_dim].
+    Slots are recycled through a free list as requests retire (EOS /
+    max-new-tokens / bucket exhausted), so admission is continuous;
+  * **one compiled decode step** — decode always steps ALL slots of a
+    pool (idle rows are throwaway work the occupancy gauge accounts
+    for), so token/pos arrays have a fixed shape and the jaxfront
+    signature cache holds exactly one decode executable per bucket, for
+    every token of every request;
+  * **donated cache** — the pool is positional arg 0 of the compiled
+    step and the first output, so `infer_state_io` pairs and donates it:
+    XLA updates the cache in place instead of copying
+    layers*slots*bucket*dim bytes per token.  `analyze.SERVE001` audits
+    exactly this property after the first decode compile.
+
+Sharding rides the existing solver: the cache's heads axis (dim 2) is the
+tensor-parallel shard dim, matching the attention strategy the solver
+picks for the model itself, so tp serving works unchanged —
+`kv_cache_specs` names the placement for callers that want to lay the
+pool out explicitly.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .admission import RequestTooLargeError
+from .batcher import select_bucket
+from .engine import ServeConfig
+from .metrics import ServeMetrics
+
+logger = logging.getLogger(__name__)
+
+
+def kv_cache_specs(axis: str = "tp"):
+    """PartitionSpec pytree for a KV cache {"k", "v"} of shape
+    [layers, batch/slots, heads, max_len, head_dim]: heads sharded on
+    `axis`, everything else replicated — the placement consistent with a
+    tensor-parallel attention strategy."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, None, axis, None, None)
+    return {"k": spec, "v": spec}
+
+
+@dataclass
+class _Slot:
+    """Host-side view of one pooled decode row."""
+    request_id: int
+    future: Future
+    pos: int                      # next cache write position
+    token: int                    # last generated token (not yet in cache)
+    max_new: int
+    eos_id: Optional[int]
+    generated: List[int] = field(default_factory=list)
+
+
+class _BucketPool:
+    """One decode bucket: pooled cache + free-list slot allocator +
+    single-row staging cache reused across prefills."""
+
+    def __init__(self, bucket: int, n_slots: int, init_cache):
+        self.bucket = bucket
+        self.n_slots = n_slots
+        self.cache = init_cache(n_slots, bucket)
+        self.staging = init_cache(1, bucket)
+        self.free: List[int] = list(range(n_slots))
+        self.slots: Dict[int, _Slot] = {}          # slot index -> _Slot
+
+    @property
+    def n_active(self) -> int:
+        return len(self.slots)
+
+
+class GenerationSession:
+    """Continuous-batching token generation over a cache-carrying model.
+
+    model_prefill(params, cache, tokens, lengths) -> (cache, logits)
+    model_decode(params, cache, token, pos) -> (cache, logits)
+    init_cache(batch, max_len, dtype=None) -> cache pytree
+
+    Greedy decoding (argmax inside the compiled step, so only int32 token
+    ids cross the host boundary per token).  `submit` returns a Future
+    resolving to {"ids": [...generated ids...], "finish_reason":
+    "eos"|"length"|"bucket_full"}; drive with `step()` (one admit +
+    decode + harvest round) or `run_until_drained()`.
+    """
+
+    def __init__(self, params, *, model_prefill: Callable,
+                 model_decode: Callable, init_cache: Callable,
+                 config: Optional[ServeConfig] = None, mesh=None,
+                 eos_id: Optional[int] = None,
+                 max_prompt_len: Optional[int] = None,
+                 metrics: Optional[ServeMetrics] = None):
+        from easydist_tpu.jaxfront import easydist_compile
+
+        self.config = config or ServeConfig()
+        if max_prompt_len is not None:
+            bad = [b for b in self.config.decode_buckets
+                   if b > max_prompt_len]
+            if bad:
+                raise ValueError(
+                    f"decode_buckets {bad} exceed the model's maximum "
+                    f"sequence length {max_prompt_len}; set "
+                    f"ServeConfig(decode_buckets=...) within it")
+        self.params = params
+        self.mesh = mesh
+        self.eos_id = eos_id
+        self.metrics = metrics or ServeMetrics()
+        self._init_cache = init_cache
+        self._pending: collections.deque = collections.deque()
+        self._pools: Dict[int, _BucketPool] = {}
+        self._next_request_id = 0
+        self._audited: set = set()
+
+        def _prefill(cache, params, tokens, lengths):
+            import jax.numpy as jnp
+
+            cache, logits = model_prefill(params, cache, tokens, lengths)
+            return cache, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        def _migrate(pool, cache, slot):
+            import jax
+
+            return {
+                k: jax.lax.dynamic_update_slice(
+                    pool[k], cache[k].astype(pool[k].dtype),
+                    (0, slot, 0, 0, 0))
+                for k in ("k", "v")
+            }
+
+        def _decode(pool, params, token, pos):
+            import jax.numpy as jnp
+
+            pool, logits = model_decode(params, pool, token, pos)
+            return pool, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        # pool/cache is arg 0 and output 0 of every compiled callable, so
+        # state_io="auto" pairs it and XLA gets the buffer donated
+        self._prefill_c = easydist_compile(_prefill, mesh=mesh)
+        self._migrate_c = easydist_compile(_migrate, mesh=mesh)
+        self._decode_c = easydist_compile(_decode, mesh=mesh)
+
+    # ------------------------------------------------------------ admission
+    def submit(self, prompt_ids: Sequence[int],
+               max_new_tokens: int = 16,
+               eos_id: Optional[int] = None) -> Future:
+        """Queue one prompt; generation interleaves with every other live
+        request (continuous batching) as `step()` is driven."""
+        prompt = [int(t) for t in prompt_ids]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, "
+                             f"got {max_new_tokens}")
+        if select_bucket(len(prompt) + 1, self.config.decode_buckets) is None:
+            raise RequestTooLargeError(
+                f"prompt of {len(prompt)} tokens does not fit any decode "
+                f"bucket {self.config.decode_buckets} with room to "
+                f"generate")
+        fut = Future()
+        self._pending.append(
+            (prompt, max_new_tokens,
+             self.eos_id if eos_id is None else eos_id, fut))
+        self.metrics.inc("requests_submitted")
+        return fut
+
+    # ------------------------------------------------------------- plumbing
+    def _pool_for(self, bucket: int) -> _BucketPool:
+        pool = self._pools.get(bucket)
+        if pool is None:
+            pool = _BucketPool(bucket, self.config.max_decode_slots,
+                               self._cache_factory)
+            self._pools[bucket] = pool
+        return pool
+
+    def _cache_factory(self, batch: int, max_len: int):
+        dtype = self.config.kv_cache_dtype
+        return self._init_cache(batch, max_len,
+                                None if dtype == "auto" else dtype)
+
+    def _prefill_pad(self, plen: int, bucket: int) -> int:
+        """Smallest power of two >= plen (floor 8), capped at the decode
+        bucket — the closed set of prefill signatures per bucket."""
+        t = 8
+        while t < plen:
+            t *= 2
+        return min(t, bucket)
+
+    def _admit_one(self) -> bool:
+        """Pop one pending request into a free slot: prefill + migrate.
+        Returns False when nothing is admissible."""
+        import jax.numpy as jnp
+
+        if not self._pending:
+            return False
+        prompt, max_new, eos, fut = self._pending[0]
+        bucket = select_bucket(len(prompt) + 1, self.config.decode_buckets)
+        pool = self._pool_for(bucket)
+        if not pool.free:
+            return False
+        self._pending.popleft()
+        if fut.set_running_or_notify_cancel() is False:
+            return True  # cancelled while queued; slot stays free
+        slot_idx = pool.free.pop()
+
+        t_pad = self._prefill_pad(len(prompt), bucket)
+        tokens = np.full((1, t_pad), int(self.config.pad_value), np.int32)
+        tokens[0, :len(prompt)] = prompt
+        lengths = np.array([len(prompt)], np.int32)
+        pool.staging, first = self._prefill_c(
+            pool.staging, self.params, jnp.asarray(tokens),
+            jnp.asarray(lengths))
+        pool.cache = self._migrate_c(pool.cache, pool.staging,
+                                     jnp.asarray(slot_idx, jnp.int32))
+        self.metrics.inc("prefills")
+
+        slot = _Slot(request_id=self._next_request_id, future=fut,
+                     pos=len(prompt), token=int(np.asarray(first)[0]),
+                     max_new=max_new, eos_id=eos)
+        self._next_request_id += 1
+        slot.generated.append(slot.token)
+        pool.slots[slot_idx] = slot
+        self._maybe_retire(pool, slot_idx)
+        return True
+
+    def _retire(self, pool: _BucketPool, slot_idx: int, reason: str) -> None:
+        slot = pool.slots.pop(slot_idx)
+        pool.free.append(slot_idx)
+        slot.future.set_result({"ids": list(slot.generated),
+                                "finish_reason": reason})
+        self.metrics.inc("requests_completed")
+
+    def _maybe_retire(self, pool: _BucketPool, slot_idx: int) -> bool:
+        slot = pool.slots[slot_idx]
+        if slot.eos_id is not None and slot.token == slot.eos_id:
+            self._retire(pool, slot_idx, "eos")
+        elif len(slot.generated) >= slot.max_new:
+            self._retire(pool, slot_idx, "length")
+        elif slot.pos >= pool.bucket:
+            self._retire(pool, slot_idx, "bucket_full")
+        else:
+            return False
+        return True
+
+    def _decode_round(self, pool: _BucketPool) -> None:
+        """One compiled decode step over ALL slots of `pool` (fixed
+        shapes: the signature cache stays at one entry per bucket)."""
+        import jax
+        import jax.numpy as jnp
+
+        token = np.zeros((pool.n_slots,), np.int32)
+        pos = np.zeros((pool.n_slots,), np.int32)
+        for idx, slot in pool.slots.items():
+            token[idx] = slot.token
+            pos[idx] = slot.pos
+        args = (pool.cache, self.params, jnp.asarray(token),
+                jnp.asarray(pos))
+        result = self._decode_c.get_compiled(*args)
+        if pool.bucket not in self._audited:
+            self._audited.add(pool.bucket)
+            self._audit_donation(result, pool.bucket)
+        t0 = time.perf_counter()
+        pool.cache, nxt = result.tree_jitted(*args)
+        nxt = np.asarray(jax.block_until_ready(nxt))
+        dt = time.perf_counter() - t0
+        n_active = pool.n_active
+        for idx in list(pool.slots):
+            slot = pool.slots[idx]
+            slot.token = int(nxt[idx])
+            slot.pos += 1
+            slot.generated.append(slot.token)
+            self._maybe_retire(pool, idx)
+        self.metrics.record_decode_step(n_active, pool.n_slots, dt)
+
+    def _audit_donation(self, result, bucket: int) -> None:
+        try:
+            from easydist_tpu.analyze import check_decode_donation
+
+            check_decode_donation(result, node=f"decode[bucket={bucket}]")
+        except ImportError:  # analyze is an optional layer at runtime
+            pass
+
+    # ------------------------------------------------------------- driving
+    def step(self) -> int:
+        """One serving round: admit pending prompts into free slots, run
+        one decode step per bucket with live slots, harvest retirements.
+        Returns the number of tokens generated this round."""
+        while self._admit_one():
+            pass
+        before = self.metrics.counter("tokens_generated")
+        for pool in self._pools.values():
+            if pool.slots:
+                self._decode_round(pool)
+        return self.metrics.counter("tokens_generated") - before
+
+    def run_until_drained(self, max_steps: int = 100000) -> None:
+        """Drive `step()` until no request is live or queued."""
+        for _ in range(max_steps):
+            if not self._pending and not any(
+                    p.slots for p in self._pools.values()):
+                return
+            self.step()
+        raise RuntimeError(f"not drained after {max_steps} steps")
+
+    # ----------------------------------------------------------- reporting
+    def stats(self) -> Dict[str, object]:
+        return {
+            "pending": len(self._pending),
+            "buckets": {
+                b: {"active": p.n_active, "free": len(p.free)}
+                for b, p in self._pools.items()},
+            "decode_signatures": self._decode_c.cache_stats(),
+            "prefill_signatures": self._prefill_c.cache_stats(),
+            "migrate_signatures": self._migrate_c.cache_stats(),
+            "metrics": self.metrics.snapshot(),
+        }
+
+    # --------------------------------------------------------- constructors
+    @classmethod
+    def for_gpt(cls, params, cfg, **kw):
+        """Session over models/gpt.py; decode_buckets must fit cfg.seq
+        (the learned-position-table bound)."""
+        from easydist_tpu.models import gpt
+
+        return cls(
+            params,
+            model_prefill=lambda p, c, t, l: gpt.gpt_prefill(p, cfg, c, t, l),
+            model_decode=lambda p, c, t, pos: gpt.gpt_decode_step(
+                p, cfg, c, t, pos),
+            init_cache=lambda b, L, dt=None: gpt.init_kv_cache(
+                cfg, b, L, dtype=dt),
+            max_prompt_len=cfg.seq, **kw)
+
+    @classmethod
+    def for_llama(cls, params, cfg, **kw):
+        """Session over models/llama.py (RoPE: buckets are not bound by
+        cfg.seq)."""
+        from easydist_tpu.models import llama
+
+        return cls(
+            params,
+            model_prefill=lambda p, c, t, l: llama.llama_prefill(
+                p, cfg, c, t, l),
+            model_decode=lambda p, c, t, pos: llama.llama_decode_step(
+                p, cfg, c, t, pos),
+            init_cache=lambda b, L, dt=None: llama.init_kv_cache(
+                cfg, b, L, dtype=dt),
+            **kw)

@@ -70,9 +70,13 @@ class ServeMetrics:
       requests_submitted / completed / failed / timed_out / rejected /
       shed (circuit open), batches_executed, batch_rows_real,
       batch_rows_padded, compile_cache_hits, compile_cache_misses,
-      oom_degradations, transient_retries, exec_timeouts (watchdog).
+      oom_degradations, transient_retries, exec_timeouts (watchdog),
+      tokens_generated (decode steps x active slots).
+    Gauges: decode_slot_occupancy (active slots / total slots at the last
+      decode step).
     Histograms: queue_wait (submit->drain), execute (device time incl.
-    host roundtrip), e2e (submit->future resolution)."""
+    host roundtrip), e2e (submit->future resolution), per_token (one
+    decode-step wall time, all slots)."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -81,6 +85,7 @@ class ServeMetrics:
         self.queue_wait = LatencyHistogram()
         self.execute = LatencyHistogram()
         self.e2e = LatencyHistogram()
+        self.per_token = LatencyHistogram()
 
     # ------------------------------------------------------------- recording
     def inc(self, name: str, n: int = 1) -> None:
@@ -105,6 +110,19 @@ class ServeMetrics:
             self._counters["batch_rows_padded"] = \
                 self._counters.get("batch_rows_padded", 0) + bucket
             self.execute.observe(execute_s)
+
+    def record_decode_step(self, n_active: int, n_slots: int,
+                           step_s: float) -> None:
+        """One token step across the whole slot pool: `n_active` slots
+        produced a real token, `n_slots` rows executed either way."""
+        with self._lock:
+            self._counters["tokens_generated"] = \
+                self._counters.get("tokens_generated", 0) + n_active
+            self._counters["decode_steps"] = \
+                self._counters.get("decode_steps", 0) + 1
+            self._gauges["decode_slot_occupancy"] = \
+                (n_active / n_slots) if n_slots else 0.0
+            self.per_token.observe(step_s)
 
     def counter(self, name: str) -> int:
         with self._lock:
@@ -131,7 +149,8 @@ class ServeMetrics:
             gauges = dict(self._gauges)
             hists = {"queue_wait": self.queue_wait.snapshot(),
                      "execute": self.execute.snapshot(),
-                     "e2e": self.e2e.snapshot()}
+                     "e2e": self.e2e.snapshot(),
+                     "per_token": self.per_token.snapshot()}
         return {"counters": counters, "gauges": gauges,
                 "latency": hists,
                 "batch_occupancy": self.batch_occupancy(),

@@ -126,5 +126,39 @@ else
     echo "static_checks: jax not importable; skipping bench.py --resilience"
 fi
 
+# decode-serving gate: KV-cached generation must beat the naive full
+# re-forward greedy loop >= 5x in tokens/s at seq 512 (the O(T) vs O(T^2)
+# economics), with bitwise greedy parity and a decode signature cache that
+# stays at one compiled step per bucket across every generated token
+if python -c "import jax" >/dev/null 2>&1; then
+    echo "== bench.py --decode (KV-cache decode speedup + parity gate)"
+    out=$(python bench.py --decode 2>/dev/null) || rc=1
+    echo "$out"
+    verdict=$(python - "$out" <<'EOF'
+import json, sys
+try:
+    r = json.loads(sys.argv[1].strip().splitlines()[-1])
+    if "error" in r:
+        print("error: " + r["error"])
+    elif not r.get("parity_greedy"):
+        print("cached greedy ids diverge from full re-forward")
+    elif not r.get("signature_cache_constant"):
+        print("decode signature cache grew across tokens")
+    elif not r.get("value", 0) >= 5.0:
+        print(f"speedup {r.get('value')} < 5.0x")
+    else:
+        print("ok")
+except Exception as e:
+    print(f"unparseable: {e}")
+EOF
+)
+    if [ "$verdict" != "ok" ]; then
+        echo "static_checks: decode gate failed ($verdict)"
+        rc=1
+    fi
+else
+    echo "static_checks: jax not importable; skipping bench.py --decode"
+fi
+
 [ "$ran" = 0 ] && echo "static_checks: no external linters ran (configs still validated by CI tests)"
 exit $rc

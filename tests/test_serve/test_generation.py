@@ -1,0 +1,234 @@
+"""GenerationSession: continuous-batching KV-cached decode over the
+jaxfront signature cache — greedy parity, slot recycling, signature
+constancy, donation audit (SERVE001), config validation, metrics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from easydist_tpu.analyze import audit_decode_donation, check_decode_donation
+from easydist_tpu.jaxfront import easydist_compile
+from easydist_tpu.jaxfront.mesh import make_device_mesh
+from easydist_tpu.models import gpt
+from easydist_tpu.serve import (GenerationSession, RequestTooLargeError,
+                                ServeConfig, kv_cache_specs)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = gpt.GPTConfig.tiny()
+    params = gpt.gpt_init(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _uncached_greedy(params, cfg, prompt, n_new):
+    cur = list(prompt)
+    out = []
+    for _ in range(n_new):
+        logits = gpt.gpt_apply(params, cfg, jnp.asarray([cur]))
+        nxt = int(jnp.argmax(logits[0, len(cur) - 1]))
+        out.append(nxt)
+        cur.append(nxt)
+    return out
+
+
+def _session(cfg, params, **kw):
+    sc = kw.pop("config", None) or ServeConfig(decode_buckets=(cfg.seq,),
+                                               max_decode_slots=2)
+    return GenerationSession.for_gpt(params, cfg, config=sc, **kw)
+
+
+class TestGreedyParity:
+    def test_single_request(self, model):
+        cfg, params = model
+        sess = _session(cfg, params)
+        prompt = [3, 14, 15, 9, 2]
+        fut = sess.submit(prompt, max_new_tokens=6)
+        sess.run_until_drained()
+        out = fut.result(timeout=5)
+        assert out["ids"] == _uncached_greedy(params, cfg, prompt, 6)
+        assert out["finish_reason"] == "length"
+
+    def test_more_requests_than_slots_recycles(self, model):
+        """6 requests through 2 slots: retirements must free slots so
+        later requests are admitted mid-flight, and every request's ids
+        still match its own uncached loop."""
+        cfg, params = model
+        sess = _session(cfg, params)
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, cfg.vocab, size=3 + i % 4).tolist()
+                   for i in range(6)]
+        futs = [sess.submit(p, max_new_tokens=4) for p in prompts]
+        sess.run_until_drained()
+        for p, f in zip(prompts, futs):
+            assert f.result(timeout=5)["ids"] == \
+                _uncached_greedy(params, cfg, p, 4)
+        st = sess.stats()
+        assert st["pending"] == 0
+        assert st["buckets"][cfg.seq]["active"] == 0
+        assert st["buckets"][cfg.seq]["free"] == 2
+
+    def test_eos_retires_early(self, model):
+        cfg, params = model
+        prompt = [3, 14, 15, 9, 2]
+        ref = _uncached_greedy(params, cfg, prompt, 8)
+        eos = ref[2]  # a token the greedy run is known to produce
+        sess = _session(cfg, params, eos_id=eos)
+        fut = sess.submit(prompt, max_new_tokens=8)
+        sess.run_until_drained()
+        out = fut.result(timeout=5)
+        assert out["finish_reason"] == "eos"
+        # generation stops at the FIRST occurrence of eos, inclusive
+        assert out["ids"] == ref[:ref.index(eos) + 1]
+
+    def test_tp2_sharded_cache_parity(self, model):
+        cfg, params = model
+        ref_sess = _session(cfg, params)
+        prompt = [7, 1, 4, 4]
+        rf = ref_sess.submit(prompt, max_new_tokens=5)
+        ref_sess.run_until_drained()
+        mesh = make_device_mesh((2,), ("tp",), devices=jax.devices()[:2])
+        sess = _session(cfg, params, mesh=mesh)
+        fut = sess.submit(prompt, max_new_tokens=5)
+        sess.run_until_drained()
+        assert fut.result(timeout=5)["ids"] == \
+            rf.result(timeout=5)["ids"]
+
+
+class TestSignatureCache:
+    def test_one_compiled_decode_step_across_tokens(self, model):
+        cfg, params = model
+        sess = _session(cfg, params)
+        f1 = sess.submit([1, 2, 3], max_new_tokens=5)
+        sess.run_until_drained()
+        sigs_after_first = sess.stats()["decode_signatures"]["size"]
+        f2 = sess.submit([9, 8, 7, 6, 5], max_new_tokens=7)
+        sess.run_until_drained()
+        st = sess.stats()["decode_signatures"]
+        assert sigs_after_first == st["size"] == 1
+        assert st["hits"] > st["misses"]
+        f1.result(timeout=5), f2.result(timeout=5)
+
+    def test_prefill_signatures_closed_by_padding(self, model):
+        """Prompt lengths 2..8 collapse into the pow2 prefill pads."""
+        cfg, params = model
+        sess = _session(cfg, params)
+        for n in (2, 3, 5, 7, 8):
+            sess.submit(list(range(1, n + 1)), max_new_tokens=2)
+        sess.run_until_drained()
+        # pads: 8 (for <=8) only -> exactly one prefill signature
+        assert sess.stats()["prefill_signatures"]["size"] == 1
+
+
+class TestDonationAudit:
+    def test_default_build_is_clean(self, model):
+        cfg, params = model
+        sess = _session(cfg, params)
+        fut = sess.submit([5, 6], max_new_tokens=3)
+        sess.run_until_drained()
+        fut.result(timeout=5)
+        pool = sess._pools[cfg.seq]
+        res = sess._decode_c.get_compiled(
+            pool.cache, params, jnp.zeros((2,), jnp.int32),
+            jnp.zeros((2,), jnp.int32))
+        assert audit_decode_donation(res) == []
+
+    def test_fires_exactly_once_without_donation(self, model):
+        cfg, params = model
+
+        def _decode(pool, prm, token, pos):
+            pool, logits = gpt.gpt_decode_step(prm, cfg, pool, token, pos)
+            return pool, jnp.argmax(logits, -1).astype(jnp.int32)
+
+        c = easydist_compile(_decode, donate_state=False)
+        res = c.get_compiled(gpt.init_kv_cache(cfg, 2, cfg.seq), params,
+                             jnp.zeros((2,), jnp.int32),
+                             jnp.zeros((2,), jnp.int32))
+        findings = audit_decode_donation(res)
+        assert len(findings) == 1
+        assert findings[0].rule_id == "SERVE001"
+        assert findings[0].severity == "warning"
+        # the hook logs but never raises (slow, not wrong)
+        assert len(check_decode_donation(res)) == 1
+
+    def test_kv_cache_specs_shards_heads(self):
+        specs = kv_cache_specs("tp")
+        assert specs["k"][2] == "tp" and specs["v"][2] == "tp"
+        assert specs["k"][0] is None and specs["k"][3] is None
+
+
+class TestAdmissionAndConfig:
+    def test_prompt_too_large_rejected(self, model):
+        cfg, params = model
+        sess = _session(cfg, params)
+        with pytest.raises(RequestTooLargeError):
+            sess.submit(list(range(cfg.seq)), max_new_tokens=1)
+
+    def test_empty_prompt_and_bad_max_new(self, model):
+        cfg, params = model
+        sess = _session(cfg, params)
+        with pytest.raises(ValueError):
+            sess.submit([], max_new_tokens=1)
+        with pytest.raises(ValueError):
+            sess.submit([1], max_new_tokens=0)
+
+    def test_bucket_beyond_model_seq_rejected(self, model):
+        cfg, params = model
+        with pytest.raises(ValueError, match="decode_buckets"):
+            GenerationSession.for_gpt(
+                params, cfg,
+                config=ServeConfig(decode_buckets=(cfg.seq * 2,)))
+
+    @pytest.mark.parametrize("kw", [
+        dict(decode_buckets=()),
+        dict(decode_buckets=(0,)),
+        dict(kv_cache_dtype="not-a-dtype"),
+        dict(max_decode_slots=0),
+    ])
+    def test_serveconfig_validation(self, kw):
+        with pytest.raises(ValueError):
+            ServeConfig(**kw)
+
+    def test_serveconfig_accepts_new_knobs(self):
+        sc = ServeConfig(decode_buckets=(128, 512),
+                         kv_cache_dtype="bfloat16", max_decode_slots=4)
+        assert sc.decode_buckets == (128, 512)
+
+    def test_kv_cache_dtype_applied(self, model):
+        cfg, params = model
+        sc = ServeConfig(decode_buckets=(cfg.seq,), max_decode_slots=2,
+                         kv_cache_dtype="bfloat16")
+        sess = GenerationSession.for_gpt(params, cfg, config=sc)
+        fut = sess.submit([1, 2, 3], max_new_tokens=2)
+        sess.run_until_drained()
+        fut.result(timeout=5)
+        assert sess._pools[cfg.seq].cache["k"].dtype == jnp.bfloat16
+
+
+class TestMetrics:
+    def test_decode_metrics_recorded(self, model):
+        cfg, params = model
+        sess = _session(cfg, params)
+        futs = [sess.submit([1, 2, 3], max_new_tokens=4),
+                sess.submit([4, 5], max_new_tokens=4)]
+        sess.run_until_drained()
+        [f.result(timeout=5) for f in futs]
+        snap = sess.metrics.snapshot()
+        # 8 tokens total; 2 came from the prefills' argmax
+        assert snap["counters"]["tokens_generated"] == 6
+        assert snap["counters"]["requests_submitted"] == 2
+        assert snap["counters"]["requests_completed"] == 2
+        assert snap["counters"]["prefills"] == 2
+        assert 0.0 < snap["gauges"]["decode_slot_occupancy"] <= 1.0
+        assert snap["latency"]["per_token"]["count"] > 0
+
+    def test_metrics_export_to_perfdb(self, model):
+        cfg, params = model
+        sess = _session(cfg, params)
+        fut = sess.submit([1, 2], max_new_tokens=2)
+        sess.run_until_drained()
+        fut.result(timeout=5)
+        db = sess.metrics.export(sub_key="generation_test", persist=False)
+        hist = db.get_op_perf("serving", "generation_test")
+        assert hist and "per_token" in hist[-1]["latency"]
