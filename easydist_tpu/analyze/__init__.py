@@ -22,9 +22,14 @@ A rule-based analyzer that runs after solving and before execution
            `audit_checkpoint_root`) — guard-off jaxpr parity (RES001) and
            checkpoint commit-protocol integrity over a checkpoint root
            (RES002 corrupt COMMITTED, RES003 stale debris);
-  layer 5  serving auditor (`audit_decode_donation`) — the SERVE001
+  layer 5  serving auditor (`audit_decode_donation`,
+           `audit_chunked_prefill`, `audit_prefix_cache`) — the SERVE001
            decode-step KV-cache donation lint (a non-donated cache turns
-           every generated token into a full-cache HBM copy).
+           every generated token into a full-cache HBM copy) and the
+           SERVE002 chunked-prefill contract lint (staging donation,
+           length-masked attention over the full bucket window so stale
+           cache rows cannot leak into live logits, prefix-trie
+           refcount/byte-accounting integrity).
 
 Surfaced via `CompiledFunction.analyze()`, `bench.py --analyze`, and the
 dryrun gate; findings export through the runtime PerfDB under
@@ -49,7 +54,8 @@ from .resilience_rules import (audit_checkpoint_root, audit_guard_parity,
                                guard_off_jaxpr)
 from .schedule_rules import (gpipe_schedule_tables, schedule_stats,
                              verify_schedule_tables)
-from .serve_rules import audit_decode_donation
+from .serve_rules import (audit_chunked_prefill, audit_decode_donation,
+                          audit_prefix_cache)
 from .strategy_rules import audit_solver_objective, verify_axis
 
 logger = logging.getLogger(__name__)
@@ -66,6 +72,8 @@ __all__ = [
     "check_overlap_plan",
     "audit_guard_parity", "audit_checkpoint_root", "guard_off_jaxpr",
     "audit_decode_donation", "check_decode_donation",
+    "audit_chunked_prefill", "audit_prefix_cache",
+    "check_chunked_prefill", "check_prefix_cache",
 ]
 
 
@@ -131,6 +139,41 @@ def check_decode_donation(result, cache_arg: int = 0,
     Returns the findings so callers/tests can assert on them."""
     findings = audit_decode_donation(result, cache_arg=cache_arg,
                                      node=node)
+    for f in findings:
+        logger.warning("[analyze] %s", f)
+    return findings
+
+
+def check_chunked_prefill(result, cache_arg: int = 0,
+                          node: str = "prefill_chunk"):
+    """Compile-time self-check hook for the chunked-prefill scheduler:
+    audit staging donation (warning — slow) and the length-mask (error —
+    stale-row leakage).  Error findings raise under `analyze_raise`
+    (missing mask means WRONG tokens, not slow ones); warnings log.
+    Returns the findings so callers/tests can assert on them."""
+    from easydist_tpu import config as edconfig
+
+    findings = audit_chunked_prefill(result, cache_arg=cache_arg,
+                                     node=node)
+    report = AnalysisReport(findings)
+    if report.errors() and edconfig.analyze_raise:
+        report.raise_on_errors()
+    for f in findings:
+        logger.warning("[analyze] %s", f)
+    return findings
+
+
+def check_prefix_cache(trie, node: str = "prefix_cache"):
+    """Runtime self-check hook for the prefix trie: refcount/byte
+    accounting invariants (SERVE002).  Drift raises under
+    `analyze_raise` — eviction over corrupt bookkeeping could free a
+    pinned chunk under a live slot.  Returns the findings."""
+    from easydist_tpu import config as edconfig
+
+    findings = audit_prefix_cache(trie, node=node)
+    report = AnalysisReport(findings)
+    if report.errors() and edconfig.analyze_raise:
+        report.raise_on_errors()
     for f in findings:
         logger.warning("[analyze] %s", f)
     return findings

@@ -118,6 +118,12 @@ RULES: Dict[str, tuple] = {
                  "decode-step KV cache input not donated (every token "
                  "pays a full-cache HBM copy instead of an in-place "
                  "XLA update)"),
+    "SERVE002": (SEV_ERROR,
+                 "chunked-prefill contract broken: staging cache not "
+                 "donated (warning), attention window not length-masked "
+                 "(stale-row leakage — restored/garbage cache rows could "
+                 "leak into live logits), or prefix-trie refcount/byte "
+                 "accounting drift"),
 }
 
 

@@ -1,21 +1,32 @@
 """Layer 5: serving auditor.
 
-One rule so far: SERVE001 — the decode-step cache-donation lint.  The
-whole economics of token-level serving (serve/generation.py) rests on the
-KV cache pool being updated *in place* by XLA: a decode step's cost is
-one row write plus attention reads.  If the cache input is not donated,
-every token instead pays a full copy of layers x slots x bucket x dim
-bytes on the cache update — correct, silent, and catastrophically slow.
-This audit checks the compiled decode step's donation vector covers every
-leaf of the cache argument, so the regression is caught at compile time
-rather than in a latency dashboard.
+SERVE001 — the decode-step cache-donation lint.  The whole economics of
+token-level serving (serve/generation.py) rests on the KV cache pool
+being updated *in place* by XLA: a decode step's cost is one row write
+plus attention reads.  If the cache input is not donated, every token
+instead pays a full copy of layers x slots x bucket x dim bytes on the
+cache update — correct, silent, and catastrophically slow.  This audit
+checks the compiled decode step's donation vector covers every leaf of
+the cache argument, so the regression is caught at compile time rather
+than in a latency dashboard.
+
+SERVE002 — the chunked-prefill contract lint.  The prefix-reuse scheduler
+(serve/generation.py + serve/prefix_cache.py) leans on three properties:
+(a) the multi-row staging cache is donated to every chunk call (same
+economics as SERVE001, but per chunk); (b) the chunk program's attention
+over the full bucket window is LENGTH-MASKED — a `select` whose predicate
+compares against an `iota` over key positions — because staging rows
+carry restored prefixes, stale tails from recycled rows, and idle-row
+garbage, and only the mask keeps them out of live logits (a missing mask
+is *wrong*, not slow, hence error severity); (c) the prefix trie's
+refcount/byte accounting stays consistent (`audit_prefix_cache`).
 """
 
 from __future__ import annotations
 
 from typing import List
 
-from .findings import Finding, make_finding
+from .findings import SEV_WARNING, Finding, make_finding
 
 
 def _arg_leaf_ranges(in_tree) -> List[tuple]:
@@ -37,21 +48,123 @@ def audit_decode_donation(result, cache_arg: int = 0,
     in `result.donated_invars`.  Non-donated leaves aggregate into ONE
     finding (one decode step, one verdict); returns [] when the cache is
     fully donated."""
+    return _donation_findings(
+        result, cache_arg, node, "SERVE001",
+        "the decode step will copy the full KV cache every token "
+        "(donate_state/enable_donation off, or the cache is not "
+        "threaded as a paired state output)")
+
+
+def _donation_findings(result, cache_arg: int, node: str,
+                       rule_id: str, what: str,
+                       severity=None) -> List[Finding]:
+    """Shared donation walk for SERVE001/SERVE002: every flat leaf of
+    positional arg `cache_arg` must be in `result.donated_invars`."""
     ranges = _arg_leaf_ranges(result.in_tree)
     if cache_arg >= len(ranges):
         return [make_finding(
-            "SERVE001", node,
+            rule_id, node,
             f"cache arg index {cache_arg} out of range: the compiled "
-            f"step has {len(ranges)} positional args")]
+            f"step has {len(ranges)} positional args", severity=severity)]
     start, stop = ranges[cache_arg]
     donated = set(getattr(result, "donated_invars", ()) or ())
     missing = [i for i in range(start, stop) if i not in donated]
     if not missing:
         return []
     return [make_finding(
-        "SERVE001", node,
+        rule_id, node,
         f"{len(missing)}/{stop - start} cache leaves (flat input indices "
         f"{missing[:8]}{'...' if len(missing) > 8 else ''}) are not "
-        f"donated; the decode step will copy the full KV cache every "
-        f"token (donate_state/enable_donation off, or the cache is not "
-        f"threaded as a paired state output)")]
+        f"donated; {what}", severity=severity)]
+
+
+_COMPARE_PRIMS = {"le", "lt", "ge", "gt", "eq", "ne"}
+_SELECT_PRIMS = {"select_n", "select"}
+
+
+def _has_masked_select(jaxpr, max_depth: int = 24) -> bool:
+    """True iff some select's predicate derives (within `max_depth`
+    producer hops) from a comparison with an `iota` ancestor — the
+    `where(key_pos <= query_pos, scores, -inf)` shape the chunked-prefill
+    attention must carry.  Recurses into sub-jaxprs (pjit/cond/scan)."""
+    from jax._src import core as jex_core
+
+    producer = {}
+    for eqn in jaxpr.eqns:
+        for ov in eqn.outvars:
+            producer[ov] = eqn
+
+    def ancestry_prims(var, depth):
+        seen = set()
+        stack = [(var, depth)]
+        prims = set()
+        while stack:
+            v, d = stack.pop()
+            if d <= 0 or isinstance(v, jex_core.Literal):
+                continue
+            eqn = producer.get(v)
+            if eqn is None or id(eqn) in seen:
+                continue
+            seen.add(id(eqn))
+            prims.add(eqn.primitive.name)
+            for iv in eqn.invars:
+                stack.append((iv, d - 1))
+        return prims
+
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in _SELECT_PRIMS:
+            prims = ancestry_prims(eqn.invars[0], max_depth)
+            if prims & _COMPARE_PRIMS and "iota" in prims:
+                return True
+    for eqn in jaxpr.eqns:
+        for param in eqn.params.values():
+            sub = []
+            if hasattr(param, "jaxpr"):
+                sub = [param.jaxpr]
+            elif isinstance(param, (list, tuple)):
+                sub = [p.jaxpr for p in param if hasattr(p, "jaxpr")]
+            for s in sub:
+                if _has_masked_select(s, max_depth):
+                    return True
+    return False
+
+
+def audit_chunked_prefill(result, cache_arg: int = 0,
+                          node: str = "prefill_chunk") -> List[Finding]:
+    """SERVE002 over a compiled chunked-prefill step: (a) the staging
+    cache (positional arg `cache_arg`) must be fully donated — warning
+    severity, slow-not-wrong; (b) the program must contain a length-masked
+    select over an iota-derived predicate — error severity, because an
+    unmasked full-window attention lets restored-prefix tails, recycled-
+    row garbage, and idle-row writes leak into live rows' logits.  The
+    mask walk retraces `result.jitted` on its input avals; when the
+    retrace is unavailable the mask check is skipped (donation still
+    audits)."""
+    findings = _donation_findings(
+        result, cache_arg, node, "SERVE002",
+        "every prefill chunk pays a full staging-cache HBM copy instead "
+        "of an in-place XLA update", severity=SEV_WARNING)
+    try:
+        import jax
+
+        traced = jax.make_jaxpr(result.jitted)(*result.in_avals)
+    except Exception:
+        return findings
+    if not _has_masked_select(traced.jaxpr):
+        findings.append(make_finding(
+            "SERVE002", node,
+            "no length-masked select found in the chunked-prefill "
+            "program: the attention window over the staging cache is not "
+            "masked to `key_pos <= query_pos`, so stale rows (restored "
+            "prefix tails, recycled staging rows, idle-row garbage) can "
+            "leak into live logits"))
+    return findings
+
+
+def audit_prefix_cache(trie, node: str = "prefix_cache") -> List[Finding]:
+    """SERVE002 over a live `serve.prefix_cache.PrefixCache`: one error
+    finding per refcount/byte-accounting invariant violation (drift here
+    means eviction decisions are being made on corrupt bookkeeping —
+    a pinned chunk could be evicted under a live slot)."""
+    return [make_finding("SERVE002", node, problem)
+            for problem in trie.check_invariants()]

@@ -235,6 +235,12 @@ decode_attention_backend = os.environ.get("EASYDIST_DECODE_ATTENTION",
 # program is O(block), independent of cache length).  TRACE-AFFECTING:
 # changes the pallas_call grid, so it salts the strategy cache too.
 decode_block_k = _env_int("EASYDIST_DECODE_BLOCK_K", 256)
+# attention backend for the chunked-prefill pass (`*_prefill_chunk`):
+# "auto" | "xla" — both resolve to the masked dot_general path today; the
+# knob reserves the dispatch point for a blocked Pallas prefill kernel.
+# TRACE-AFFECTING: part of the strategy-cache salt like the decode backend.
+prefill_attention_backend = os.environ.get("EASYDIST_PREFILL_ATTENTION",
+                                           "auto")
 
 # ---------------- resilience (easydist_tpu.resilience) ----------------
 # deterministic fault schedule, e.g. "step.nan_grad@7,ckpt.write.partial@2"

@@ -269,7 +269,7 @@ def _compile_cache_key(closed_jaxpr, axis_specs) -> str:
     # schema + cost-model salt: cached strategies are only valid for the
     # solver/cost-model that produced them; a version bump or a tuned
     # bandwidth/latency knob must miss, not silently serve stale plans
-    h.update(("v6|" + "|".join(
+    h.update(("v7|" + "|".join(
         f"{k}={getattr(edconfig, k)}" for k in
         ("ici_bandwidth", "dcn_bandwidth", "ici_latency", "dcn_latency",
          "hbm_bandwidth", "all_to_all_punish_factor",
@@ -295,7 +295,10 @@ def _compile_cache_key(closed_jaxpr, axis_specs) -> str:
          # program (pallas_call kernel vs masked dot_general) at identical
          # input shapes, so serve decode builds must not share strategies
          # across backends
-         "decode_attention_backend", "decode_block_k"))).encode())
+         "decode_attention_backend", "decode_block_k",
+         # chunked-prefill backend: same reasoning as the decode backend —
+         # different emitted programs at identical shapes
+         "prefill_attention_backend"))).encode())
     names = VarNames()
     for v in closed_jaxpr.jaxpr.invars:
         names.name(v)

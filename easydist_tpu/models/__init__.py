@@ -10,12 +10,12 @@ from .mlp import mlp_init, mlp_apply, make_mlp_train_step  # noqa: F401
 from .gpt import (GPTConfig, gpt_init, gpt_apply,  # noqa: F401
                   make_gpt_train_step)
 from .gpt import (init_kv_cache as gpt_init_kv_cache,  # noqa: F401
-                  gpt_prefill, gpt_decode_step)
+                  gpt_prefill, gpt_prefill_chunk, gpt_decode_step)
 from .resnet import resnet_init, resnet_apply, make_resnet_train_step  # noqa: F401
 from .optim import adam_init, adam_update, sgd_update  # noqa: F401
 from .llama import (LlamaConfig, llama_init, llama_apply,  # noqa: F401
                     make_llama_train_step)
 from .llama import (init_kv_cache as llama_init_kv_cache,  # noqa: F401
-                    llama_prefill, llama_decode_step)
+                    llama_prefill, llama_prefill_chunk, llama_decode_step)
 from .vit import ViTConfig, vit_init, vit_apply, make_vit_train_step  # noqa: F401
 from .gat import GATConfig, gat_init, gat_apply, make_gat_train_step  # noqa: F401

@@ -263,6 +263,75 @@ def gpt_prefill(params, cfg: GPTConfig, cache, tokens, lengths):
     return cache, last.astype(jnp.float32) @ params["wte"].T
 
 
+def _cache_write_chunk(cache_layer, new, start):
+    """Write a fixed-size chunk of K or V rows per sequence: cache_layer
+    [b, h, T, hd], new [b, h, c, hd], start int32 [b] -> updated layer.
+    Per-row dynamic_update_slice at a traced start keeps ONE compiled
+    signature across every chunk position."""
+    return jax.vmap(
+        lambda cl, n, s: jax.lax.dynamic_update_slice(
+            cl, n.astype(cl.dtype), (0, s, 0)))(
+        cache_layer, new, start.astype(jnp.int32))
+
+
+def gpt_prefill_chunk(params, cfg: GPTConfig, cache, tokens, start_pos,
+                      lengths):
+    """One fixed-size prefill chunk: run `tokens` (int32 [batch, chunk])
+    at absolute positions `start_pos + [0..chunk)` (int32 [batch]), write
+    the chunk's K/V into `cache` at those positions, and return
+    (cache, logits [batch, vocab]) taken at each row's last real position
+    — valid for rows whose chunk contains `lengths - 1` (the finishing
+    chunk), garbage otherwise (the scheduler only reads finishing rows).
+
+    Unlike `gpt_prefill` this attends the FULL cache window [0, T) with a
+    `key_pos <= query_pos` mask, so the traced shape is independent of how
+    much prompt is already cached: one compiled signature per bucket
+    replaces the per-pow2-length set, and restored prefix chunks (written
+    by a previous request via the prefix trie) are consumed exactly as if
+    recomputed — softmax weights past a row's live positions underflow to
+    exact 0, the stale-row-leakage property analyze SERVE002 audits."""
+    from easydist_tpu.ops import chunk_attention
+
+    dtype = jnp.dtype(cfg.dtype)
+    heads = cfg.heads
+    b, c_len = tokens.shape
+    hd = cfg.dim // heads
+    start = start_pos.astype(jnp.int32)
+    # absolute positions of this chunk's queries, per row: [b, chunk]
+    abs_pos = start[:, None] + jnp.arange(c_len, dtype=jnp.int32)[None, :]
+    x = params["wte"][tokens].astype(dtype) \
+        + params["wpe"][abs_pos].astype(dtype)
+    new_k, new_v = [], []
+    for li, blk in enumerate(_block_list(params, cfg)):
+        p_at = blk["attn"]
+        h_in = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"]).astype(dtype)
+        qkv = h_in @ p_at["qkv"]["w"].astype(dtype) \
+            + p_at["qkv"]["b"].astype(dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, c_len, heads, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(b, c_len, heads, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(b, c_len, heads, hd).transpose(0, 2, 1, 3)
+        ck = _cache_write_chunk(cache["k"][li], k, start)
+        cv = _cache_write_chunk(cache["v"][li], v, start)
+        new_k.append(ck)
+        new_v.append(cv)
+        att = chunk_attention(q, ck.astype(dtype), cv.astype(dtype),
+                              abs_pos)
+        att = att.transpose(0, 2, 1, 3).reshape(b, c_len, cfg.dim)
+        x = x + (att @ p_at["proj"]["w"].astype(dtype)
+                 + p_at["proj"]["b"].astype(dtype))
+        h = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"]).astype(dtype)
+        h = jax.nn.gelu(h @ blk["mlp"]["fc"]["w"].astype(dtype)
+                        + blk["mlp"]["fc"]["b"].astype(dtype))
+        x = x + (h @ blk["mlp"]["proj"]["w"].astype(dtype)
+                 + blk["mlp"]["proj"]["b"].astype(dtype))
+    cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
+    rel_last = jnp.clip(lengths.astype(jnp.int32) - 1 - start, 0, c_len - 1)
+    last = jnp.take_along_axis(x, rel_last[:, None, None], axis=1)[:, 0]
+    return cache, last.astype(jnp.float32) @ params["wte"].T
+
+
 def gpt_decode_step(params, cfg: GPTConfig, cache, token, pos):
     """One cached decode step: feed `token` (int32 [batch]) at position
     `pos` (int32 [batch], == current sequence length per row) and return

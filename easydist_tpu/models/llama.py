@@ -213,6 +213,82 @@ def llama_prefill(params, cfg: LlamaConfig, cache, tokens, lengths):
     return cache, last.astype(jnp.float32) @ params["wte"].T
 
 
+def _rope_abs(x, pos, theta):
+    """x: [b, n, c, d] chunk heads rotated at absolute positions `pos`
+    (int32 [b, c]) — the chunked-prefill form of `_rope`/`_rope_at`."""
+    b, n, c, d = x.shape
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    ang = pos.astype(jnp.float32)[..., None] * freqs  # [b, c, d/2]
+    cos = jnp.cos(ang)[:, None, :, :]
+    sin = jnp.sin(ang)[:, None, :, :]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    r1 = x1 * cos - x2 * sin
+    r2 = x1 * sin + x2 * cos
+    return jnp.stack([r1, r2], axis=-1).reshape(b, n, c, d)
+
+
+def _cache_write_chunk(cache_layer, new, start):
+    """cache_layer [b, n, T, hd], new [b, n, c, hd], start int32 [b]."""
+    return jax.vmap(
+        lambda cl, n_, s: jax.lax.dynamic_update_slice(
+            cl, n_.astype(cl.dtype), (0, s, 0)))(
+        cache_layer, new, start.astype(jnp.int32))
+
+
+def llama_prefill_chunk(params, cfg: LlamaConfig, cache, tokens, start_pos,
+                        lengths):
+    """One fixed-size prefill chunk (the llama mirror of
+    `gpt.gpt_prefill_chunk`): `tokens` (int32 [batch, chunk]) at absolute
+    positions `start_pos + [0..chunk)`, K/V roped at those absolute
+    positions and written into `cache` at kv_heads granularity, attention
+    over the FULL cache window masked to `key_pos <= query_pos`.  Returns
+    (cache, logits [batch, vocab]) at each row's last real position —
+    valid for rows whose chunk contains `lengths - 1`."""
+    from easydist_tpu.ops import chunk_attention
+
+    dtype = jnp.dtype(cfg.dtype)
+    b, c_len = tokens.shape
+    hd = cfg.dim // cfg.heads
+    rep = cfg.heads // cfg.kv_heads
+    start = start_pos.astype(jnp.int32)
+    abs_pos = start[:, None] + jnp.arange(c_len, dtype=jnp.int32)[None, :]
+    x = params["wte"][tokens].astype(dtype)
+    new_k, new_v = [], []
+    for li, blk in enumerate(params["blocks"]):
+        hx = _rmsnorm(x, blk["attn_norm"]).astype(dtype)
+
+        def heads(y, n):
+            return y.reshape(b, c_len, n, hd).transpose(0, 2, 1, 3)
+
+        q = heads(hx @ blk["wq"].astype(dtype), cfg.heads)
+        k = heads(hx @ blk["wk"].astype(dtype), cfg.kv_heads)
+        v = heads(hx @ blk["wv"].astype(dtype), cfg.kv_heads)
+        q = _rope_abs(q.astype(jnp.float32), abs_pos,
+                      cfg.rope_theta).astype(dtype)
+        k = _rope_abs(k.astype(jnp.float32), abs_pos,
+                      cfg.rope_theta).astype(dtype)
+        ck = _cache_write_chunk(cache["k"][li], k, start)
+        cv = _cache_write_chunk(cache["v"][li], v, start)
+        new_k.append(ck)
+        new_v.append(cv)
+        kf, vf = ck.astype(dtype), cv.astype(dtype)
+        if rep > 1:
+            kf = jnp.repeat(kf, rep, axis=1)
+            vf = jnp.repeat(vf, rep, axis=1)
+        att = chunk_attention(q, kf, vf, abs_pos)
+        out = att.transpose(0, 2, 1, 3).reshape(b, c_len, cfg.heads * hd)
+        x = x + out @ blk["wo"].astype(dtype)
+        hx = _rmsnorm(x, blk["ffn_norm"]).astype(dtype)
+        gated = jax.nn.silu(hx @ blk["w_gate"].astype(dtype)) \
+            * (hx @ blk["w_up"].astype(dtype))
+        x = x + gated @ blk["w_down"].astype(dtype)
+    cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    x = _rmsnorm(x, params["norm_f"])
+    rel_last = jnp.clip(lengths.astype(jnp.int32) - 1 - start, 0, c_len - 1)
+    last = jnp.take_along_axis(x, rel_last[:, None, None], axis=1)[:, 0]
+    return cache, last.astype(jnp.float32) @ params["wte"].T
+
+
 def llama_decode_step(params, cfg: LlamaConfig, cache, token, pos):
     """One cached decode step: (cache, logits [batch, vocab]) for `token`
     (int32 [batch]) at absolute position `pos` (int32 [batch]).  Q and the

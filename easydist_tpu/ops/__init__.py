@@ -1,4 +1,4 @@
 """Pallas TPU kernels for hot ops."""
 
-from .flash_attention import (decode_attention,  # noqa: F401
+from .flash_attention import (chunk_attention, decode_attention,  # noqa: F401
                               flash_attention, flash_decode_attention)

@@ -540,3 +540,42 @@ def decode_attention(q, k, v, lengths, scale: Optional[float] = None,
         return _decode_attention_xla(q, k, v, lengths, scale)
     raise ValueError(f"unknown decode attention backend {backend!r}; "
                      f"expected auto|flash|xla")
+
+
+def _chunk_attention_xla(q, k, v, q_pos, scale: float):
+    """Masked dot_general chunked-prefill path: q [b, h, c, hd] at absolute
+    positions `q_pos` (int32 [b, c]) attends the full cache window k/v
+    [b, h, T, hd].  A key at position kp is visible iff kp <= q_pos, which
+    is simultaneously the causal mask *within* the chunk and the validity
+    mask over the cache tail (stale rows beyond the row's live length sit
+    at positions > q_pos, so their softmax weight underflows to exact 0 —
+    the no-stale-leakage property SERVE002 audits statically)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
+    s = jnp.where(k_pos <= q_pos.astype(jnp.int32)[:, None, :, None], s,
+                  _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def chunk_attention(q, k, v, q_pos, scale: Optional[float] = None,
+                    backend: Optional[str] = None):
+    """Backend-dispatching chunked-prefill attention (the models'
+    `*_prefill_chunk` call this): q is a fixed-size token chunk at
+    absolute positions `q_pos`, k/v are the full bucket-length cache.
+    `EASYDIST_PREFILL_ATTENTION` forces the backend; today both "auto"
+    and "xla" resolve to the masked dot_general path (a blocked Pallas
+    variant can slot in behind the same knob), and the choice is part of
+    the strategy-cache salt like the decode backend."""
+    from easydist_tpu import config as edconfig
+
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if backend is None:
+        backend = edconfig.prefill_attention_backend
+    if backend in ("auto", "xla"):
+        return _chunk_attention_xla(q, k, v, q_pos, scale)
+    raise ValueError(f"unknown prefill attention backend {backend!r}; "
+                     f"expected auto|xla")

@@ -23,3 +23,4 @@ from .batcher import (MicroBatcher, PackMeta, Request,  # noqa: F401
 from .engine import ServeConfig, ServeEngine  # noqa: F401
 from .generation import GenerationSession, kv_cache_specs  # noqa: F401
 from .metrics import LatencyHistogram, ServeMetrics  # noqa: F401
+from .prefix_cache import PrefixCache, chunk_key  # noqa: F401

@@ -81,6 +81,19 @@ class ServeConfig:
         shape/dtype-visible in every decode signature.
     max_decode_slots: slots per decode bucket — the fixed decode batch
         width (idle slots show up as occupancy, never as a new signature).
+    prefill_chunk: token window of one chunked-prefill pass — prompts run
+        in fixed [prefill_batch, prefill_chunk] chunk calls, so ONE
+        compiled prefill signature per bucket serves every prompt length;
+        also the prefix-cache chunk granularity (reuse is whole chunks).
+    prefill_batch: staging rows — how many pending prompts pack into a
+        single chunked-prefill call.
+    prefill_chunks_per_step: chunk calls interleaved per `step()` before
+        the decode rounds run — bounds decode p99 under prefill pressure.
+    enable_prefix_cache: commit/restore prefix KV chunks via the token
+        trie (serve/prefix_cache.py); off = every prompt recomputes from
+        position 0 (bitwise-identical outputs either way).
+    prefix_cache_bytes: LRU byte budget per decode bucket's trie; 0
+        disables committing.
     """
     batch_buckets: Tuple[int, ...] = (1, 2, 4, 8)
     seq_buckets: Optional[Tuple[int, ...]] = None
@@ -100,6 +113,11 @@ class ServeConfig:
     decode_buckets: Tuple[int, ...] = (1024,)
     kv_cache_dtype: str = "auto"
     max_decode_slots: int = 8
+    prefill_chunk: int = 64
+    prefill_batch: int = 4
+    prefill_chunks_per_step: int = 4
+    enable_prefix_cache: bool = True
+    prefix_cache_bytes: int = 64 * 2**20
 
     def __post_init__(self):
         if not self.batch_buckets:
@@ -135,6 +153,30 @@ class ServeConfig:
         if self.max_decode_slots < 1:
             raise ValueError(f"max_decode_slots must be >= 1, "
                              f"got {self.max_decode_slots}")
+        if self.prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, "
+                             f"got {self.prefill_chunk}")
+        for b in self.decode_buckets:
+            # the effective chunk (min(prefill_chunk, bucket)) must tile
+            # the bucket exactly: a chunk write that would spill past the
+            # bucket gets its start CLAMPED by dynamic_update_slice,
+            # silently corrupting earlier cache rows
+            eff = min(self.prefill_chunk, b)
+            if b % eff != 0:
+                raise ValueError(
+                    f"decode bucket {b} is not a multiple of the "
+                    f"effective prefill chunk {eff} "
+                    f"(prefill_chunk={self.prefill_chunk}); chunked "
+                    f"prefill windows must tile the bucket exactly")
+        if self.prefill_batch < 1:
+            raise ValueError(f"prefill_batch must be >= 1, "
+                             f"got {self.prefill_batch}")
+        if self.prefill_chunks_per_step < 1:
+            raise ValueError(f"prefill_chunks_per_step must be >= 1, "
+                             f"got {self.prefill_chunks_per_step}")
+        if self.prefix_cache_bytes < 0:
+            raise ValueError(f"prefix_cache_bytes must be >= 0 "
+                             f"(0 disables), got {self.prefix_cache_bytes}")
 
 
 class ServeEngine:

@@ -4,27 +4,32 @@
 whole forward.  For autoregressive generation that is O(T^2) attention
 flops per sequence; the KV cache makes each token O(T).  This module is
 the serving half of the cache-carrying model API
-(models/gpt.py::gpt_prefill/gpt_decode_step and the llama mirror):
+(models/gpt.py::gpt_prefill_chunk/gpt_decode_step and the llama mirror):
 
-  * **prefill/decode split** — each admitted prompt runs one prefill
-    through a closed set of padded prompt lengths (powers of two, capped
-    at the decode bucket) into a single-row staging cache, then migrates
-    into a slot of the bucket's pooled cache with one
-    `dynamic_update_slice`;
-  * **bucketed KV pool** — one slot pool per `ServeConfig.decode_buckets`
-    entry, shaped [layers, max_decode_slots, heads, bucket, head_dim].
-    Slots are recycled through a free list as requests retire (EOS /
-    max-new-tokens / bucket exhausted), so admission is continuous;
-  * **one compiled decode step** — decode always steps ALL slots of a
-    pool (idle rows are throwaway work the occupancy gauge accounts
-    for), so token/pos arrays have a fixed shape and the jaxfront
-    signature cache holds exactly one decode executable per bucket, for
-    every token of every request;
-  * **donated cache** — the pool is positional arg 0 of the compiled
-    step and the first output, so `infer_state_io` pairs and donates it:
-    XLA updates the cache in place instead of copying
-    layers*slots*bucket*dim bytes per token.  `analyze.SERVE001` audits
-    exactly this property after the first decode compile.
+  * **chunked, batched prefill** — each admitted prompt is processed in
+    fixed [prefill_batch, prefill_chunk] windows against a multi-row
+    staging cache, so ONE compiled prefill signature per bucket serves
+    every prompt length (PR 9 compiled one per pow2-padded length), and
+    up to `prefill_batch` pending prompts share each chunk call;
+  * **prefix-reuse KV cache** — finished prefills commit their aligned
+    KV chunks into a per-bucket token trie (serve/prefix_cache.py);
+    admission restores the longest cached whole-chunk prefix with
+    `dynamic_update_slice` and resumes prefill at `prefix_len` instead
+    of 0.  Restored and recomputed KV are bitwise identical, so the
+    cache is a pure latency optimization (`enable_prefix_cache=False`
+    produces bitwise-identical outputs);
+  * **bounded prefill pressure** — `step()` interleaves at most
+    `prefill_chunks_per_step` chunk calls before the decode rounds run,
+    so a long prompt cannot stall in-flight decodes for its whole
+    prefill (decode p99 stays bounded);
+  * **bucketed KV pool + one compiled decode step** — unchanged from
+    PR 9: one slot pool per `ServeConfig.decode_buckets` entry, decode
+    always steps ALL slots, slots recycle through a free list;
+  * **donated caches** — pool and staging are positional arg 0 and
+    output 0 of their compiled callables, so `infer_state_io` pairs and
+    donates them; XLA updates in place instead of copying.  `analyze`
+    rules SERVE001 (decode) and SERVE002 (chunked prefill: donation +
+    length-masked attention + trie accounting) audit exactly this.
 
 Sharding rides the existing solver: the cache's heads axis (dim 2) is the
 tensor-parallel shard dim, matching the attention strategy the solver
@@ -48,6 +53,7 @@ from .admission import RequestTooLargeError
 from .batcher import select_bucket
 from .engine import ServeConfig
 from .metrics import ServeMetrics
+from .prefix_cache import PrefixCache
 
 logger = logging.getLogger(__name__)
 
@@ -73,19 +79,46 @@ class _Slot:
     max_new: int
     eos_id: Optional[int]
     generated: List[int] = field(default_factory=list)
+    pinned: List[object] = field(default_factory=list)  # trie nodes held
+
+
+@dataclass
+class _PrefillJob:
+    """One prompt mid-prefill: owns a staging row and a reserved pool
+    slot; `start` advances one chunk per batched chunk call."""
+    request_id: int
+    future: Future
+    prompt: List[int]
+    max_new: int
+    eos_id: Optional[int]
+    row: int                      # staging row
+    slot_idx: int                 # reserved pool slot
+    start: int                    # next chunk start (multiple of chunk)
+    prefix_nodes: List[object]    # trie nodes restored (pinned)
+    t_submit: float
 
 
 class _BucketPool:
     """One decode bucket: pooled cache + free-list slot allocator +
-    single-row staging cache reused across prefills."""
+    multi-row staging cache shared by the chunked-prefill scheduler +
+    the bucket's prefix trie."""
 
-    def __init__(self, bucket: int, n_slots: int, init_cache):
+    def __init__(self, bucket: int, n_slots: int, init_cache,
+                 n_rows: int = 1, chunk: int = 0,
+                 prefix_bytes: int = 0):
         self.bucket = bucket
         self.n_slots = n_slots
         self.cache = init_cache(n_slots, bucket)
-        self.staging = init_cache(1, bucket)
+        self.n_rows = n_rows
+        self.staging = init_cache(n_rows, bucket)
+        self.chunk = chunk                      # 0 = legacy one-shot path
         self.free: List[int] = list(range(n_slots))
         self.slots: Dict[int, _Slot] = {}          # slot index -> _Slot
+        self.free_rows: List[int] = list(range(n_rows))
+        self.jobs: Dict[int, _PrefillJob] = {}     # staging row -> job
+        self.trie: Optional[PrefixCache] = \
+            PrefixCache(chunk, prefix_bytes) if chunk and prefix_bytes \
+            else None
 
     @property
     def n_active(self) -> int:
@@ -97,17 +130,23 @@ class GenerationSession:
 
     model_prefill(params, cache, tokens, lengths) -> (cache, logits)
     model_decode(params, cache, token, pos) -> (cache, logits)
+    model_prefill_chunk(params, cache, tokens, start_pos, lengths)
+        -> (cache, logits) — fixed-chunk window at absolute positions;
+        enables the chunked/batched/prefix-reuse prefill scheduler (the
+        `for_gpt`/`for_llama` constructors wire it; without it the
+        session falls back to PR 9's one-shot pow2-padded prefill).
     init_cache(batch, max_len, dtype=None) -> cache pytree
 
     Greedy decoding (argmax inside the compiled step, so only int32 token
     ids cross the host boundary per token).  `submit` returns a Future
     resolving to {"ids": [...generated ids...], "finish_reason":
-    "eos"|"length"|"bucket_full"}; drive with `step()` (one admit +
-    decode + harvest round) or `run_until_drained()`.
+    "eos"|"length"|"bucket_full"}; drive with `step()` (admit + bounded
+    prefill chunks + decode + harvest) or `run_until_drained()`.
     """
 
     def __init__(self, params, *, model_prefill: Callable,
                  model_decode: Callable, init_cache: Callable,
+                 model_prefill_chunk: Optional[Callable] = None,
                  config: Optional[ServeConfig] = None, mesh=None,
                  eos_id: Optional[int] = None,
                  max_prompt_len: Optional[int] = None,
@@ -128,10 +167,12 @@ class GenerationSession:
         self.eos_id = eos_id
         self.metrics = metrics or ServeMetrics()
         self._init_cache = init_cache
+        self._chunked = model_prefill_chunk is not None
         self._pending: collections.deque = collections.deque()
         self._pools: Dict[int, _BucketPool] = {}
         self._next_request_id = 0
         self._audited: set = set()
+        self._audited_prefill: set = set()
 
         def _prefill(cache, params, tokens, lengths):
             import jax.numpy as jnp
@@ -139,15 +180,36 @@ class GenerationSession:
             cache, logits = model_prefill(params, cache, tokens, lengths)
             return cache, jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-        def _migrate(pool, cache, slot):
+        def _prefill_chunk(staging, params, tokens, start, lengths):
+            import jax.numpy as jnp
+
+            staging, logits = model_prefill_chunk(params, staging, tokens,
+                                                  start, lengths)
+            return staging, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        def _restore(staging, chunk_kv, row, start):
             import jax
 
             return {
                 k: jax.lax.dynamic_update_slice(
-                    pool[k], cache[k].astype(pool[k].dtype),
-                    (0, slot, 0, 0, 0))
+                    staging[k],
+                    chunk_kv[k][:, None].astype(staging[k].dtype),
+                    (0, row, 0, start, 0))
                 for k in ("k", "v")
             }
+
+        def _migrate(pool, staging, row, slot):
+            import jax
+
+            out = {}
+            for k in ("k", "v"):
+                layers, _, heads, max_len, hd = staging[k].shape
+                src = jax.lax.dynamic_slice(
+                    staging[k], (0, row, 0, 0, 0),
+                    (layers, 1, heads, max_len, hd))
+                out[k] = jax.lax.dynamic_update_slice(
+                    pool[k], src.astype(pool[k].dtype), (0, slot, 0, 0, 0))
+            return out
 
         def _decode(pool, params, token, pos):
             import jax.numpy as jnp
@@ -155,11 +217,39 @@ class GenerationSession:
             pool, logits = model_decode(params, pool, token, pos)
             return pool, jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-        # pool/cache is arg 0 and output 0 of every compiled callable, so
-        # state_io="auto" pairs it and XLA gets the buffer donated
+        # pool/staging is arg 0 and output 0 of every mutating compiled
+        # callable, so state_io="auto" pairs it and XLA gets the buffer
+        # donated; _extract's output is chunk-shaped (no pairing, no
+        # donation — it must not invalidate the staging it reads)
         self._prefill_c = easydist_compile(_prefill, mesh=mesh)
+        self._prefill_chunk_c = easydist_compile(_prefill_chunk, mesh=mesh)
+        self._restore_c = easydist_compile(_restore, mesh=mesh)
+        self._extract_cs: Dict[int, Callable] = {}
         self._migrate_c = easydist_compile(_migrate, mesh=mesh)
         self._decode_c = easydist_compile(_decode, mesh=mesh)
+
+    def _extract_for(self, chunk_len: int) -> Callable:
+        """Compiled chunk extractor for one chunk size (the slice size
+        must be static, so each chunk length is its own closure — one per
+        distinct bucket chunk, compiled once)."""
+        fn = self._extract_cs.get(chunk_len)
+        if fn is None:
+            from easydist_tpu.jaxfront import easydist_compile
+
+            def _extract(staging, row, start):
+                import jax
+
+                out = {}
+                for k in ("k", "v"):
+                    layers, _, heads, _, hd = staging[k].shape
+                    out[k] = jax.lax.dynamic_slice(
+                        staging[k], (0, row, 0, start, 0),
+                        (layers, 1, heads, chunk_len, hd))[:, 0]
+                return out
+
+            fn = easydist_compile(_extract, mesh=self.mesh)
+            self._extract_cs[chunk_len] = fn
+        return fn
 
     # ------------------------------------------------------------ admission
     def submit(self, prompt_ids: Sequence[int],
@@ -181,7 +271,8 @@ class GenerationSession:
         fut = Future()
         self._pending.append(
             (prompt, max_new_tokens,
-             self.eos_id if eos_id is None else eos_id, fut))
+             self.eos_id if eos_id is None else eos_id, fut,
+             time.perf_counter()))
         self.metrics.inc("requests_submitted")
         return fut
 
@@ -189,8 +280,17 @@ class GenerationSession:
     def _pool_for(self, bucket: int) -> _BucketPool:
         pool = self._pools.get(bucket)
         if pool is None:
-            pool = _BucketPool(bucket, self.config.max_decode_slots,
-                               self._cache_factory)
+            cfg = self.config
+            if self._chunked:
+                pool = _BucketPool(
+                    bucket, cfg.max_decode_slots, self._cache_factory,
+                    n_rows=cfg.prefill_batch,
+                    chunk=min(cfg.prefill_chunk, bucket),
+                    prefix_bytes=(cfg.prefix_cache_bytes
+                                  if cfg.enable_prefix_cache else 0))
+            else:
+                pool = _BucketPool(bucket, cfg.max_decode_slots,
+                                   self._cache_factory)
             self._pools[bucket] = pool
         return pool
 
@@ -200,29 +300,57 @@ class GenerationSession:
                                 None if dtype == "auto" else dtype)
 
     def _prefill_pad(self, plen: int, bucket: int) -> int:
-        """Smallest power of two >= plen (floor 8), capped at the decode
-        bucket — the closed set of prefill signatures per bucket."""
+        """Legacy one-shot path: smallest power of two >= plen (floor 8),
+        capped at the decode bucket."""
         t = 8
         while t < plen:
             t *= 2
         return min(t, bucket)
 
     def _admit_one(self) -> bool:
-        """Pop one pending request into a free slot: prefill + migrate.
-        Returns False when nothing is admissible."""
+        """Pop one pending request toward generation.  Chunked path:
+        reserve a pool slot + staging row, restore the longest cached
+        prefix, and enqueue a prefill job (chunks run in `step()`).
+        Legacy path: one-shot prefill + migrate, as in PR 9.  Returns
+        False when nothing is admissible."""
         import jax.numpy as jnp
 
         if not self._pending:
             return False
-        prompt, max_new, eos, fut = self._pending[0]
+        prompt, max_new, eos, fut, t_submit = self._pending[0]
         bucket = select_bucket(len(prompt) + 1, self.config.decode_buckets)
         pool = self._pool_for(bucket)
         if not pool.free:
+            return False
+        if self._chunked and not pool.free_rows:
             return False
         self._pending.popleft()
         if fut.set_running_or_notify_cancel() is False:
             return True  # cancelled while queued; slot stays free
         slot_idx = pool.free.pop()
+
+        if self._chunked:
+            row = pool.free_rows.pop()
+            prefix_len, nodes = 0, []
+            if pool.trie is not None:
+                # cap below len(prompt): at least one real token must run
+                # through prefill so the finishing chunk produces logits
+                prefix_len, nodes = pool.trie.match(
+                    prompt, max_tokens=len(prompt) - 1)
+                for j, node in enumerate(nodes):
+                    pool.staging = self._restore_c(
+                        pool.staging, node.kv,
+                        jnp.asarray(row, jnp.int32),
+                        jnp.asarray(j * pool.chunk, jnp.int32))
+                pool.trie.pin(nodes)
+            self.metrics.record_admission(len(prompt), prefix_len)
+            pool.jobs[row] = _PrefillJob(
+                request_id=self._next_request_id, future=fut,
+                prompt=prompt, max_new=max_new, eos_id=eos, row=row,
+                slot_idx=slot_idx, start=prefix_len,
+                prefix_nodes=nodes, t_submit=t_submit)
+            self._next_request_id += 1
+            return True
 
         t_pad = self._prefill_pad(len(prompt), bucket)
         tokens = np.full((1, t_pad), int(self.config.pad_value), np.int32)
@@ -232,8 +360,10 @@ class GenerationSession:
             pool.staging, self.params, jnp.asarray(tokens),
             jnp.asarray(lengths))
         pool.cache = self._migrate_c(pool.cache, pool.staging,
+                                     jnp.asarray(0, jnp.int32),
                                      jnp.asarray(slot_idx, jnp.int32))
-        self.metrics.inc("prefills")
+        self.metrics.record_admission(len(prompt), 0)
+        self.metrics.observe("ttft", time.perf_counter() - t_submit)
 
         slot = _Slot(request_id=self._next_request_id, future=fut,
                      pos=len(prompt), token=int(np.asarray(first)[0]),
@@ -244,9 +374,92 @@ class GenerationSession:
         self._maybe_retire(pool, slot_idx)
         return True
 
+    # ----------------------------------------------------- chunked prefill
+    def _prefill_round(self, pool: _BucketPool, max_chunks: int) -> int:
+        """Run up to `max_chunks` batched chunk calls on `pool`'s staging
+        rows; finished jobs commit to the trie, migrate to their slot, and
+        free their row.  Returns the number of chunk calls executed."""
+        import jax
+        import jax.numpy as jnp
+
+        calls = 0
+        c_len = pool.chunk
+        while pool.jobs and calls < max_chunks:
+            tokens = np.full((pool.n_rows, c_len),
+                             int(self.config.pad_value), np.int32)
+            start = np.zeros((pool.n_rows,), np.int32)
+            lengths = np.ones((pool.n_rows,), np.int32)
+            for row, job in pool.jobs.items():
+                seg = job.prompt[job.start:job.start + c_len]
+                tokens[row, :len(seg)] = seg
+                start[row] = job.start
+                lengths[row] = len(job.prompt)
+            args = (pool.staging, self.params, jnp.asarray(tokens),
+                    jnp.asarray(start), jnp.asarray(lengths))
+            result = self._prefill_chunk_c.get_compiled(*args)
+            if pool.bucket not in self._audited_prefill:
+                self._audited_prefill.add(pool.bucket)
+                self._audit_chunked_prefill(result, pool.bucket)
+            t0 = time.perf_counter()
+            pool.staging, first = result.tree_jitted(*args)
+            first = np.asarray(jax.block_until_ready(first))
+            self.metrics.record_prefill_chunk(
+                pool.n_rows, c_len, time.perf_counter() - t0)
+            calls += 1
+            for row in list(pool.jobs):
+                job = pool.jobs[row]
+                job.start += c_len
+                if job.start >= len(job.prompt):
+                    self._finish_prefill(pool, row, int(first[row]))
+        return calls
+
+    def _finish_prefill(self, pool: _BucketPool, row: int,
+                        first_token: int) -> None:
+        """One job's last chunk ran: commit its aligned chunks into the
+        trie, migrate the staging row into the reserved pool slot, free
+        the row, and open the decode slot."""
+        import jax.numpy as jnp
+
+        job = pool.jobs.pop(row)
+        pinned = list(job.prefix_nodes)
+        if pool.trie is not None:
+            nodes = list(job.prefix_nodes)
+            for j in range(len(nodes), len(job.prompt) // pool.chunk):
+                chunk_toks = job.prompt[j * pool.chunk:(j + 1) * pool.chunk]
+                node = pool.trie.lookup_node(nodes, chunk_toks)
+                if node is None:
+                    kv = self._extract_for(pool.chunk)(
+                        pool.staging, jnp.asarray(row, jnp.int32),
+                        jnp.asarray(j * pool.chunk, jnp.int32))
+                    node = pool.trie.commit(nodes, chunk_toks, kv)
+                if node is None:
+                    break  # byte budget exhausted; partial path is fine
+                nodes.append(node)
+            # hold the full committed path for the slot's lifetime
+            pool.trie.unpin(job.prefix_nodes)
+            pool.trie.pin(nodes)
+            pinned = nodes
+            self._audit_prefix_cache(pool)
+        pool.cache = self._migrate_c(pool.cache, pool.staging,
+                                     jnp.asarray(row, jnp.int32),
+                                     jnp.asarray(job.slot_idx, jnp.int32))
+        pool.free_rows.append(row)
+        self.metrics.observe("ttft", time.perf_counter() - job.t_submit)
+
+        slot = _Slot(request_id=job.request_id, future=job.future,
+                     pos=len(job.prompt), token=first_token,
+                     max_new=job.max_new, eos_id=job.eos_id,
+                     pinned=pinned)
+        slot.generated.append(slot.token)
+        pool.slots[job.slot_idx] = slot
+        self._maybe_retire(pool, job.slot_idx)
+
+    # ------------------------------------------------------------- decoding
     def _retire(self, pool: _BucketPool, slot_idx: int, reason: str) -> None:
         slot = pool.slots.pop(slot_idx)
         pool.free.append(slot_idx)
+        if pool.trie is not None and slot.pinned:
+            pool.trie.unpin(slot.pinned)
         slot.future.set_result({"ids": list(slot.generated),
                                 "finish_reason": reason})
         self.metrics.inc("requests_completed")
@@ -301,13 +514,40 @@ class GenerationSession:
         except ImportError:  # analyze is an optional layer at runtime
             pass
 
+    def _audit_chunked_prefill(self, result, bucket: int) -> None:
+        try:
+            from easydist_tpu.analyze import check_chunked_prefill
+
+            check_chunked_prefill(result,
+                                  node=f"prefill_chunk[bucket={bucket}]")
+        except ImportError:
+            pass
+
+    def _audit_prefix_cache(self, pool: _BucketPool) -> None:
+        try:
+            from easydist_tpu.analyze import check_prefix_cache
+
+            check_prefix_cache(pool.trie,
+                               node=f"prefix_cache[bucket={pool.bucket}]")
+        except ImportError:
+            pass
+
     # ------------------------------------------------------------- driving
     def step(self) -> int:
-        """One serving round: admit pending prompts into free slots, run
-        one decode step per bucket with live slots, harvest retirements.
-        Returns the number of tokens generated this round."""
+        """One serving round: admit pending prompts into free slots/rows,
+        run at most `prefill_chunks_per_step` prefill chunk calls, then
+        one decode step per bucket with live slots, harvesting
+        retirements.  Returns the number of tokens generated this round
+        (decode tokens; prefill first-tokens count via `prefills`)."""
         while self._admit_one():
             pass
+        if self._chunked:
+            budget = self.config.prefill_chunks_per_step
+            for pool in self._pools.values():
+                if budget <= 0:
+                    break
+                if pool.jobs:
+                    budget -= self._prefill_round(pool, budget)
         before = self.metrics.counter("tokens_generated")
         for pool in self._pools.values():
             if pool.slots:
@@ -318,7 +558,7 @@ class GenerationSession:
         """Drive `step()` until no request is live or queued."""
         for _ in range(max_steps):
             if not self._pending and not any(
-                    p.slots for p in self._pools.values()):
+                    p.slots or p.jobs for p in self._pools.values()):
                 return
             self.step()
         raise RuntimeError(f"not drained after {max_steps} steps")
@@ -328,10 +568,15 @@ class GenerationSession:
         return {
             "pending": len(self._pending),
             "buckets": {
-                b: {"active": p.n_active, "free": len(p.free)}
+                b: {"active": p.n_active, "free": len(p.free),
+                    "prefilling": len(p.jobs),
+                    "free_rows": len(p.free_rows),
+                    "prefix_cache": (p.trie.stats() if p.trie else None)}
                 for b, p in self._pools.items()},
             "decode_signatures": self._decode_c.cache_stats(),
-            "prefill_signatures": self._prefill_c.cache_stats(),
+            "prefill_signatures": (
+                self._prefill_chunk_c if self._chunked
+                else self._prefill_c).cache_stats(),
             "migrate_signatures": self._migrate_c.cache_stats(),
             "metrics": self.metrics.snapshot(),
         }
@@ -346,6 +591,8 @@ class GenerationSession:
         return cls(
             params,
             model_prefill=lambda p, c, t, l: gpt.gpt_prefill(p, cfg, c, t, l),
+            model_prefill_chunk=lambda p, c, t, s, l: gpt.gpt_prefill_chunk(
+                p, cfg, c, t, s, l),
             model_decode=lambda p, c, t, pos: gpt.gpt_decode_step(
                 p, cfg, c, t, pos),
             init_cache=lambda b, L, dt=None: gpt.init_kv_cache(
@@ -362,6 +609,8 @@ class GenerationSession:
             params,
             model_prefill=lambda p, c, t, l: llama.llama_prefill(
                 p, cfg, c, t, l),
+            model_prefill_chunk=lambda p, c, t, s, l:
+                llama.llama_prefill_chunk(p, cfg, c, t, s, l),
             model_decode=lambda p, c, t, pos: llama.llama_decode_step(
                 p, cfg, c, t, pos),
             init_cache=lambda b, L, dt=None: llama.init_kv_cache(

@@ -72,11 +72,19 @@ class ServeMetrics:
       batch_rows_padded, compile_cache_hits, compile_cache_misses,
       oom_degradations, transient_retries, exec_timeouts (watchdog),
       tokens_generated (decode steps x active slots).
+    Chunked-prefill counters: prefills (admissions), prefill_chunks
+      (batched chunk calls), prefill_tokens_real (prompt tokens actually
+      needing prefill, prefix reuse already deducted),
+      prefill_tokens_padded (executed token slots = rows x chunk per
+      call), prefix_tokens_reused / prefix_tokens_total,
+      prefix_cache_hits / misses / evictions (trie chunk events).
     Gauges: decode_slot_occupancy (active slots / total slots at the last
-      decode step).
+      decode step), prefill_padding_ratio (executed token slots per real
+      prefill token, 1.0 = zero waste), prefix_cache_hit_rate (fraction
+      of prompt tokens restored from the prefix trie).
     Histograms: queue_wait (submit->drain), execute (device time incl.
     host roundtrip), e2e (submit->future resolution), per_token (one
-    decode-step wall time, all slots)."""
+    decode-step wall time, all slots), ttft (submit->first token)."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -86,6 +94,7 @@ class ServeMetrics:
         self.execute = LatencyHistogram()
         self.e2e = LatencyHistogram()
         self.per_token = LatencyHistogram()
+        self.ttft = LatencyHistogram()
 
     # ------------------------------------------------------------- recording
     def inc(self, name: str, n: int = 1) -> None:
@@ -124,6 +133,39 @@ class ServeMetrics:
                 (n_active / n_slots) if n_slots else 0.0
             self.per_token.observe(step_s)
 
+    def record_admission(self, prompt_len: int, prefix_len: int) -> None:
+        """One prompt admitted into the chunked-prefill scheduler:
+        `prefix_len` of its `prompt_len` tokens were restored from the
+        prefix trie, the rest must run through prefill."""
+        with self._lock:
+            self._counters["prefills"] = \
+                self._counters.get("prefills", 0) + 1
+            self._counters["prefill_tokens_real"] = \
+                self._counters.get("prefill_tokens_real", 0) \
+                + (prompt_len - prefix_len)
+            self._counters["prefix_tokens_reused"] = \
+                self._counters.get("prefix_tokens_reused", 0) + prefix_len
+            total = self._counters["prefix_tokens_total"] = \
+                self._counters.get("prefix_tokens_total", 0) + prompt_len
+            self._gauges["prefix_cache_hit_rate"] = \
+                self._counters["prefix_tokens_reused"] / total
+
+    def record_prefill_chunk(self, n_rows: int, chunk: int,
+                             chunk_s: float) -> None:
+        """One batched chunk call: `n_rows` staging rows executed `chunk`
+        token slots each (idle rows and padded tails included — that IS
+        the waste the padding-ratio gauge measures)."""
+        with self._lock:
+            self._counters["prefill_chunks"] = \
+                self._counters.get("prefill_chunks", 0) + 1
+            padded = self._counters["prefill_tokens_padded"] = \
+                self._counters.get("prefill_tokens_padded", 0) \
+                + n_rows * chunk
+            real = self._counters.get("prefill_tokens_real", 0)
+            if real:
+                self._gauges["prefill_padding_ratio"] = padded / real
+            self.execute.observe(chunk_s)
+
     def counter(self, name: str) -> int:
         with self._lock:
             return self._counters.get(name, 0)
@@ -143,6 +185,22 @@ class ServeMetrics:
             m = self._counters.get("compile_cache_misses", 0)
         return h / (h + m) if (h + m) else None
 
+    def prefill_padding_ratio(self) -> Optional[float]:
+        """Executed prefill token slots per real prefill token (>= 1.0;
+        1.0 = every executed slot carried a real token)."""
+        with self._lock:
+            padded = self._counters.get("prefill_tokens_padded", 0)
+            real = self._counters.get("prefill_tokens_real", 0)
+        return padded / real if real else None
+
+    def prefix_cache_hit_rate(self) -> Optional[float]:
+        """Fraction of submitted prompt tokens restored from the prefix
+        trie instead of recomputed."""
+        with self._lock:
+            reused = self._counters.get("prefix_tokens_reused", 0)
+            total = self._counters.get("prefix_tokens_total", 0)
+        return reused / total if total else None
+
     def snapshot(self) -> Dict[str, object]:
         with self._lock:
             counters = dict(self._counters)
@@ -150,11 +208,14 @@ class ServeMetrics:
             hists = {"queue_wait": self.queue_wait.snapshot(),
                      "execute": self.execute.snapshot(),
                      "e2e": self.e2e.snapshot(),
-                     "per_token": self.per_token.snapshot()}
+                     "per_token": self.per_token.snapshot(),
+                     "ttft": self.ttft.snapshot()}
         return {"counters": counters, "gauges": gauges,
                 "latency": hists,
                 "batch_occupancy": self.batch_occupancy(),
-                "compile_cache_hit_rate": self.compile_cache_hit_rate()}
+                "compile_cache_hit_rate": self.compile_cache_hit_rate(),
+                "prefill_padding_ratio": self.prefill_padding_ratio(),
+                "prefix_cache_hit_rate": self.prefix_cache_hit_rate()}
 
     def export(self, db=None, key: str = "serving",
                sub_key: str = "engine", persist: bool = True):

@@ -146,6 +146,9 @@ try:
         print("decode signature cache grew across tokens")
     elif not r.get("value", 0) >= 5.0:
         print(f"speedup {r.get('value')} < 5.0x")
+    elif r.get("perf_regression"):
+        print(f"committed-floor regression: {r.get('value')} is >10% below "
+              f"last-good {r.get('last_good_value')}")
     else:
         print("ok")
 except Exception as e:
@@ -158,6 +161,45 @@ EOF
     fi
 else
     echo "static_checks: jax not importable; skipping bench.py --decode"
+fi
+
+# chunked-prefill / prefix-cache gate: restoring a shared 256-token
+# prefix from the trie must cut TTFT >= 2x vs recomputing it (cache-off),
+# with bitwise greedy parity cache-on vs cache-off vs full re-forward and
+# ONE compiled chunk program per bucket across all prompt lengths
+if python -c "import jax" >/dev/null 2>&1; then
+    echo "== bench.py --prefill (prefix-cache TTFT speedup + parity gate)"
+    out=$(python bench.py --prefill 2>/dev/null) || rc=1
+    echo "$out"
+    verdict=$(python - "$out" <<'PYEOF'
+import json, sys
+try:
+    r = json.loads(sys.argv[1].strip().splitlines()[-1])
+    if "error" in r:
+        print("error: " + r["error"])
+    elif not r.get("parity_greedy"):
+        print("cache-on greedy ids diverge from cache-off")
+    elif not r.get("parity_vs_full_forward"):
+        print("greedy ids diverge from the full re-forward reference")
+    elif not r.get("signature_cache_constant"):
+        print("prefill signature cache grew across prompt lengths")
+    elif not r.get("value", 0) >= 2.0:
+        print(f"TTFT speedup {r.get('value')} < 2.0x")
+    elif r.get("perf_regression"):
+        print(f"committed-floor regression: {r.get('value')} is >10% below "
+              f"last-good {r.get('last_good_value')}")
+    else:
+        print("ok")
+except Exception as e:
+    print(f"unparseable: {e}")
+PYEOF
+)
+    if [ "$verdict" != "ok" ]; then
+        echo "static_checks: prefill gate failed ($verdict)"
+        rc=1
+    fi
+else
+    echo "static_checks: jax not importable; skipping bench.py --prefill"
 fi
 
 [ "$ran" = 0 ] && echo "static_checks: no external linters ran (configs still validated by CI tests)"

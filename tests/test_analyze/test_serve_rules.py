@@ -1,0 +1,139 @@
+"""Layer 5 serving auditor goldens: SERVE002 over compiled chunked-
+prefill programs (staging donation + length-mask presence) and over live
+prefix tries (refcount/byte invariants).  SERVE001 goldens live with the
+session tests in tests/test_serve/test_generation.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from easydist_tpu.analyze import (audit_chunked_prefill, audit_prefix_cache,
+                                  check_chunked_prefill, check_prefix_cache)
+from easydist_tpu.analyze.findings import AnalysisError
+from easydist_tpu.analyze.serve_rules import _has_masked_select
+from easydist_tpu.jaxfront import easydist_compile
+from easydist_tpu.models import gpt
+from easydist_tpu.serve import PrefixCache
+
+CHUNK = 4
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = gpt.GPTConfig.tiny()
+    params = gpt.gpt_init(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _chunk_args(cfg, batch=2):
+    cache = gpt.init_kv_cache(cfg, batch, cfg.seq)
+    tokens = jnp.zeros((batch, CHUNK), jnp.int32)
+    start = jnp.zeros((batch,), jnp.int32)
+    lengths = jnp.ones((batch,), jnp.int32)
+    return cache, tokens, start, lengths
+
+
+def _compile_chunk(cfg, params, donate=True):
+    def _pf(cache, prm, tokens, start, lengths):
+        cache, logits = gpt.gpt_prefill_chunk(prm, cfg, cache, tokens,
+                                              start, lengths)
+        return cache, jnp.argmax(logits, -1).astype(jnp.int32)
+
+    c = easydist_compile(_pf, donate_state=donate)
+    cache, tokens, start, lengths = _chunk_args(cfg)
+    return c.get_compiled(cache, params, tokens, start, lengths)
+
+
+class TestChunkedPrefillAudit:
+    def test_clean_build_zero_findings(self, model):
+        cfg, params = model
+        res = _compile_chunk(cfg, params, donate=True)
+        assert audit_chunked_prefill(res) == []
+        assert check_chunked_prefill(res) == []
+
+    def test_missing_donation_fires_warning_once(self, model):
+        cfg, params = model
+        res = _compile_chunk(cfg, params, donate=False)
+        findings = audit_chunked_prefill(res)
+        assert len(findings) == 1
+        assert findings[0].rule_id == "SERVE002"
+        assert findings[0].severity == "warning"
+        # warning-only: the hook logs, never raises
+        assert len(check_chunked_prefill(res)) == 1
+
+    def test_missing_mask_fires_error_once(self, model):
+        """An unmasked full-window attention (the where() dropped) must
+        trip the stale-row-leakage error."""
+        cfg, params = model
+
+        def _unmasked(cache, prm, tokens, start, lengths):
+            # same cache-write shape, but softmax over the raw scores:
+            # restored tails and idle-row garbage leak into the logits
+            q = prm["emb"][tokens]           # [b, c, hd]
+            k = cache["k"][0, :, 0]          # [b, max_len, hd]
+            s = jnp.einsum("bcd,btd->bct", q, k)
+            att = jax.nn.softmax(s, axis=-1)  # NO length mask
+            out = jnp.einsum("bct,btd->bcd", att, cache["v"][0, :, 0])
+            cache = {kk: cache[kk] + 0.0 for kk in cache}
+            return cache, out.sum((-1, -2)).astype(jnp.int32)
+
+        c = easydist_compile(_unmasked, donate_state=True)
+        cache, tokens, start, lengths = _chunk_args(cfg)
+        head_dim = cache["k"].shape[-1]
+        prm = {"emb": jnp.ones((cfg.vocab, head_dim), jnp.float32)}
+        res = c.get_compiled(cache, prm, tokens, start, lengths)
+        findings = audit_chunked_prefill(res)
+        mask_errors = [f for f in findings if f.severity == "error"]
+        assert len(mask_errors) == 1
+        assert "length-masked" in mask_errors[0].message
+        with pytest.raises(AnalysisError):
+            check_chunked_prefill(res)
+
+    def test_has_masked_select_on_raw_chunk_program(self, model):
+        """The detector sees the mask straight on the model's jaxpr (no
+        compile wrapper), and its absence on an unmasked softmax."""
+        cfg, params = model
+        cache = gpt.init_kv_cache(cfg, 1, cfg.seq)
+
+        def _pf(cache, tokens, start, lengths):
+            return gpt.gpt_prefill_chunk(params, cfg, cache, tokens,
+                                         start, lengths)
+
+        traced = jax.make_jaxpr(_pf)(
+            cache, jnp.zeros((1, CHUNK), jnp.int32),
+            jnp.zeros((1,), jnp.int32), jnp.ones((1,), jnp.int32))
+        assert _has_masked_select(traced.jaxpr)
+
+        def _plain(q, k):
+            return jax.nn.softmax(q @ k.T, axis=-1)
+
+        plain = jax.make_jaxpr(_plain)(
+            jnp.zeros((4, 8), jnp.float32), jnp.zeros((6, 8), jnp.float32))
+        assert not _has_masked_select(plain.jaxpr)
+
+
+class TestPrefixCacheAudit:
+    def _trie(self):
+        trie = PrefixCache(CHUNK, 1 << 20)
+        kv = {"k": np.zeros((1, 2, CHUNK, 8), np.float32),
+              "v": np.zeros((1, 2, CHUNK, 8), np.float32)}
+        trie.commit([], [1, 2, 3, 4], kv)
+        return trie
+
+    def test_clean_trie_zero_findings(self):
+        trie = self._trie()
+        assert audit_prefix_cache(trie) == []
+        assert check_prefix_cache(trie) == []
+
+    def test_corrupted_trie_fires_errors(self):
+        trie = self._trie()
+        trie.bytes_used += 13                       # byte drift
+        node = trie.lookup_node([], [1, 2, 3, 4])
+        trie.unpin([node])                          # negative refcount
+        findings = audit_prefix_cache(trie)
+        assert len(findings) == 2
+        assert all(f.rule_id == "SERVE002" and f.severity == "error"
+                   for f in findings)
+        with pytest.raises(AnalysisError):
+            check_prefix_cache(trie)

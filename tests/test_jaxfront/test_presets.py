@@ -152,6 +152,16 @@ def test_scatter_add_rule():
     assert (("reduce", None, "sum"),) in kinds  # batch shard -> partial
 
 
+_HAS_SPLIT_PRIM = "split" in [
+    e.primitive.name
+    for e in jax.make_jaxpr(lambda x: jnp.split(x, 2, axis=1))(
+        jnp.ones((4, 8))).eqns]
+
+
+@pytest.mark.xfail(not _HAS_SPLIT_PRIM, raises=StopIteration, strict=True,
+                   reason="this jax lowers jnp.split to slice eqns and has "
+                          "no lax.split; the split primitive (and rule) is "
+                          "only traceable on jax >= 0.4.38")
 def test_split_rule():
     eqn = get_eqn(lambda x: jnp.split(x, 2, axis=1)[0], jnp.ones((4, 8)),
                   prim="split")

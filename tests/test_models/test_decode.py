@@ -159,3 +159,136 @@ class TestLlamaDecode:
             np.testing.assert_allclose(np.asarray(at),
                                        np.asarray(full[:, :, t]),
                                        atol=1e-5)
+
+
+def _chunked_greedy(prefill_chunk, decode, init_cache, prompt_rows, n_new,
+                    max_len, chunk):
+    """Chunked prefill (fixed [b, chunk] windows from position 0) then
+    cached decode — the serving scheduler's model-level recipe."""
+    b = len(prompt_rows)
+    lengths = jnp.asarray([len(r) for r in prompt_rows], jnp.int32)
+    cache = init_cache(b, max_len)
+    n_chunks = (max(len(r) for r in prompt_rows) + chunk - 1) // chunk
+    logits = None
+    final = np.zeros((b,), np.int64)
+    for j in range(n_chunks):
+        toks = np.zeros((b, chunk), np.int32)
+        for i, r in enumerate(prompt_rows):
+            seg = r[j * chunk:(j + 1) * chunk]
+            toks[i, :len(seg)] = seg
+        start = jnp.full((b,), j * chunk, jnp.int32)
+        cache, logits = prefill_chunk(cache, jnp.asarray(toks), start,
+                                      lengths)
+        for i, r in enumerate(prompt_rows):
+            if j * chunk <= len(r) - 1 < (j + 1) * chunk:
+                final[i] = int(jnp.argmax(logits[i]))
+    tok = jnp.asarray(final, jnp.int32)
+    pos = lengths
+    ids = [np.asarray(tok)]
+    for _ in range(n_new - 1):
+        cache, logits = decode(cache, tok, pos)
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        pos = pos + 1
+        ids.append(np.asarray(tok))
+    return np.stack(ids, 1).tolist()
+
+
+class TestGPTChunkedPrefill:
+    @pytest.mark.parametrize("scan", [False, True])
+    def test_chunked_greedy_parity_vs_full_forward(self, scan):
+        cfg = gpt.GPTConfig.tiny(scan_layers=scan)
+        params = gpt.gpt_init(cfg, jax.random.PRNGKey(0))
+        prompts = [[3, 14, 15, 9, 2, 6, 26, 5, 3, 1], [11, 5, 7]]
+        ref = _uncached_greedy(
+            lambda t: gpt.gpt_apply(params, cfg, t), prompts, 6)
+        got = _chunked_greedy(
+            lambda c, t, s, l: gpt.gpt_prefill_chunk(params, cfg, c, t,
+                                                     s, l),
+            lambda c, t, p: gpt.gpt_decode_step(params, cfg, c, t, p),
+            lambda b, L: gpt.init_kv_cache(cfg, b, L),
+            prompts, 6, cfg.seq, chunk=4)
+        assert got == ref
+
+    def test_chunk_cache_matches_one_shot_prefill(self):
+        cfg = gpt.GPTConfig.tiny()
+        params = gpt.gpt_init(cfg, jax.random.PRNGKey(1))
+        prompt = [5, 9, 2, 7, 1, 3, 8, 6, 4, 2, 9]
+        plen = len(prompt)
+        ref_cache, _ = gpt.gpt_prefill(
+            params, cfg, gpt.init_kv_cache(cfg, 1, cfg.seq),
+            jnp.asarray([prompt], jnp.int32),
+            jnp.asarray([plen], jnp.int32))
+        cache = gpt.init_kv_cache(cfg, 1, cfg.seq)
+        C = 4
+        for j in range((plen + C - 1) // C):
+            toks = np.zeros((1, C), np.int32)
+            seg = prompt[j * C:(j + 1) * C]
+            toks[0, :len(seg)] = seg
+            cache, _ = gpt.gpt_prefill_chunk(
+                params, cfg, cache, jnp.asarray(toks),
+                jnp.asarray([j * C], jnp.int32),
+                jnp.asarray([plen], jnp.int32))
+        np.testing.assert_allclose(
+            np.asarray(cache["k"])[:, :, :, :plen],
+            np.asarray(ref_cache["k"])[:, :, :, :plen], atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(cache["v"])[:, :, :, :plen],
+            np.asarray(ref_cache["v"])[:, :, :, :plen], atol=1e-5)
+
+    def test_restored_prefix_is_bitwise_identical(self):
+        # the prefix-cache contract: recomputing a chunk on a staging row
+        # whose earlier chunks were COPIED in (not recomputed) yields
+        # bitwise-identical logits and cache rows
+        cfg = gpt.GPTConfig.tiny()
+        params = gpt.gpt_init(cfg, jax.random.PRNGKey(2))
+        prompt = list(range(1, 13))
+        plen, C = len(prompt), 4
+        pf = jax.jit(lambda c, t, s, l: gpt.gpt_prefill_chunk(
+            params, cfg, c, t, s, l))
+        cache = gpt.init_kv_cache(cfg, 1, cfg.seq)
+        logits = None
+        for j in range(plen // C):
+            toks = jnp.asarray([prompt[j * C:(j + 1) * C]], jnp.int32)
+            cache, logits = pf(cache, toks, jnp.asarray([j * C], jnp.int32),
+                               jnp.asarray([plen], jnp.int32))
+        restored = gpt.init_kv_cache(cfg, 1, cfg.seq)
+        rest = plen - C
+        restored = {k: restored[k].at[:, :, :, :rest].set(
+            np.asarray(cache[k])[:, :, :, :rest]) for k in ("k", "v")}
+        restored, logits2 = pf(
+            restored, jnp.asarray([prompt[rest:]], jnp.int32),
+            jnp.asarray([rest], jnp.int32), jnp.asarray([plen], jnp.int32))
+        assert bool((np.asarray(logits2) == np.asarray(logits)).all())
+        assert bool((np.asarray(restored["k"])[:, :, :, :plen]
+                     == np.asarray(cache["k"])[:, :, :, :plen]).all())
+
+
+class TestLlamaChunkedPrefill:
+    def test_chunked_greedy_parity_vs_full_forward(self):
+        cfg = llama.LlamaConfig.tiny()  # GQA + RoPE at absolute positions
+        params = llama.llama_init(cfg, jax.random.PRNGKey(0))
+        prompts = [[3, 14, 15, 9, 2, 6, 26, 5, 3, 1], [11, 5, 7]]
+        ref = _uncached_greedy(
+            lambda t: llama.llama_apply(params, cfg, t), prompts, 6)
+        got = _chunked_greedy(
+            lambda c, t, s, l: llama.llama_prefill_chunk(params, cfg, c,
+                                                         t, s, l),
+            lambda c, t, p: llama.llama_decode_step(params, cfg, c, t, p),
+            lambda b, L: llama.init_kv_cache(cfg, b, L),
+            prompts, 6, cfg.seq, chunk=4)
+        assert got == ref
+
+    def test_rope_abs_matches_rope(self):
+        # the chunk rotation at absolute positions must equal the batch
+        # rotation's columns
+        x = jax.random.normal(jax.random.PRNGKey(3), (2, 4, 8, 16),
+                              jnp.float32)
+        full = llama._rope(x, 10000.0)
+        pos = jnp.asarray([[2, 5, 7], [0, 3, 6]], jnp.int32)
+        chunk = jnp.stack([x[0, :, [2, 5, 7]].transpose(1, 0, 2),
+                           x[1, :, [0, 3, 6]].transpose(1, 0, 2)])
+        got = llama._rope_abs(chunk, pos, 10000.0)
+        want = jnp.stack([full[0, :, [2, 5, 7]].transpose(1, 0, 2),
+                          full[1, :, [0, 3, 6]].transpose(1, 0, 2)])
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5)

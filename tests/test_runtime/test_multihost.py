@@ -37,11 +37,16 @@ from easydist_tpu.jaxfront import easydist_compile, make_device_mesh
 mesh = make_device_mesh((2, 2), ("dcn", "ici"), dcn_axes=("dcn",))
 
 # 1. raw collective crossing the process (DCN) boundary
-from jax import shard_map
+try:                                       # jax >= 0.6 top-level export
+    from jax import shard_map
+    _sm_kw = {"check_vma": False}
+except ImportError:                        # jax 0.4/0.5: experimental home,
+    from jax.experimental.shard_map import shard_map  # check_rep spelling
+    _sm_kw = {"check_rep": False}
 ones = jnp.ones((4, 8))
 total = jax.jit(shard_map(
     lambda x: jax.lax.psum(x, ("dcn", "ici")), mesh=mesh,
-    in_specs=P(("dcn", "ici")), out_specs=P(), check_vma=False))(ones)
+    in_specs=P(("dcn", "ici")), out_specs=P(), **_sm_kw))(ones)
 np.testing.assert_allclose(np.asarray(total[0, 0]), 4.0)
 
 # 2. easydist auto-parallel solve + run over the hybrid mesh; the solver
@@ -86,6 +91,13 @@ def test_two_process_dcn_smoke(tmp_path):
             for q in procs:
                 q.kill()
             raise
+        if "Multiprocess computations aren't implemented" in (err or ""):
+            # this jaxlib's CPU client has no cross-process collective
+            # support (gloo-backed CPU collectives land in newer jaxlib);
+            # the control plane (coordinator handshake, global device
+            # enumeration) already passed by the time XLA rejects the psum
+            pytest.xfail("jaxlib CPU backend lacks multiprocess "
+                         "collectives (needs newer jaxlib with gloo)")
         assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
         outs.append(out.strip().splitlines()[-1])
 

@@ -232,3 +232,148 @@ class TestMetrics:
         db = sess.metrics.export(sub_key="generation_test", persist=False)
         hist = db.get_op_perf("serving", "generation_test")
         assert hist and "per_token" in hist[-1]["latency"]
+
+
+def _chunked_config(cfg, **kw):
+    kw.setdefault("decode_buckets", (cfg.seq,))
+    kw.setdefault("max_decode_slots", 2)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("prefill_batch", 2)
+    return ServeConfig(**kw)
+
+
+class TestPrefixReuse:
+    def test_prefix_on_off_bitwise_identical(self, model):
+        """The prefix cache is a pure latency optimization: cache-on and
+        cache-off sessions emit identical greedy ids, and both match the
+        full uncached re-forward."""
+        cfg, params = model
+        rng = np.random.RandomState(7)
+        shared = rng.randint(0, cfg.vocab, size=16).tolist()
+        prompts = [shared + [i + 1] for i in range(4)]
+
+        outs = {}
+        for on in (True, False):
+            sess = _session(cfg, params, config=_chunked_config(
+                cfg, enable_prefix_cache=on))
+            # first prompt alone so its chunks are committed before the
+            # others look them up
+            f0 = sess.submit(prompts[0], max_new_tokens=4)
+            sess.run_until_drained()
+            futs = [sess.submit(p, max_new_tokens=4) for p in prompts[1:]]
+            sess.run_until_drained()
+            outs[on] = [f0.result(timeout=5)["ids"]] + [
+                f.result(timeout=5)["ids"] for f in futs]
+            if on:
+                st = sess.stats()["buckets"][cfg.seq]["prefix_cache"]
+                assert st["hits"] >= 6        # 3 followers x 2 chunks
+                assert st["nodes"] >= 2
+            else:
+                assert sess.stats()["buckets"][cfg.seq][
+                    "prefix_cache"] is None
+        assert outs[True] == outs[False]
+        for p, ids in zip(prompts, outs[True]):
+            assert ids == _uncached_greedy(params, cfg, p, 4)
+
+    def test_hit_rate_and_padding_metrics(self, model):
+        cfg, params = model
+        sess = _session(cfg, params, config=_chunked_config(cfg))
+        shared = list(range(1, 17))
+        sess.submit(shared + [20], max_new_tokens=2)
+        sess.run_until_drained()
+        assert sess.metrics.prefix_cache_hit_rate() == 0.0
+        sess.submit(shared + [21], max_new_tokens=2)
+        sess.run_until_drained()
+        # follower reused 16 of (17+17-1) admitted prefill tokens
+        assert sess.metrics.prefix_cache_hit_rate() == \
+            pytest.approx(16 / 34)
+        # padded slots (rows x chunk per call) never undershoot real work
+        assert sess.metrics.prefill_padding_ratio() >= 1.0
+        snap = sess.metrics.snapshot()
+        assert snap["prefix_cache_hit_rate"] == pytest.approx(16 / 34)
+        assert snap["prefill_padding_ratio"] >= 1.0
+        assert snap["latency"]["ttft"]["count"] == 2
+
+    def test_chunked_prefill_single_signature(self, model):
+        """Prompt lengths 2..17 all run through ONE compiled chunk
+        program (fixed [rows, chunk] window) — no per-length retraces."""
+        cfg, params = model
+        sess = _session(cfg, params, config=_chunked_config(cfg))
+        for n in (2, 3, 7, 9, 17):
+            sess.submit(list(range(1, n + 1)), max_new_tokens=2)
+        sess.run_until_drained()
+        sig = sess.stats()["prefill_signatures"]
+        assert sig["size"] == 1 and sig["hits"] >= 4
+
+    def test_ttft_recorded_per_request(self, model):
+        cfg, params = model
+        sess = _session(cfg, params, config=_chunked_config(cfg))
+        for _ in range(3):
+            sess.submit([4, 8, 2], max_new_tokens=2)
+        sess.run_until_drained()
+        assert sess.metrics.snapshot()["latency"]["ttft"]["count"] == 3
+
+
+class TestSlotReuseDeterminism:
+    def test_readmit_into_freed_slots_is_bitwise_deterministic(self, model):
+        """Retire one slot via EOS and one by filling its bucket, re-admit
+        a queued prompt into the freed slot mid-flight, and require its
+        ids to be bitwise identical to a fresh session's."""
+        cfg, params = model
+        rng = np.random.RandomState(3)
+        p_eos = rng.randint(0, cfg.vocab, size=5).tolist()
+        ref_eos = _uncached_greedy(params, cfg, p_eos, 8)
+        eos = ref_eos[2]                      # retire after 3 tokens
+        p_full = rng.randint(0, cfg.vocab, size=9).tolist()
+        full_new = cfg.seq - len(p_full)      # runs into the bucket wall
+        p_next = rng.randint(0, cfg.vocab, size=11).tolist()
+
+        sess = _session(cfg, params, config=_chunked_config(cfg))
+        f_eos = sess.submit(p_eos, max_new_tokens=8, eos_id=eos)
+        f_full = sess.submit(p_full, max_new_tokens=full_new)
+        f_next = sess.submit(p_next, max_new_tokens=5)  # queued: slots busy
+        # step until the EOS retirement frees a slot and p_next is
+        # admitted while p_full is still decoding (mid-flight re-admit)
+        for _ in range(200):
+            sess.step()
+            pool = sess._pools[cfg.seq]
+            if not sess._pending and f_eos.done():
+                break
+        assert f_eos.done() and not f_full.done()
+        sess.run_until_drained()
+        assert f_eos.result(timeout=5)["finish_reason"] == "eos"
+        assert f_eos.result(timeout=5)["ids"] == ref_eos[:3]
+        assert f_full.result(timeout=5)["ids"] == \
+            _uncached_greedy(params, cfg, p_full, full_new)
+
+        fresh = _session(cfg, params, config=_chunked_config(cfg))
+        f_ref = fresh.submit(p_next, max_new_tokens=5)
+        fresh.run_until_drained()
+        assert f_next.result(timeout=5)["ids"] == \
+            f_ref.result(timeout=5)["ids"]
+        assert f_next.result(timeout=5)["ids"] == \
+            _uncached_greedy(params, cfg, p_next, 5)
+
+
+class TestInterleaveBound:
+    def test_prefill_pressure_bounded_per_step(self, model):
+        """With prefill_chunks_per_step=1 a 3-chunk prompt cannot finish
+        prefill in one step, and the live request still decodes every
+        step (decode p99 stays bounded during long prefills)."""
+        cfg, params = model
+        sess = _session(cfg, params, config=_chunked_config(
+            cfg, prefill_chunks_per_step=1))
+        f_live = sess.submit([5, 9, 2], max_new_tokens=20)
+        sess.step()                           # admit + prefill + 1 decode
+        pool = sess._pools[cfg.seq]
+        assert pool.n_active == 1
+        sess.submit(list(range(1, 18)), max_new_tokens=2)  # 3 chunks
+        live_before = len(sess._pools[cfg.seq].slots)
+        tokens = sess.step()
+        assert len(pool.jobs) == 1            # prefill NOT finished
+        assert tokens >= 1                    # the live slot still decoded
+        sess.step()
+        assert len(pool.jobs) == 1            # chunk 2 of 3 ran
+        sess.run_until_drained()
+        assert f_live.result(timeout=5)["ids"] == \
+            _uncached_greedy(params, cfg, [5, 9, 2], 20)

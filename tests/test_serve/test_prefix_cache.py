@@ -1,0 +1,170 @@
+"""PrefixCache: the reference-counted token trie over committed KV
+chunks — match/commit round trips, LRU eviction under the byte budget,
+pin-blocked eviction, hit/miss counters, and invariant auditing."""
+
+import numpy as np
+import pytest
+
+from easydist_tpu.serve import PrefixCache, chunk_key
+
+CHUNK = 4
+
+
+def _kv(fill=0.0):
+    """One committed chunk's KV payload: 2 * 1*2*4*8 f32 = 256 bytes."""
+    return {"k": np.full((1, 2, CHUNK, 8), fill, np.float32),
+            "v": np.full((1, 2, CHUNK, 8), fill, np.float32)}
+
+
+_KV_BYTES = 2 * 1 * 2 * CHUNK * 8 * 4
+
+
+def _commit_path(trie, prompt, n_chunks):
+    nodes = []
+    for j in range(n_chunks):
+        node = trie.commit(nodes, prompt[j * CHUNK:(j + 1) * CHUNK],
+                           _kv(float(j)))
+        assert node is not None
+        nodes.append(node)
+    return nodes
+
+
+class TestMatchCommit:
+    def test_empty_trie_misses(self):
+        trie = PrefixCache(CHUNK, 1 << 20)
+        plen, nodes = trie.match([1, 2, 3, 4, 5, 6, 7, 8])
+        assert plen == 0 and nodes == []
+        assert trie.misses == 2 and trie.hits == 0
+
+    def test_commit_then_match_whole_chunks(self):
+        trie = PrefixCache(CHUNK, 1 << 20)
+        prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+        nodes = _commit_path(trie, prompt, 2)
+        plen, got = trie.match(prompt)
+        assert plen == 8 and got == nodes
+        # restored KV is the exact committed object (bitwise contract)
+        assert got[0].kv["k"][0, 0, 0, 0] == 0.0
+        assert got[1].kv["k"][0, 0, 0, 0] == 1.0
+
+    def test_max_tokens_caps_prefix(self):
+        # the scheduler caps at len(prompt)-1 so the finishing chunk
+        # always runs through prefill and produces logits
+        trie = PrefixCache(CHUNK, 1 << 20)
+        prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+        _commit_path(trie, prompt, 2)
+        plen, nodes = trie.match(prompt, max_tokens=len(prompt) - 1)
+        assert plen == 4 and len(nodes) == 1
+
+    def test_divergent_prompt_shares_only_common_prefix(self):
+        trie = PrefixCache(CHUNK, 1 << 20)
+        _commit_path(trie, [1, 2, 3, 4, 5, 6, 7, 8], 2)
+        plen, nodes = trie.match([1, 2, 3, 4, 9, 9, 9, 9])
+        assert plen == 4 and len(nodes) == 1
+
+    def test_partial_chunk_never_commits_or_matches(self):
+        trie = PrefixCache(CHUNK, 1 << 20)
+        assert trie.commit([], [1, 2, 3], _kv()) is None
+        _commit_path(trie, [1, 2, 3, 4], 1)
+        plen, _ = trie.match([1, 2, 3, 4, 5, 6])  # 6 tokens = 1 chunk max
+        assert plen == 4
+
+    def test_commit_existing_returns_same_node(self):
+        trie = PrefixCache(CHUNK, 1 << 20)
+        n1 = trie.commit([], [1, 2, 3, 4], _kv(1.0))
+        n2 = trie.commit([], [1, 2, 3, 4], _kv(2.0))
+        assert n2 is n1 and trie.n_nodes == 1
+        assert n1.kv["k"][0, 0, 0, 0] == 1.0  # first commit wins
+
+    def test_lookup_node(self):
+        trie = PrefixCache(CHUNK, 1 << 20)
+        nodes = _commit_path(trie, [1, 2, 3, 4, 5, 6, 7, 8], 2)
+        assert trie.lookup_node([], [1, 2, 3, 4]) is nodes[0]
+        assert trie.lookup_node(nodes[:1], [5, 6, 7, 8]) is nodes[1]
+        assert trie.lookup_node(nodes[:1], [9, 9, 9, 9]) is None
+
+    def test_zero_budget_disables_commit(self):
+        trie = PrefixCache(CHUNK, 0)
+        assert trie.commit([], [1, 2, 3, 4], _kv()) is None
+        assert trie.n_nodes == 0 and trie.bytes_used == 0
+
+
+class TestEviction:
+    def test_lru_eviction_under_budget(self):
+        trie = PrefixCache(CHUNK, 2 * _KV_BYTES)
+        a = trie.commit([], [1, 1, 1, 1], _kv())
+        b = trie.commit([], [2, 2, 2, 2], _kv())
+        assert a is not None and b is not None
+        trie.match([1, 1, 1, 1])  # bump a's LRU tick; b is now oldest
+        c = trie.commit([], [3, 3, 3, 3], _kv())
+        assert c is not None and trie.evictions == 1
+        assert trie.lookup_node([], [2, 2, 2, 2]) is None  # b evicted
+        assert trie.lookup_node([], [1, 1, 1, 1]) is a
+        assert trie.bytes_used == 2 * _KV_BYTES
+
+    def test_eviction_is_leaf_first(self):
+        # a parent with a live child is never evicted before the child
+        trie = PrefixCache(CHUNK, 2 * _KV_BYTES)
+        _commit_path(trie, [1, 2, 3, 4, 5, 6, 7, 8], 2)
+        got = trie.commit([], [9, 9, 9, 9], _kv())
+        assert got is not None
+        # the leaf (depth 1) went first; the root chunk survives
+        assert trie.lookup_node([], [1, 2, 3, 4]) is not None
+
+    def test_pin_blocks_eviction(self):
+        trie = PrefixCache(CHUNK, _KV_BYTES)
+        a = trie.commit([], [1, 1, 1, 1], _kv())
+        trie.pin([a])
+        assert trie.commit([], [2, 2, 2, 2], _kv()) is None  # nothing evictable
+        assert trie.n_nodes == 1 and trie.evictions == 0
+        trie.unpin([a])
+        b = trie.commit([], [2, 2, 2, 2], _kv())
+        assert b is not None and trie.evictions == 1
+
+    def test_oversized_chunk_rejected(self):
+        trie = PrefixCache(CHUNK, _KV_BYTES - 1)
+        assert trie.commit([], [1, 1, 1, 1], _kv()) is None
+        assert trie.evictions == 0
+
+
+class TestCountersAndInvariants:
+    def test_hit_miss_counters_and_rate(self):
+        trie = PrefixCache(CHUNK, 1 << 20)
+        _commit_path(trie, [1, 2, 3, 4, 5, 6, 7, 8], 2)
+        trie.match([1, 2, 3, 4, 5, 6, 7, 8])          # 2 hits
+        trie.match([1, 2, 3, 4, 9, 9, 9, 9])          # 1 hit, 1 miss
+        s = trie.stats()
+        assert s["hits"] == 2 + 1 and s["misses"] == 1
+        assert s["hit_rate"] == pytest.approx(3 / 4)
+        assert s["nodes"] == 2
+        assert s["bytes_used"] == 2 * _KV_BYTES
+
+    def test_invariants_clean(self):
+        trie = PrefixCache(CHUNK, 1 << 20)
+        nodes = _commit_path(trie, [1, 2, 3, 4, 5, 6, 7, 8], 2)
+        trie.pin(nodes)
+        trie.unpin(nodes)
+        assert trie.check_invariants() == []
+
+    def test_invariants_detect_negative_refcount(self):
+        trie = PrefixCache(CHUNK, 1 << 20)
+        nodes = _commit_path(trie, [1, 2, 3, 4], 1)
+        trie.unpin(nodes)  # unbalanced
+        problems = trie.check_invariants()
+        assert any("negative refcount" in p for p in problems)
+
+    def test_invariants_detect_byte_drift(self):
+        trie = PrefixCache(CHUNK, 1 << 20)
+        _commit_path(trie, [1, 2, 3, 4], 1)
+        trie.bytes_used += 17
+        problems = trie.check_invariants()
+        assert any("byte accounting drift" in p for p in problems)
+
+    def test_constructor_validation(self):
+        with pytest.raises(ValueError):
+            PrefixCache(0, 1024)
+        with pytest.raises(ValueError):
+            PrefixCache(4, -1)
+
+    def test_chunk_key_is_exact_token_identity(self):
+        assert chunk_key([1, 2, 3]) == (1, 2, 3)
+        assert chunk_key(np.asarray([1, 2, 3])) == (1, 2, 3)
