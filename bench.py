@@ -1447,6 +1447,169 @@ def prefill_main():
     print(json.dumps(result), flush=True)
 
 
+def fleet_main():
+    """Multi-replica fleet scenario (`--fleet`): shared-prefix traffic
+    through a 2-decode-replica `FleetRouter` under the affinity policy vs
+    the uniform-random arm, plus a disaggregated-prefill + graceful-drain
+    pass under live load.
+
+    Prints ONE JSON line gated on: bitwise greedy parity (every fleet
+    arm's ids == the single-session run, including the arm that drains a
+    replica mid-stream), affinity routing beating random on the aggregate
+    prefix-trie hit rate (co-locating shared prefixes is the point of the
+    scored policy), and zero dropped requests across the drain.  Forced
+    to CPU — the gate is routing/lifecycle economics, not device peak."""
+    result = {"metric": "fleet_affinity_hit_rate", "value": 0.0,
+              "unit": "fraction"}
+    try:
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+
+        from easydist_tpu.fleet import (FleetConfig, FleetRouter,
+                                        InProcessTransport)
+        from easydist_tpu.models.gpt import GPTConfig, gpt_init
+        from easydist_tpu.serve import GenerationSession, ServeConfig
+        from easydist_tpu.serve.metrics import LatencyHistogram
+
+        seq, chunk, n_req, max_new = 256, 32, 16, 6
+        cfg = GPTConfig(vocab=256, seq=seq, dim=64, heads=4, layers=2,
+                        dtype="float32")
+        params = gpt_init(cfg, jax.random.PRNGKey(0))
+        rng = np.random.RandomState(0)
+        # two prefix families (two "system prompts"): affinity should
+        # pin each family to one replica; random scatters both
+        prefixes = [rng.randint(0, cfg.vocab, size=96).tolist()
+                    for _ in range(2)]
+        prompts = [prefixes[i % 2]
+                   + rng.randint(0, cfg.vocab, size=4 + i % 5).tolist()
+                   for i in range(n_req)]
+
+        def mk(rid):
+            sc = ServeConfig(decode_buckets=(seq,), max_decode_slots=4,
+                             prefill_chunk=chunk, prefill_batch=4)
+            return GenerationSession.for_gpt(params, cfg, config=sc,
+                                             replica_id=rid)
+
+        # single-session reference: the bitwise target for every arm
+        ref = mk("ref")
+        ref_futs = [ref.submit(p, max_new_tokens=max_new)
+                    for p in prompts]
+        ref.run_until_drained()
+        want = [f.result(timeout=5)["ids"] for f in ref_futs]
+
+        def merged_ttft(router):
+            m = LatencyHistogram()
+            for rep in router.stats()["replicas"]:
+                h = router.replica(rep).session.metrics.ttft
+                for i, c in enumerate(h.counts):
+                    m.counts[i] += c
+                m.total += h.total
+                m.sum += h.sum
+            return m
+
+        def run_arm(policy):
+            router = FleetRouter(
+                [mk(f"{policy[0]}0"), mk(f"{policy[0]}1")],
+                config=FleetConfig(policy=policy, seed=0))
+            # two waves: wave 1 warms the tries (cold-hash placement),
+            # wave 2 routes against warm tries — the affinity signal
+            t0 = time.perf_counter()
+            futs = [router.submit(p, max_new_tokens=max_new)
+                    for p in prompts[:n_req // 2]]
+            router.run_until_drained()
+            futs += [router.submit(p, max_new_tokens=max_new)
+                     for p in prompts[n_req // 2:]]
+            router.run_until_drained()
+            wall = time.perf_counter() - t0
+            ids = [f.result(timeout=5)["ids"] for f in futs]
+            reused = total = 0
+            for rep in router.stats()["replicas"]:
+                c = router.replica(rep).session.metrics.snapshot()[
+                    "counters"]
+                reused += c.get("prefix_tokens_reused", 0)
+                total += c.get("prefix_tokens_total", 0)
+            ttft = merged_ttft(router)
+            return {"ids": ids, "wall": wall,
+                    "hit_rate": reused / total if total else 0.0,
+                    "warm_routes": router.metrics.counter("routed_warm"),
+                    "ttft_p50_ms": (ttft.percentile(50) or 0) * 1e3,
+                    "ttft_p99_ms": (ttft.percentile(99) or 0) * 1e3,
+                    "tokens": router.metrics.counter(
+                        "requests_completed") * max_new}
+
+        aff = run_arm("affinity")
+        rnd = run_arm("random")
+        log(f"# fleet bench: hit rate affinity {aff['hit_rate']:.2f} vs "
+            f"random {rnd['hit_rate']:.2f}; ttft p50 "
+            f"{aff['ttft_p50_ms']:.0f}ms p99 {aff['ttft_p99_ms']:.0f}ms")
+
+        # disaggregated prefill + graceful drain under live load
+        tp = InProcessTransport()
+        router = FleetRouter([mk("d0"), mk("d1")],
+                             prefill_replicas=[mk("p0")], transport=tp)
+        futs = [router.submit(p, max_new_tokens=max_new)
+                for p in prompts[:n_req // 2]]
+        router.run_until_drained()
+        futs += [router.submit(p, max_new_tokens=max_new)
+                 for p in prompts[n_req // 2:]]
+        for _ in range(2):
+            router.step()
+        # drain the replica holding the warmer trie — the hard case:
+        # its pages must migrate and its live decodes must retire
+        victim = max(("d0", "d1"), key=lambda r: router.replica(
+            r).session.metrics.counter("prefix_tokens_total"))
+        router.drain(victim, mode="graceful")
+        router.run_until_drained()
+        drain_out = [f.result(timeout=5) for f in futs]
+        drain_ids = [o["ids"] for o in drain_out]
+        dropped = sum(o["finish_reason"] not in ("length", "eos")
+                      for o in drain_out)
+        drain_zero_drop = dropped == 0 and \
+            victim not in router.stats()["replicas"]
+        handoffs = router.metrics.counter("prefill_handoffs")
+        migrated = router.metrics.counter("pages_migrated")
+
+        parity = aff["ids"] == want and rnd["ids"] == want \
+            and drain_ids == want
+        beats_random = aff["hit_rate"] > rnd["hit_rate"]
+        log(f"# fleet bench: parity={parity}, drain dropped={dropped}, "
+            f"handoffs={handoffs}, pages migrated={migrated}")
+
+        tput = aff["tokens"] / aff["wall"] if aff["wall"] else 0.0
+        result.update(
+            value=round(aff["hit_rate"], 4),
+            random_hit_rate=round(rnd["hit_rate"], 4),
+            affinity_beats_random=bool(beats_random),
+            parity_greedy=bool(parity),
+            drain_zero_drop=bool(drain_zero_drop),
+            drain_dropped_requests=int(dropped),
+            prefill_handoffs=int(handoffs),
+            pages_handed_off=int(router.metrics.counter(
+                "pages_handed_off")),
+            pages_migrated_on_drain=int(migrated),
+            warm_routes=int(aff["warm_routes"]),
+            tokens_per_sec=round(tput, 2),
+            ttft_p50_ms=round(aff["ttft_p50_ms"], 2),
+            ttft_p99_ms=round(aff["ttft_p99_ms"], 2),
+            device=jax.devices()[0].device_kind,
+            n_replicas=2, n_prefill_replicas=1,
+            seq=seq, prefill_chunk=chunk, n_requests=n_req,
+            verdict="ok" if (parity and beats_random and drain_zero_drop)
+            else "regression")
+        router.export_metrics(persist=True)
+    except Exception as e:  # always land the JSON line
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        result["error"] = f"{type(e).__name__}: {e}"
+        result["verdict"] = "error"
+    _annotate_vs_last_good(result)
+    _maybe_update_last_good(result)
+    print(json.dumps(result), flush=True)
+
+
 if __name__ == "__main__":
     if "--serve" in sys.argv:
         serve_main()
@@ -1462,6 +1625,8 @@ if __name__ == "__main__":
         decode_main()
     elif "--prefill" in sys.argv:
         prefill_main()
+    elif "--fleet" in sys.argv:
+        fleet_main()
     elif "--child" in sys.argv:
         child_main()
     else:

@@ -29,7 +29,13 @@ A rule-based analyzer that runs after solving and before execution
            SERVE002 chunked-prefill contract lint (staging donation,
            length-masked attention over the full bucket window so stale
            cache rows cannot leak into live logits, prefix-trie
-           refcount/byte-accounting integrity).
+           refcount/byte-accounting integrity);
+  layer 6  fleet auditor (`audit_routing`, `audit_page_handoff`,
+           `audit_drained_session`) — multi-replica serving hygiene:
+           FLEET001 routing into a tripped-breaker/draining replica,
+           FLEET002 KV page handoffs whose payload disagrees with the
+           sha256 manifest, FLEET003 orphaned pinned trie pages left
+           behind by a drain.
 
 Surfaced via `CompiledFunction.analyze()`, `bench.py --analyze`, and the
 dryrun gate; findings export through the runtime PerfDB under
@@ -44,6 +50,8 @@ import logging
 
 from .findings import (RULES, SEV_INFO, AnalysisError, AnalysisReport,
                        Finding, make_finding)
+from .fleet_rules import (audit_drained_session, audit_page_handoff,
+                          audit_routing)
 from .jaxpr_rules import lint_bucket_plan, lint_fn, lint_jaxpr
 from .memory_rules import (audit_remat_plan, check_hbm_budget,
                            recompute_liveness, remat_advisory,
@@ -74,6 +82,8 @@ __all__ = [
     "audit_decode_donation", "check_decode_donation",
     "audit_chunked_prefill", "audit_prefix_cache",
     "check_chunked_prefill", "check_prefix_cache",
+    "audit_routing", "audit_page_handoff", "audit_drained_session",
+    "check_fleet_routing", "check_page_handoff", "check_fleet_drain",
 ]
 
 
@@ -174,6 +184,47 @@ def check_prefix_cache(trie, node: str = "prefix_cache"):
     report = AnalysisReport(findings)
     if report.errors() and edconfig.analyze_raise:
         report.raise_on_errors()
+    for f in findings:
+        logger.warning("[analyze] %s", f)
+    return findings
+
+
+def check_fleet_routing(decisions, node: str = "fleet"):
+    """Audit hook for a fleet router's decision log: FLEET001 (routed to
+    a tripped-breaker or draining replica) raises under `analyze_raise`.
+    Returns the findings."""
+    from easydist_tpu import config as edconfig
+
+    findings = audit_routing(decisions, node=node)
+    report = AnalysisReport(findings)
+    if report.errors() and edconfig.analyze_raise:
+        report.raise_on_errors()
+    for f in findings:
+        logger.warning("[analyze] %s", f)
+    return findings
+
+
+def check_page_handoff(manifest, path, node: str = "handoff"):
+    """Transfer-time self-check hook for `fleet.transport`: FLEET002
+    (payload disagrees with the sha256 manifest) raises under
+    `analyze_raise` — committing a corrupt page poisons every request
+    sharing the prefix.  Returns the findings."""
+    from easydist_tpu import config as edconfig
+
+    findings = audit_page_handoff(manifest, path, node=node)
+    report = AnalysisReport(findings)
+    if report.errors() and edconfig.analyze_raise:
+        report.raise_on_errors()
+    for f in findings:
+        logger.warning("[analyze] %s", f)
+    return findings
+
+
+def check_fleet_drain(session, node: str = "drain"):
+    """Drain-time self-check hook for the fleet router: FLEET003
+    (orphaned pinned pages / trie bookkeeping drift on a drained
+    session) — warning severity, logs and returns the findings."""
+    findings = audit_drained_session(session, node=node)
     for f in findings:
         logger.warning("[analyze] %s", f)
     return findings

@@ -124,6 +124,21 @@ RULES: Dict[str, tuple] = {
                  "(stale-row leakage — restored/garbage cache rows could "
                  "leak into live logits), or prefix-trie refcount/byte "
                  "accounting drift"),
+    # ---- layer 6: fleet auditor (multi-replica routing / KV handoff /
+    #      drain hygiene, analyze/fleet_rules.py)
+    "FLEET001": (SEV_ERROR,
+                 "request routed to an ineligible replica (circuit "
+                 "breaker OPEN or already draining) — load steered into "
+                 "a replica that is shedding or leaving"),
+    "FLEET002": (SEV_ERROR,
+                 "KV page handoff fails manifest verification (token "
+                 "ids, sha256, or byte count disagree) — a corrupt page "
+                 "committed to a live trie poisons every request "
+                 "sharing that prefix, bitwise-silently"),
+    "FLEET003": (SEV_WARNING,
+                 "drained replica's trie still holds pinned pages "
+                 "(pin/unpin imbalance): unevictable orphans keep device "
+                 "memory from releasing"),
 }
 
 

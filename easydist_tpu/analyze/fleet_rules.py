@@ -1,0 +1,97 @@
+"""Layer 6: fleet auditor — routing health, KV handoff integrity, drain
+hygiene (`easydist_tpu.fleet`).
+
+Three failure shapes a multi-replica serving fleet adds on top of the
+single-session audits:
+
+  FLEET001 (error)   a request routed to a replica whose circuit breaker
+                     was OPEN or that was already draining.  The router's
+                     eligibility filter exists precisely to prevent this;
+                     a decision that slipped through means load is being
+                     steered into a replica that is shedding or leaving —
+                     the request will burn a timeout or an admission error
+                     instead of being served.
+  FLEET002 (error)   a KV page handoff whose payload disagrees with its
+                     sha256 manifest (token ids, digest, or byte count).
+                     A corrupt page committed into a live trie poisons
+                     every future request sharing that prefix — bitwise-
+                     silently, because restore skips recompute.
+  FLEET003 (warning) a drained replica's trie still holds pinned pages.
+                     Drain retires every slot and every retirement unpins;
+                     leftover refcounts mean a pin/unpin imbalance — the
+                     pages can never be evicted and the drained session's
+                     device memory never fully releases.
+
+All three audit plain data surfaces (the router's decision log, a
+transfer manifest + payload, a drained session's tries), so goldens are
+cheap fixtures, not compiled programs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+from .findings import Finding, make_finding
+
+__all__ = ["audit_routing", "audit_page_handoff", "audit_drained_session"]
+
+
+def audit_routing(decisions: Sequence[Dict[str, object]],
+                  node: str = "fleet") -> List[Finding]:
+    """FLEET001 over a router decision log: every entry names the chosen
+    replica and the breaker/drain state OBSERVED at decision time."""
+    findings: List[Finding] = []
+    for d in decisions:
+        rid = d.get("replica_id")
+        where = f"{node}.request[{d.get('request_id')}]"
+        if d.get("breaker_state") == "open":
+            findings.append(make_finding(
+                "FLEET001", where,
+                f"routed to replica {rid!r} whose circuit breaker was "
+                f"OPEN — the eligibility filter must exclude tripped "
+                f"replicas"))
+        if d.get("draining"):
+            findings.append(make_finding(
+                "FLEET001", where,
+                f"routed to replica {rid!r} that was already draining — "
+                f"its session rejects the submit and the request "
+                f"bounces"))
+    return findings
+
+
+def audit_page_handoff(manifest: Dict[str, object], path,
+                       node: str = "handoff") -> List[Finding]:
+    """FLEET002 over one transfer: recompute every page digest against
+    the manifest (fleet.transport.verify_manifest does the hashing)."""
+    from easydist_tpu.fleet.transport import verify_manifest
+
+    return [make_finding("FLEET002", node, problem)
+            for problem in verify_manifest(manifest, path)]
+
+
+def audit_drained_session(session, node: str = "drain") -> List[Finding]:
+    """FLEET003 over a drained session's tries: with no live slots left,
+    every page must be unpinned (refcount 0) — pinned leftovers are
+    unevictable orphans.  Also folds in the trie's own bookkeeping audit
+    (`check_invariants`) since drain is the natural audit point."""
+    findings: List[Finding] = []
+    if not session.is_drained:
+        return [make_finding(
+            "FLEET003", node,
+            "drain audit ran on a session that still holds live work "
+            "(queued/prefilling/decoding) — audit after is_drained")]
+    for bucket, pool in getattr(session, "_pools", {}).items():
+        trie = getattr(pool, "trie", None)
+        if trie is None:
+            continue
+        where = f"{node}.bucket[{bucket}]"
+        for n in trie._walk():
+            if n.refcount > 0:
+                findings.append(make_finding(
+                    "FLEET003", where,
+                    f"orphaned pinned page at depth {n.depth} "
+                    f"(refcount {n.refcount} with zero live slots): "
+                    f"pin/unpin imbalance leaves it unevictable"))
+        for problem in trie.check_invariants():
+            findings.append(make_finding("FLEET003", where, problem))
+    return findings

@@ -1,0 +1,29 @@
+"""Fleet serving: N `GenerationSession` replicas behind one router.
+
+Scales serve/ horizontally without touching the bitwise spine:
+
+  * `FleetRouter` — admission-controlled submit routed by a scored policy
+    (prefix-cache affinity + slot occupancy, breaker-aware eligibility,
+    consistent-hash placement for cold prefixes), replica lifecycle
+    (zero-downtime drain with hot-page migration, immediate evacuate with
+    bitwise-seamless mid-stream resubmission);
+  * prefill/decode disaggregation — dedicated prefill replicas compute
+    page-aligned prompt prefixes and hand committed KV pages to decode
+    replicas through a `KVTransport`;
+  * `InProcessTransport` — reference transport: pages move by reference,
+    the sha256-per-page manifest (checkpoint MANIFEST.json idiom) still
+    round-trips and is verified before commit (FLEET002);
+  * `HashRing` — classic consistent hashing over sha256 virtual points,
+    so cold-prefix placement is sticky and a drain only remaps ~1/N of
+    the keyspace.
+
+Fleet outputs are bitwise-identical to a single session's: all replicas
+run the same params/programs, prefix restore equals recompute, and greedy
+continuation is a pure function of the token prefix.  docs/SERVING.md
+covers the design; FLEET001-003 in docs/ANALYZE.md are the audits.
+"""
+
+from .hashring import HashRing, prefix_hash_key  # noqa: F401
+from .router import FleetConfig, FleetRouter, Replica  # noqa: F401
+from .transport import (InProcessTransport, KVTransport,  # noqa: F401
+                        page_manifest, verify_manifest)

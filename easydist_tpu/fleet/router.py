@@ -1,0 +1,555 @@
+"""`FleetRouter`: N `GenerationSession` replicas behind one submit().
+
+Scales serve/ from one host to a fleet without touching the bitwise
+spine: every replica runs the same params and the same compiled programs,
+prefix restore is bitwise-equal to recompute, and greedy continuation is
+a pure function of the token prefix — so WHICH replica serves a request
+(or whether its prefill ran on a different replica, or it migrated
+mid-stream during a drain) never changes a single output token.
+
+Routing is a scored policy over live signals:
+
+  * **prefix-cache affinity** — `PrefixCache.peek` (non-mutating) probes
+    how many prompt tokens each replica's trie already holds; requests
+    sharing a system prefix converge on the replica that warmed it and
+    skip that prefill entirely;
+  * **occupancy** — free decode-slot fraction from the session's
+    `queue_depth`, so affinity never piles everything onto one replica;
+  * **breaker/health** — a replica whose `CircuitBreaker` is OPEN is
+    ineligible (routing to it anyway is the FLEET001 error);
+  * **consistent-hash fallback** — a cold prefix (zero affinity
+    everywhere) routes by `HashRing` over its page-aligned prefix, so
+    identical cold prefixes co-locate and BUILD affinity instead of
+    scattering.
+
+Prefill/decode disaggregation: with dedicated prefill replicas, the
+page-aligned prompt prefix runs chunked prefill there, the committed
+pages hand off through a `KVTransport` (sha256 page manifest, FLEET002),
+and the decode replica restores them on admission — computing only the
+unaligned tail.  Elastic drain: `drain(rid)` stops new admits, keeps
+stepping the replica until in-flight work retires (other replicas never
+stall — zero downtime), migrates its hot trie pages to the survivors,
+and audits the emptied trie for orphaned pins (FLEET003).  `evacuate`
+mode retires live decodes immediately with partial ids and the router
+resubmits prompt+partial elsewhere, bitwise-seamlessly.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from easydist_tpu.resilience.breaker import OPEN, CircuitBreaker
+from easydist_tpu.serve.admission import (AdmissionController,
+                                          CircuitOpenError,
+                                          RequestTooLargeError)
+from easydist_tpu.serve.batcher import select_bucket
+from easydist_tpu.serve.metrics import ServeMetrics
+
+from .hashring import HashRing, prefix_hash_key
+from .transport import InProcessTransport, KVTransport, page_manifest
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["FleetConfig", "FleetRouter", "Replica"]
+
+
+@dataclass(frozen=True)
+class FleetConfig:
+    """Routing policy knobs.
+
+    affinity_weight / occupancy_weight: the scored policy is
+        w_aff * (cached prefix tokens / prompt tokens)
+        + w_occ * (free decode-slot fraction); affinity dominating means
+        a warm trie wins unless the replica is nearly full.
+    policy: "affinity" (scored + hash fallback) or "random" (uniform —
+        the bench's comparison arm, never the production setting).
+    vnodes: virtual points per replica on the consistent-hash ring.
+    max_queue: fleet-wide bound on live requests; submits beyond it
+        raise QueueFullError (the admission layer's check).
+    default_deadline_ms: deadline stamped on submits that pass none.
+    seed: rng seed for the "random" policy (deterministic benches).
+    """
+    affinity_weight: float = 2.0
+    occupancy_weight: float = 1.0
+    policy: str = "affinity"
+    vnodes: int = 64
+    max_queue: int = 1024
+    default_deadline_ms: Optional[float] = None
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.policy not in ("affinity", "random"):
+            raise ValueError(f"unknown routing policy {self.policy!r}")
+        if self.vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {self.vnodes}")
+        if self.affinity_weight < 0 or self.occupancy_weight < 0:
+            raise ValueError("routing weights must be >= 0")
+
+
+@dataclass
+class Replica:
+    """One registered session + its health surface."""
+    replica_id: str
+    session: object                      # GenerationSession
+    breaker: Optional[CircuitBreaker] = None
+    role: str = "decode"                 # "decode" | "prefill"
+
+    def eligible(self) -> bool:
+        return (not self.session.is_draining
+                and (self.breaker is None or self.breaker.state != OPEN))
+
+
+@dataclass
+class _Inflight:
+    """Router-side record of one request across replica hops."""
+    request_id: int
+    prompt: List[int]
+    max_new: int
+    eos_id: Optional[int]
+    future: Future                       # the caller's future
+    acc_ids: List[int] = field(default_factory=list)
+    replica_id: Optional[str] = None
+    inner: Optional[Future] = None       # current session future
+    deadline_t: Optional[float] = None
+    t_submit: float = 0.0
+
+
+@dataclass
+class _Handoff:
+    """One disaggregated prefill awaiting page transfer."""
+    request_id: int
+    prefill_replica: str
+    decode_replica: str
+    aligned: List[int]                   # page-aligned prompt prefix
+    inner: Future                        # prefill session future
+
+
+class FleetRouter:
+    """Multi-replica serving front: route, disaggregate, drain."""
+
+    def __init__(self, replicas: Sequence, *,
+                 prefill_replicas: Sequence = (),
+                 config: Optional[FleetConfig] = None,
+                 transport: Optional[KVTransport] = None):
+        self.config = config or FleetConfig()
+        self.transport = transport or InProcessTransport()
+        self._replicas: Dict[str, Replica] = {}
+        self._ring = HashRing(vnodes=self.config.vnodes)
+        self._prefill_ring = HashRing(vnodes=self.config.vnodes)
+        for sess in replicas:
+            self.add_replica(sess, role="decode")
+        for sess in prefill_replicas:
+            self.add_replica(sess, role="prefill")
+        if not any(r.role == "decode" for r in self._replicas.values()):
+            raise ValueError("fleet needs at least one decode replica")
+        self.admission = AdmissionController(
+            self.config.max_queue,
+            default_deadline_ms=self.config.default_deadline_ms)
+        self.metrics = ServeMetrics(replica_id="fleet")
+        self._rng = random.Random(self.config.seed)
+        self._inflight: Dict[int, _Inflight] = {}
+        self._handoffs: List[_Handoff] = []
+        self._next_request_id = 0
+        # audit surfaces: FLEET001 reads the decision log, FLEET003 the
+        # drain log; both bounded so a long-lived router stays O(1)
+        self.decision_log: List[Dict[str, object]] = []
+        self.drain_log: List[Dict[str, object]] = []
+        self._log_cap = 1024
+
+    # ------------------------------------------------------------ replicas
+    def add_replica(self, session, role: str = "decode") -> Replica:
+        rid = session.replica_id
+        if not rid:
+            raise ValueError("fleet sessions need a replica_id")
+        if rid in self._replicas:
+            raise ValueError(f"duplicate replica_id {rid!r}")
+        cfg = session.config
+        breaker = None
+        if cfg.breaker_failure_threshold > 0:
+            breaker = CircuitBreaker(
+                failure_threshold=cfg.breaker_failure_threshold,
+                cooldown_s=cfg.breaker_cooldown_ms / 1e3,
+                p99_threshold_s=(cfg.breaker_p99_threshold_ms / 1e3
+                                 if cfg.breaker_p99_threshold_ms is not None
+                                 else None),
+                min_samples=cfg.breaker_min_samples,
+                p99=lambda m=session.metrics: m.execute.percentile(99),
+                replica_id=rid)
+        rep = Replica(replica_id=rid, session=session, breaker=breaker,
+                      role=role)
+        self._replicas[rid] = rep
+        (self._ring if role == "decode" else self._prefill_ring).add(rid)
+        return rep
+
+    def replica(self, replica_id: str) -> Replica:
+        return self._replicas[replica_id]
+
+    def _decode_replicas(self) -> List[Replica]:
+        return [r for r in self._replicas.values() if r.role == "decode"]
+
+    def _prefill_replicas(self) -> List[Replica]:
+        return [r for r in self._replicas.values() if r.role == "prefill"]
+
+    # ------------------------------------------------------------- routing
+    def _aligned_prefix(self, prompt: Sequence[int]) -> List[int]:
+        """Longest trie-page-aligned strict-prefix of `prompt` — the
+        affinity/hash identity AND the disaggregated-prefill unit (the
+        unaligned tail plus at least one token always prefills on the
+        decode replica, matching the trie's max_tokens=len-1 cap)."""
+        chunk = None
+        for rep in self._decode_replicas():
+            chunk = rep.session.bucket_chunk(prompt)
+            if chunk:
+                break
+        if not chunk:
+            return list(prompt)
+        aligned = ((len(prompt) - 1) // chunk) * chunk
+        return list(prompt[:aligned]) if aligned else list(prompt)
+
+    def _route(self, prompt: Sequence[int],
+               request_id: int) -> Replica:
+        """Pick the decode replica; logs the decision for FLEET001."""
+        eligible = [r for r in self._decode_replicas() if r.eligible()]
+        if not eligible:
+            waits = [r.breaker.retry_after_s()
+                     for r in self._decode_replicas() if r.breaker]
+            raise CircuitOpenError(
+                "no eligible decode replica (all draining or circuit-"
+                "open)", retry_after_s=max([0.0] + waits))
+        if self.config.policy == "random":
+            chosen = self._rng.choice(eligible)
+            affinity = 0
+        else:
+            aff = {r.replica_id: r.session.prefix_affinity(prompt)
+                   for r in eligible}
+            if max(aff.values()) == 0:
+                key = prefix_hash_key(self._aligned_prefix(prompt))
+                rid = self._ring.route(
+                    key, eligible=[r.replica_id for r in eligible])
+                chosen = self._replicas[rid] if rid else eligible[0]
+            else:
+                def score(r: Replica) -> Tuple[float, int, str]:
+                    occ_free = 1.0 - (
+                        r.session.queue_depth
+                        / max(1, r.session.config.max_decode_slots))
+                    s = (self.config.affinity_weight
+                         * aff[r.replica_id] / len(prompt)
+                         + self.config.occupancy_weight
+                         * max(0.0, occ_free))
+                    # deterministic tie-break: least loaded, then id
+                    return (-s, r.session.queue_depth, r.replica_id)
+                chosen = min(eligible, key=score)
+            affinity = aff.get(chosen.replica_id, 0) \
+                if self.config.policy == "affinity" else 0
+        self._log(self.decision_log, {
+            "request_id": request_id,
+            "replica_id": chosen.replica_id,
+            "breaker_state": (chosen.breaker.state if chosen.breaker
+                              else "closed"),
+            "draining": chosen.session.is_draining,
+            "affinity_tokens": affinity,
+            "prompt_tokens": len(prompt),
+            "policy": self.config.policy,
+        })
+        if affinity:
+            self.metrics.inc("routed_warm")
+        self.metrics.inc("routed")
+        return chosen
+
+    def _log(self, log: List, entry: Dict) -> None:
+        log.append(entry)
+        del log[:-self._log_cap]
+
+    # ------------------------------------------------------------ admission
+    def submit(self, prompt_ids: Sequence[int], max_new_tokens: int = 16,
+               eos_id: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> Future:
+        """Route one prompt into the fleet; the returned future resolves
+        to the same {"ids", "finish_reason"} a single session produces,
+        plus "replica_id" (the LAST replica that decoded it)."""
+        prompt = [int(t) for t in prompt_ids]
+        any_fit = any(
+            select_bucket(len(prompt) + 1,
+                          r.session.config.decode_buckets) is not None
+            for r in self._decode_replicas())
+        if prompt and not any_fit:
+            raise RequestTooLargeError(
+                f"prompt of {len(prompt)} tokens fits no replica's decode "
+                f"buckets")
+        self.admission.check_depth(self.total_queue_depth)
+        deadline_t = self.admission.resolve_deadline(deadline_ms)
+        rid = self._next_request_id
+        self._next_request_id += 1
+        rec = _Inflight(request_id=rid, prompt=prompt,
+                        max_new=max_new_tokens, eos_id=eos_id,
+                        future=Future(), deadline_t=deadline_t,
+                        t_submit=time.perf_counter())
+        chosen = self._route(prompt, rid)
+        self._inflight[rid] = rec
+        if not self._start_disaggregated(rec, chosen):
+            rec.replica_id = chosen.replica_id
+            rec.inner = chosen.session.submit(
+                prompt, max_new_tokens=max_new_tokens, eos_id=eos_id)
+        self.metrics.inc("requests_submitted")
+        self.metrics.set_gauge("queue_depth", self.total_queue_depth)
+        return rec.future
+
+    def _start_disaggregated(self, rec: _Inflight,
+                             decode_rep: Replica) -> bool:
+        """Run the page-aligned prefix on a dedicated prefill replica when
+        that saves decode-side prefill; returns False to submit directly
+        (no prefill tier, prompt under one page, decode trie already
+        warm, or page sizes disagree across tiers)."""
+        prefill = [r for r in self._prefill_replicas() if r.eligible()]
+        if not prefill:
+            return False
+        aligned = self._aligned_prefix(rec.prompt)
+        chunk = decode_rep.session.bucket_chunk(rec.prompt)
+        if not chunk or len(aligned) < chunk \
+                or len(aligned) == len(rec.prompt):
+            return False
+        if decode_rep.session.prefix_affinity(rec.prompt) >= len(aligned):
+            return False  # decode trie already holds everything aligned
+        src = prefill[0]
+        if len(prefill) > 1:
+            rid = self._prefill_ring.route(
+                prefix_hash_key(aligned),
+                eligible=[r.replica_id for r in prefill])
+            src = self._replicas[rid] if rid else prefill[0]
+        if src.session.bucket_chunk(aligned) != chunk:
+            return False  # page sizes disagree; handoff would be refused
+        rec.replica_id = decode_rep.replica_id
+        inner = src.session.submit(aligned, max_new_tokens=1)
+        self._handoffs.append(_Handoff(
+            request_id=rec.request_id, prefill_replica=src.replica_id,
+            decode_replica=decode_rep.replica_id, aligned=aligned,
+            inner=inner))
+        self.metrics.inc("prefill_handoffs")
+        return True
+
+    @property
+    def total_queue_depth(self) -> int:
+        return sum(r.session.queue_depth
+                   for r in self._replicas.values()) + len(self._handoffs)
+
+    # -------------------------------------------------------------- driving
+    def step(self) -> int:
+        """One fleet round: step EVERY replica (draining ones included —
+        their in-flight work retires while the others keep serving; that
+        is the zero-downtime property), then harvest handoffs, replica
+        hops, completions, and finished drains.  Returns decode tokens
+        generated across the fleet this round."""
+        tokens = 0
+        for rep in self._replicas.values():
+            sess = rep.session
+            had_work = not sess.is_drained
+            try:
+                tokens += sess.step()
+            except Exception:
+                if rep.breaker is not None:
+                    rep.breaker.record_failure()
+                raise
+            if had_work and rep.breaker is not None:
+                rep.breaker.record_success()
+        self._poll_handoffs()
+        self._poll_inflight()
+        self._poll_drains()
+        self.metrics.set_gauge("queue_depth", self.total_queue_depth)
+        return tokens
+
+    def _poll_handoffs(self) -> None:
+        for h in list(self._handoffs):
+            if not h.inner.done():
+                continue
+            self._handoffs.remove(h)
+            rec = self._inflight.get(h.request_id)
+            if rec is None:
+                continue
+            result = h.inner.result()
+            dst = self._replicas[h.decode_replica]
+            if result["finish_reason"] != "length":
+                # prefill replica was evacuated under us: nothing
+                # committed for sure — decode replica prefills from zero
+                logger.warning("prefill handoff %s interrupted (%s); "
+                               "falling back to direct prefill",
+                               h.request_id, result["finish_reason"])
+            else:
+                src = self._replicas[h.prefill_replica]
+                path = src.session.export_prefix_path(h.aligned)
+                moved = self.transport.transfer(
+                    path, dst.session, rec.prompt,
+                    src=h.prefill_replica, dst=h.decode_replica)
+                self.metrics.inc("pages_handed_off", moved)
+            if not dst.eligible():
+                # decode target started draining while prefill ran:
+                # re-route; restore == recompute keeps parity either way
+                try:
+                    dst = self._route(rec.prompt, rec.request_id)
+                except CircuitOpenError as e:
+                    del self._inflight[rec.request_id]
+                    rec.future.set_exception(e)
+                    self.metrics.inc("requests_failed")
+                    continue
+            rec.replica_id = dst.replica_id
+            rec.inner = dst.session.submit(
+                rec.prompt, max_new_tokens=rec.max_new,
+                eos_id=rec.eos_id)
+
+    def _poll_inflight(self) -> None:
+        for rid, rec in list(self._inflight.items()):
+            if rec.inner is None or not rec.inner.done():
+                continue
+            result = rec.inner.result()
+            if result["finish_reason"] == "evacuated":
+                # mid-stream migration: greedy continuation is a pure
+                # function of the prefix, so prompt+partial resumed on
+                # any replica concatenates bitwise-identically
+                rec.acc_ids.extend(result["ids"])
+                remaining = rec.max_new - len(rec.acc_ids)
+                try:
+                    nxt = self._route(rec.prompt + rec.acc_ids,
+                                      rec.request_id)
+                except CircuitOpenError as e:
+                    del self._inflight[rid]
+                    rec.future.set_exception(e)
+                    self.metrics.inc("requests_failed")
+                    continue
+                rec.replica_id = nxt.replica_id
+                rec.inner = nxt.session.submit(
+                    rec.prompt + rec.acc_ids, max_new_tokens=remaining,
+                    eos_id=rec.eos_id)
+                self.metrics.inc("migrations")
+                continue
+            del self._inflight[rid]
+            rec.future.set_result({
+                "ids": rec.acc_ids + result["ids"],
+                "finish_reason": result["finish_reason"],
+                "replica_id": rec.replica_id,
+            })
+            self.metrics.inc("requests_completed")
+            self.metrics.observe(
+                "e2e", time.perf_counter() - rec.t_submit)
+
+    def _poll_drains(self) -> None:
+        for rep in list(self._replicas.values()):
+            if not rep.session.is_draining or not rep.session.is_drained:
+                continue
+            if any(h.prefill_replica == rep.replica_id
+                   or h.decode_replica == rep.replica_id
+                   for h in self._handoffs):
+                continue  # let pending handoffs clear first
+            self._finish_drain(rep)
+
+    # --------------------------------------------------------------- drain
+    def drain(self, replica_id: str, mode: str = "graceful") -> None:
+        """Begin removing one replica with zero dropped requests.
+
+        "graceful": stop new admits, keep stepping until its in-flight
+        decodes retire naturally.  "evacuate": retire live work NOW with
+        partial ids (SIGTERM-grace semantics — resilience/preempt.py);
+        the inflight poller resubmits each prompt+partial elsewhere.
+        Either way the replica's hot trie pages migrate to the survivors
+        before it is removed (next step() after it empties)."""
+        rep = self._replicas[replica_id]
+        (self._ring if rep.role == "decode"
+         else self._prefill_ring).remove(replica_id)
+        if mode == "evacuate":
+            rep.session.evacuate()
+        elif mode == "graceful":
+            rep.session.drain(wait=False)
+        else:
+            raise ValueError(f"unknown drain mode {mode!r}")
+        self.metrics.inc("drains_started")
+
+    def _finish_drain(self, rep: Replica) -> None:
+        pages = rep.session.export_hot_pages()
+        survivors = [r for r in self._decode_replicas()
+                     if r.replica_id != rep.replica_id and r.eligible()]
+        migrated = 0
+        for bucket, paths in pages.items():
+            for path in paths:
+                # manifest-verified like any other handoff (FLEET002)
+                manifest = page_manifest(path, src=rep.replica_id,
+                                         dst="survivors")
+                self._check_handoff(manifest, path, rep.replica_id)
+                for dst in survivors:
+                    migrated += dst.session.import_hot_pages(
+                        {bucket: [path]})
+        self._audit_drain(rep)
+        del self._replicas[rep.replica_id]
+        self.metrics.inc("drains_completed")
+        self.metrics.inc("pages_migrated", migrated)
+        self._log(self.drain_log, {
+            "replica_id": rep.replica_id, "role": rep.role,
+            "pages_migrated": migrated,
+            "survivors": [r.replica_id for r in survivors],
+        })
+
+    def _check_handoff(self, manifest, path, src: str) -> None:
+        try:
+            from easydist_tpu.analyze import check_page_handoff
+
+            check_page_handoff(manifest, path,
+                               node=f"drain[{src}]")
+        except ImportError:
+            pass
+
+    def _audit_drain(self, rep: Replica) -> None:
+        try:
+            from easydist_tpu.analyze import check_fleet_drain
+
+            check_fleet_drain(rep.session,
+                              node=f"drain[{rep.replica_id}]")
+        except ImportError:
+            pass
+
+    # -------------------------------------------------------------- runners
+    def run_until_drained(self, max_steps: int = 100000) -> None:
+        """Drive `step()` until every submitted request resolved and no
+        replica holds live work."""
+        for _ in range(max_steps):
+            if not self._inflight and not self._handoffs and all(
+                    r.session.is_drained for r in self._replicas.values()):
+                return
+            self.step()
+        raise RuntimeError(f"fleet not drained after {max_steps} steps")
+
+    # ------------------------------------------------------------ reporting
+    def stats(self) -> Dict[str, object]:
+        return {
+            "replicas": {
+                rid: {"role": r.role,
+                      "draining": r.session.is_draining,
+                      "queue_depth": r.session.queue_depth,
+                      "breaker": (r.breaker.snapshot() if r.breaker
+                                  else None)}
+                for rid, r in self._replicas.items()},
+            "inflight": len(self._inflight),
+            "handoffs": len(self._handoffs),
+            "decisions": len(self.decision_log),
+            "drains": list(self.drain_log),
+            "metrics": self.metrics.snapshot(),
+        }
+
+    def export_metrics(self, db=None, persist: bool = True):
+        """Fleet gauges + every replica's metrics into PerfDB, each under
+        its own replica-labeled sub_key (no collisions)."""
+        db = self.metrics.export(db=db, key="serving",
+                                 sub_key="fleet", persist=False)
+        for rep in self._replicas.values():
+            rep.session.metrics.export(db=db, persist=False)
+        db.append_history("serving", "fleet_routing", {
+            "decisions": list(self.decision_log)[-64:],
+            "drains": list(self.drain_log),
+        })
+        if persist:
+            try:
+                db.persist()
+            except Exception:
+                pass
+        return db

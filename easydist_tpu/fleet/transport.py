@@ -1,0 +1,145 @@
+"""KV-page transport for prefill/decode disaggregation and drain
+migration.
+
+The unit of transfer is the prefix trie's committed page: one aligned
+`prefill_chunk`-token window of K/V, shape [layers, (kv_)heads, chunk,
+head_dim] per array (serve/prefix_cache.py).  A transfer ships a
+root-to-leaf chunk *path* — pages are only meaningful with every ancestor
+present (causal attention: a page's K/V depends on all tokens before it).
+
+Every transfer carries a **manifest** in the checkpoint MANIFEST.json
+idiom (runtime/checkpoint.py): per-page sha256 over the token ids and the
+raw K/V bytes, so the receiver verifies integrity before committing —
+FLEET002 makes a digest mismatch an error finding, because a corrupt page
+restored into a live trie poisons every future request sharing that
+prefix, bitwise-silently.
+
+`InProcessTransport` moves device arrays by reference (same process, same
+backend) and still builds + verifies the manifest — the serialized format
+is the contract a DCN transport implements later; the in-process one
+proves it round-trips.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+MANIFEST_FORMAT = 1
+
+Page = Tuple[Tuple[int, ...], Dict[str, object]]  # (chunk_tokens, {"k","v"})
+
+
+def _page_digest(tokens: Sequence[int], kv: Dict[str, object]) -> Tuple[str, int]:
+    """sha256 over the page identity AND payload: token ids, then each
+    array's dtype/shape/raw bytes in key order — any bit flip anywhere in
+    the page changes the digest."""
+    h = hashlib.sha256()
+    for t in tokens:
+        h.update(int(t).to_bytes(8, "big", signed=True))
+    nbytes = 0
+    for name in sorted(kv):
+        arr = np.asarray(kv[name])
+        h.update(name.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        buf = np.ascontiguousarray(arr).tobytes()
+        h.update(buf)
+        nbytes += len(buf)
+    return h.hexdigest(), nbytes
+
+
+def page_manifest(path: Sequence[Page], src: str = "?",
+                  dst: str = "?") -> Dict[str, object]:
+    """Serializable description of one chunk-path transfer (JSON-safe:
+    token ids + digests, never array payloads)."""
+    pages = []
+    for idx, (tokens, kv) in enumerate(path):
+        digest, nbytes = _page_digest(tokens, kv)
+        pages.append({"index": idx, "tokens": [int(t) for t in tokens],
+                      "sha256": digest, "bytes": nbytes})
+    return {"format": MANIFEST_FORMAT, "src": src, "dst": dst,
+            "pages": pages}
+
+
+def verify_manifest(manifest: Dict[str, object],
+                    path: Sequence[Page]) -> List[str]:
+    """Recompute every page digest against the manifest; returns problem
+    strings (empty = intact).  The FLEET002 audit wraps this."""
+    problems: List[str] = []
+    entries = manifest.get("pages", [])
+    if manifest.get("format") != MANIFEST_FORMAT:
+        problems.append(f"manifest format {manifest.get('format')!r} != "
+                        f"{MANIFEST_FORMAT}")
+    if len(entries) != len(path):
+        problems.append(f"manifest lists {len(entries)} pages, transfer "
+                        f"carries {len(path)}")
+    for entry, (tokens, kv) in zip(entries, path):
+        want_tokens = [int(t) for t in entry.get("tokens", [])]
+        if want_tokens != [int(t) for t in tokens]:
+            problems.append(f"page {entry.get('index')}: token ids differ "
+                            f"from manifest")
+            continue
+        digest, nbytes = _page_digest(tokens, kv)
+        if digest != entry.get("sha256"):
+            problems.append(
+                f"page {entry.get('index')}: sha256 mismatch (manifest "
+                f"{str(entry.get('sha256'))[:12]}.., payload "
+                f"{digest[:12]}..)")
+        elif nbytes != entry.get("bytes"):
+            problems.append(f"page {entry.get('index')}: {nbytes} payload "
+                            f"bytes != manifest {entry.get('bytes')}")
+    return problems
+
+
+class KVTransport:
+    """Moves one committed chunk path between replicas.  Implementations
+    must build a manifest at the source and verify it at the destination
+    before committing anything."""
+
+    def transfer(self, path: Sequence[Page], dst_session, prompt,
+                 src: str = "?", dst: str = "?") -> int:
+        raise NotImplementedError
+
+
+class InProcessTransport(KVTransport):
+    """Same-process transfer: pages move by reference, the manifest still
+    round-trips (and is kept in `manifests` for audit/tests)."""
+
+    def __init__(self, verify: bool = True, keep: int = 32):
+        self.verify = verify
+        self.keep = keep
+        self.manifests: List[Dict[str, object]] = []
+        self.pages_moved = 0
+
+    def transfer(self, path: Sequence[Page], dst_session, prompt,
+                 src: str = "?", dst: str = "?") -> int:
+        """Verify + commit `path` into `dst_session`'s trie for `prompt`'s
+        decode bucket; returns chunks present after import."""
+        if not path:
+            return 0
+        manifest = page_manifest(path, src=src, dst=dst)
+        self.manifests = (self.manifests + [manifest])[-self.keep:]
+        if self.verify:
+            self._check(manifest, path)
+        n = dst_session.import_prefix_path(prompt, path)
+        self.pages_moved += len(path)
+        return n
+
+    def _check(self, manifest, path) -> None:
+        try:
+            from easydist_tpu.analyze import check_page_handoff
+        except ImportError:  # analyze is an optional layer at runtime
+            problems = verify_manifest(manifest, path)
+            if problems:
+                raise RuntimeError(
+                    f"KV page handoff corrupt: {problems}")
+            return
+        check_page_handoff(manifest, path,
+                           node=f"handoff[{manifest['src']}->"
+                                f"{manifest['dst']}]")

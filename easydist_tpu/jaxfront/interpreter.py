@@ -736,6 +736,20 @@ class ShardingAnalyzer:
         for inner_b, sub_b, _, _ in analyzed:
             if len(inner_b.jaxpr.invars) != len(operands):
                 return None
+        # operand indices each branch actually READS: cond unions the
+        # branch closures, so every branch jaxpr is padded with the other
+        # branches' captured weights as dead invars (a top-level invar
+        # used anywhere must appear as a top-level eqn invar or outvar)
+        used_sets = []
+        for inner_b, _, _, _ in analyzed:
+            used_vars = set()
+            for be in inner_b.jaxpr.eqns:
+                used_vars.update(bv for bv in be.invars
+                                 if not isinstance(bv, jex_core.Literal))
+            used_vars.update(bv for bv in inner_b.jaxpr.outvars
+                             if not isinstance(bv, jex_core.Literal))
+            used_sets.append({k for k, bv in enumerate(inner_b.jaxpr.invars)
+                              if bv in used_vars})
 
         edge_invars = [i for i, v in enumerate(eqn.invars)
                        if not isinstance(v, jex_core.Literal)]
@@ -800,10 +814,30 @@ class ShardingAnalyzer:
                     per_branch.append((got, res[1], res[2], res[3]))
                 if len(per_branch) != len(analyzed):
                     continue
-                keys = {(tuple(repr(p) for p in ins),
-                         tuple(repr(p) for p in outs))
-                        for (ins, outs), _, _, _ in per_branch}
-                if len(keys) != 1:
+                # Join the per-branch boundaries treating operands a branch
+                # never reads as don't-care: the body solver places a dead
+                # invar arbitrarily, so demanding byte-identical boundary
+                # keys rejects every seed whenever branches capture
+                # different weights.  Disagreement on an operand some
+                # branch actually reads still rejects the seed; an operand
+                # no branch reads pins to replicate.
+                joint_ins = []
+                agree = True
+                for pos, i in enumerate(edge_invars):
+                    if i == 0:
+                        joint_ins.append(Placement.replicate())
+                        continue
+                    picks_here = [per_branch[b][0][0][pos]
+                                  for b in range(len(per_branch))
+                                  if (i - 1) in used_sets[b]]
+                    if len({repr(p) for p in picks_here}) > 1:
+                        agree = False
+                        break
+                    joint_ins.append(picks_here[0] if picks_here
+                                     else Placement.replicate())
+                out_keys = {tuple(repr(p) for p in outs)
+                            for (_, outs), _, _, _ in per_branch}
+                if not agree or len(out_keys) != 1:
                     continue  # branches disagree on the boundary
                 # fold the full-price compute only for solves that SURVIVED
                 # the per-branch agreement check — a rejected solve's price
@@ -811,10 +845,10 @@ class ShardingAnalyzer:
                 # compares against (ADVICE r5 #1)
                 full_branch_compute = max(
                     [full_branch_compute] + [fc for _, _, _, fc in per_branch])
-                (ins, outs), _, _, _ = per_branch[0]
+                ins, outs = joint_ins, per_branch[0][0][1]
                 if all(p.is_replicate() for p in ins):
                     continue
-                key = next(iter(keys))
+                key = (tuple(repr(p) for p in ins), next(iter(out_keys)))
                 if key in seen_keys:
                     continue
                 seen_keys.add(key)

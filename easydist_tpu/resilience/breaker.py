@@ -41,7 +41,8 @@ class CircuitBreaker:
                  min_samples: int = 20,
                  half_open_probes: int = 1,
                  p99: Optional[Callable[[], Optional[float]]] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 replica_id: Optional[str] = None):
         if failure_threshold < 1:
             raise ValueError(
                 f"failure_threshold must be >= 1, got {failure_threshold}")
@@ -57,6 +58,9 @@ class CircuitBreaker:
         self.half_open_probes = half_open_probes
         self._p99 = p99  # callable returning current p99 seconds (or None)
         self.clock = clock
+        # fleet label: stamped into snapshot() so per-replica breaker
+        # states aggregate without key collisions
+        self.replica_id = replica_id
         self._lock = threading.Lock()
         self._state = CLOSED
         self._consecutive_failures = 0
@@ -87,7 +91,8 @@ class CircuitBreaker:
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {"state": self._state_locked(),
+            return {"replica_id": self.replica_id,
+                    "state": self._state_locked(),
                     "consecutive_failures": self._consecutive_failures,
                     "times_opened": self._times_opened}
 

@@ -28,6 +28,14 @@ class PerfDB:
     def record_op_perf(self, key: str, sub_key: str, value: Any) -> None:
         self._db.setdefault(key, {})[sub_key] = value
 
+    def append_history(self, key: str, sub_key: str, entry: Any,
+                       cap: int = 32) -> None:
+        """Append `entry` to a bounded history list under (key, sub_key) —
+        the shape serving metrics and fleet gauges use, so N writers keep
+        rolling windows instead of clobbering one value."""
+        hist = self.get_op_perf(key, sub_key) or []
+        self.record_op_perf(key, sub_key, (list(hist) + [entry])[-cap:])
+
     def persist(self) -> None:
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
         with open(self.path, "wb") as f:

@@ -15,7 +15,8 @@ framework pays off at inference time behind a dispatch layer like this.
 
 from .admission import (CircuitOpenError, DeadlineExceededError,  # noqa: F401
                         EngineStoppedError, ExecTimeoutError,
-                        QueueFullError, RequestTooLargeError, ServeError,
+                        QueueFullError, ReplicaDrainingError,
+                        RequestTooLargeError, ServeError,
                         is_oom_error, is_transient_error, retry_transient)
 from .batcher import (MicroBatcher, PackMeta, Request,  # noqa: F401
                       RequestQueue, pack_requests, scatter_results,

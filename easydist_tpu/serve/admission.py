@@ -44,6 +44,12 @@ class RequestTooLargeError(ServeError):
     """A request dimension exceeds the largest configured bucket."""
 
 
+class ReplicaDrainingError(ServeError):
+    """The session is draining (scale-down in progress): it retires its
+    in-flight work but admits nothing new.  A fleet router routes the
+    request to another replica; a direct caller should back off."""
+
+
 class ExecTimeoutError(ServeError):
     """One device execution exceeded the per-batch watchdog deadline.
     The dispatch itself cannot be cancelled (XLA has no cancellation); the

@@ -49,13 +49,22 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from .admission import RequestTooLargeError
+from .admission import ReplicaDrainingError, RequestTooLargeError
 from .batcher import select_bucket
 from .engine import ServeConfig
 from .metrics import ServeMetrics
 from .prefix_cache import PrefixCache
 
 logger = logging.getLogger(__name__)
+
+
+# process-level memo of compiled step functions, keyed by (model identity,
+# mesh).  Every compiled callable below is pure — cache, params, and tokens
+# all cross as arguments — so sessions over the same model/mesh can share
+# the traced-and-XLA-compiled programs instead of each replica re-paying
+# the compile.  This is the fleet case: N in-process replicas differ only
+# in the state they carry, never in the program they run.
+_COMPILED_MEMO: Dict[tuple, tuple] = {}
 
 
 def kv_cache_specs(axis: str = "tp"):
@@ -80,6 +89,7 @@ class _Slot:
     eos_id: Optional[int]
     generated: List[int] = field(default_factory=list)
     pinned: List[object] = field(default_factory=list)  # trie nodes held
+    prompt: List[int] = field(default_factory=list)  # for evacuation
 
 
 @dataclass
@@ -142,6 +152,12 @@ class GenerationSession:
     resolving to {"ids": [...generated ids...], "finish_reason":
     "eos"|"length"|"bucket_full"}; drive with `step()` (admit + bounded
     prefill chunks + decode + harvest) or `run_until_drained()`.
+
+    `compile_key` (any hashable; `for_gpt`/`for_llama` derive one from the
+    model config) opts the session into the process-level compiled-program
+    memo: replicas over the same model and mesh share traced/compiled step
+    functions instead of each paying the compile — the callables are pure,
+    so only host-side state is per-session.
     """
 
     def __init__(self, params, *, model_prefill: Callable,
@@ -150,10 +166,13 @@ class GenerationSession:
                  config: Optional[ServeConfig] = None, mesh=None,
                  eos_id: Optional[int] = None,
                  max_prompt_len: Optional[int] = None,
-                 metrics: Optional[ServeMetrics] = None):
+                 metrics: Optional[ServeMetrics] = None,
+                 replica_id: Optional[str] = None,
+                 compile_key: Optional[object] = None):
         from easydist_tpu.jaxfront import easydist_compile
 
         self.config = config or ServeConfig()
+        self.replica_id = replica_id
         if max_prompt_len is not None:
             bad = [b for b in self.config.decode_buckets
                    if b > max_prompt_len]
@@ -165,7 +184,9 @@ class GenerationSession:
         self.params = params
         self.mesh = mesh
         self.eos_id = eos_id
-        self.metrics = metrics or ServeMetrics()
+        self.metrics = metrics or ServeMetrics(replica_id=replica_id)
+        self._draining = False
+        self._closed = False
         self._init_cache = init_cache
         self._chunked = model_prefill_chunk is not None
         self._pending: collections.deque = collections.deque()
@@ -221,12 +242,34 @@ class GenerationSession:
         # callable, so state_io="auto" pairs it and XLA gets the buffer
         # donated; _extract's output is chunk-shaped (no pairing, no
         # donation — it must not invalidate the staging it reads)
-        self._prefill_c = easydist_compile(_prefill, mesh=mesh)
-        self._prefill_chunk_c = easydist_compile(_prefill_chunk, mesh=mesh)
-        self._restore_c = easydist_compile(_restore, mesh=mesh)
-        self._extract_cs: Dict[int, Callable] = {}
-        self._migrate_c = easydist_compile(_migrate, mesh=mesh)
-        self._decode_c = easydist_compile(_decode, mesh=mesh)
+        # `mesh=None` means "the global mesh at first call", which is
+        # sticky process state that can change between sessions — resolve
+        # it NOW so every program this session runs (and every session
+        # sharing this memo entry) is compiled against the same mesh.
+        # Unresolvable (no global installed yet) skips the memo: the
+        # session compiles privately under whatever ambient its first
+        # call sees, exactly the pre-memo behavior.
+        if mesh is None:
+            from easydist_tpu.jaxfront.mesh import get_device_mesh
+
+            mesh = get_device_mesh()
+            self.mesh = mesh  # _extract_for compiles against it too
+        memo_key = (compile_key, mesh) \
+            if compile_key is not None and mesh is not None else None
+        shared = _COMPILED_MEMO.get(memo_key) if memo_key else None
+        if shared is None:
+            shared = (easydist_compile(_prefill, mesh=mesh),
+                      easydist_compile(_prefill_chunk, mesh=mesh),
+                      easydist_compile(_restore, mesh=mesh),
+                      easydist_compile(_migrate, mesh=mesh),
+                      easydist_compile(_decode, mesh=mesh),
+                      {})
+            if memo_key:
+                while len(_COMPILED_MEMO) >= 32:  # live sessions keep refs
+                    _COMPILED_MEMO.pop(next(iter(_COMPILED_MEMO)))
+                _COMPILED_MEMO[memo_key] = shared
+        (self._prefill_c, self._prefill_chunk_c, self._restore_c,
+         self._migrate_c, self._decode_c, self._extract_cs) = shared
 
     def _extract_for(self, chunk_len: int) -> Callable:
         """Compiled chunk extractor for one chunk size (the slice size
@@ -257,6 +300,11 @@ class GenerationSession:
                eos_id: Optional[int] = None) -> Future:
         """Queue one prompt; generation interleaves with every other live
         request (continuous batching) as `step()` is driven."""
+        if self._draining or self._closed:
+            raise ReplicaDrainingError(
+                f"session{f' {self.replica_id}' if self.replica_id else ''} "
+                f"is {'closed' if self._closed else 'draining'}: in-flight "
+                f"work retires but nothing new is admitted")
         prompt = [int(t) for t in prompt_ids]
         if not prompt:
             raise ValueError("empty prompt")
@@ -274,7 +322,15 @@ class GenerationSession:
              self.eos_id if eos_id is None else eos_id, fut,
              time.perf_counter()))
         self.metrics.inc("requests_submitted")
+        self.metrics.set_gauge("queue_depth", self.queue_depth)
         return fut
+
+    @property
+    def queue_depth(self) -> int:
+        """Live requests this session owns: queued + prefilling + decoding
+        (the fleet router's occupancy signal)."""
+        return len(self._pending) + sum(
+            len(p.jobs) + p.n_active for p in self._pools.values())
 
     # ------------------------------------------------------------- plumbing
     def _pool_for(self, bucket: int) -> _BucketPool:
@@ -367,7 +423,7 @@ class GenerationSession:
 
         slot = _Slot(request_id=self._next_request_id, future=fut,
                      pos=len(prompt), token=int(np.asarray(first)[0]),
-                     max_new=max_new, eos_id=eos)
+                     max_new=max_new, eos_id=eos, prompt=prompt)
         self._next_request_id += 1
         slot.generated.append(slot.token)
         pool.slots[slot_idx] = slot
@@ -449,7 +505,7 @@ class GenerationSession:
         slot = _Slot(request_id=job.request_id, future=job.future,
                      pos=len(job.prompt), token=first_token,
                      max_new=job.max_new, eos_id=job.eos_id,
-                     pinned=pinned)
+                     pinned=pinned, prompt=job.prompt)
         slot.generated.append(slot.token)
         pool.slots[job.slot_idx] = slot
         self._maybe_retire(pool, job.slot_idx)
@@ -552,6 +608,7 @@ class GenerationSession:
         for pool in self._pools.values():
             if pool.slots:
                 self._decode_round(pool)
+        self.metrics.set_gauge("queue_depth", self.queue_depth)
         return self.metrics.counter("tokens_generated") - before
 
     def run_until_drained(self, max_steps: int = 100000) -> None:
@@ -563,9 +620,153 @@ class GenerationSession:
             self.step()
         raise RuntimeError(f"not drained after {max_steps} steps")
 
+    # ------------------------------------------------------------ lifecycle
+    def drain(self, wait: bool = True, max_steps: int = 100000):
+        """Stop admitting (submits raise `ReplicaDrainingError`), let
+        in-flight work retire, and export the tries' hot pages for
+        re-admission elsewhere.  `wait=False` only flips the flag — the
+        caller keeps driving `step()` (a fleet router does this so its
+        OTHER replicas never stall behind this one's drain) and calls
+        `export_hot_pages()` itself once `is_drained`.  Returns the hot
+        pages (wait=True) or None (wait=False).  Idempotent."""
+        self._draining = True
+        if not wait:
+            return None
+        self.run_until_drained(max_steps=max_steps)
+        return self.export_hot_pages()
+
+    @property
+    def is_draining(self) -> bool:
+        return self._draining
+
+    @property
+    def is_drained(self) -> bool:
+        """No queued, prefilling, or decoding work left."""
+        return not self._pending and not any(
+            p.slots or p.jobs for p in self._pools.values())
+
+    def export_hot_pages(self) -> Dict[int, List[List[tuple]]]:
+        """Per-bucket root-to-leaf chunk paths from each trie,
+        hottest-first (prefix_cache.hot_paths) — what a router re-imports
+        into surviving replicas on drain so shared-prefix traffic does
+        not re-pay prefill after a scale-down."""
+        return {b: p.trie.hot_paths() for b, p in self._pools.items()
+                if p.trie is not None}
+
+    # ------------------------------------------------- fleet trie access
+    def bucket_chunk(self, prompt: Sequence[int]) -> Optional[int]:
+        """Trie page size (tokens) for the bucket `prompt` decodes in, or
+        None when the prompt fits no bucket / prefix reuse is off."""
+        bucket = select_bucket(len(prompt) + 1, self.config.decode_buckets)
+        if bucket is None or not self._chunked \
+                or not self.config.enable_prefix_cache \
+                or not self.config.prefix_cache_bytes:
+            return None
+        return min(self.config.prefill_chunk, bucket)
+
+    def prefix_affinity(self, prompt: Sequence[int]) -> int:
+        """Tokens of `prompt` already committed in this session's trie —
+        non-mutating (PrefixCache.peek), so a router can probe every
+        replica without disturbing LRU state."""
+        bucket = select_bucket(len(prompt) + 1, self.config.decode_buckets)
+        pool = self._pools.get(bucket) if bucket is not None else None
+        if pool is None or pool.trie is None:
+            return 0
+        return pool.trie.peek(prompt, max_tokens=len(prompt) - 1)
+
+    def export_prefix_path(self, prompt: Sequence[int],
+                           max_tokens: Optional[int] = None) -> List[tuple]:
+        """Committed chunk path for `prompt`'s longest cached prefix, as
+        [(chunk_tokens, kv)] for transport to another replica."""
+        bucket = select_bucket(len(prompt) + 1, self.config.decode_buckets)
+        pool = self._pools.get(bucket) if bucket is not None else None
+        if pool is None or pool.trie is None:
+            return []
+        return pool.trie.export_path(prompt, max_tokens=max_tokens)
+
+    def import_prefix_path(self, prompt: Sequence[int],
+                           path: Sequence[tuple]) -> int:
+        """Commit a transported chunk path into the trie of the bucket
+        `prompt` will decode in (creating the pool if needed).  Returns
+        chunks present along the path afterwards."""
+        bucket = select_bucket(len(prompt) + 1, self.config.decode_buckets)
+        if bucket is None:
+            return 0
+        pool = self._pool_for(bucket)
+        if pool.trie is None:
+            return 0
+        return pool.trie.import_path(path)
+
+    def import_hot_pages(self, pages: Dict[int, List[List[tuple]]]) -> int:
+        """Re-admit another replica's exported hot pages (drain
+        migration): each bucket's paths import into this session's same
+        bucket when configured here, falling back to the largest
+        configured bucket.  Returns total chunks committed."""
+        total = 0
+        for bucket, paths in pages.items():
+            b = bucket if bucket in self.config.decode_buckets \
+                else max(self.config.decode_buckets)
+            pool = self._pool_for(b)
+            if pool.trie is None:
+                continue
+            for path in paths:
+                total += pool.trie.import_path(path)
+        return total
+
+    def evacuate(self) -> List[Dict[str, object]]:
+        """Preemptive drain (SIGTERM grace too short to retire decodes):
+        retire EVERY live request immediately with finish_reason
+        "evacuated" and partial ids, returning resume descriptors.  A
+        router resubmits prompt + ids with the remaining budget elsewhere;
+        greedy continuation is a pure function of the token prefix, so the
+        concatenated output is bitwise-identical to an uninterrupted run.
+        An evacuated partial never contains eos (eos retires the slot the
+        step it appears) and is always shorter than max_new (reaching it
+        retires as "length"), so the remaining budget is >= 1."""
+        self._draining = True
+        out: List[Dict[str, object]] = []
+        while self._pending:
+            prompt, max_new, eos, fut, _ = self._pending.popleft()
+            if fut.set_running_or_notify_cancel() is False:
+                continue
+            fut.set_result({"ids": [], "finish_reason": "evacuated"})
+            out.append({"prompt": list(prompt), "ids": [],
+                        "max_new": max_new, "eos_id": eos})
+        for pool in self._pools.values():
+            for row in list(pool.jobs):
+                job = pool.jobs.pop(row)
+                pool.free_rows.append(row)
+                pool.free.append(job.slot_idx)
+                if pool.trie is not None:
+                    pool.trie.unpin(job.prefix_nodes)
+                job.future.set_result(
+                    {"ids": [], "finish_reason": "evacuated"})
+                out.append({"prompt": list(job.prompt), "ids": [],
+                            "max_new": job.max_new, "eos_id": job.eos_id})
+            for idx in list(pool.slots):
+                slot = pool.slots[idx]
+                desc = {"prompt": list(slot.prompt),
+                        "ids": list(slot.generated),
+                        "max_new": slot.max_new, "eos_id": slot.eos_id}
+                self._retire(pool, idx, "evacuated")
+                out.append(desc)
+        return out
+
+    def close(self) -> None:
+        """Drain, then release the pooled device caches.  Idempotent;
+        every submit afterwards raises `ReplicaDrainingError`."""
+        if self._closed:
+            return
+        self.drain(wait=True)
+        self._closed = True
+        self._pools.clear()
+
     # ----------------------------------------------------------- reporting
     def stats(self) -> Dict[str, object]:
         return {
+            "replica_id": self.replica_id,
+            "draining": self._draining,
+            "queue_depth": self.queue_depth,
             "pending": len(self._pending),
             "buckets": {
                 b: {"active": p.n_active, "free": len(p.free),
@@ -586,8 +787,11 @@ class GenerationSession:
     def for_gpt(cls, params, cfg, **kw):
         """Session over models/gpt.py; decode_buckets must fit cfg.seq
         (the learned-position-table bound)."""
+        import dataclasses
+
         from easydist_tpu.models import gpt
 
+        kw.setdefault("compile_key", ("gpt", dataclasses.astuple(cfg)))
         return cls(
             params,
             model_prefill=lambda p, c, t, l: gpt.gpt_prefill(p, cfg, c, t, l),
@@ -603,8 +807,11 @@ class GenerationSession:
     def for_llama(cls, params, cfg, **kw):
         """Session over models/llama.py (RoPE: buckets are not bound by
         cfg.seq)."""
+        import dataclasses
+
         from easydist_tpu.models import llama
 
+        kw.setdefault("compile_key", ("llama", dataclasses.astuple(cfg)))
         return cls(
             params,
             model_prefill=lambda p, c, t, l: llama.llama_prefill(

@@ -10,7 +10,7 @@ lands next to the step-time history `EASYDIST_RUNTIME_PROF` already keeps.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 # log-spaced bucket upper bounds, 0.1ms .. ~107s (x2 per bucket)
 _DEFAULT_BOUNDS = tuple(1e-4 * (2 ** i) for i in range(21))
@@ -86,7 +86,10 @@ class ServeMetrics:
     host roundtrip), e2e (submit->future resolution), per_token (one
     decode-step wall time, all slots), ttft (submit->first token)."""
 
-    def __init__(self):
+    def __init__(self, replica_id: Optional[str] = None):
+        # fleet label: stamped into every snapshot and the default PerfDB
+        # sub_key so N replicas' histories never collide under one key
+        self.replica_id = replica_id
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {}
         self._gauges: Dict[str, float] = {}
@@ -210,7 +213,8 @@ class ServeMetrics:
                      "e2e": self.e2e.snapshot(),
                      "per_token": self.per_token.snapshot(),
                      "ttft": self.ttft.snapshot()}
-        return {"counters": counters, "gauges": gauges,
+        return {"replica_id": self.replica_id,
+                "counters": counters, "gauges": gauges,
                 "latency": hists,
                 "batch_occupancy": self.batch_occupancy(),
                 "compile_cache_hit_rate": self.compile_cache_hit_rate(),
@@ -218,16 +222,19 @@ class ServeMetrics:
                 "prefix_cache_hit_rate": self.prefix_cache_hit_rate()}
 
     def export(self, db=None, key: str = "serving",
-               sub_key: str = "engine", persist: bool = True):
+               sub_key: Optional[str] = None, persist: bool = True):
         """Record the snapshot into the persistent PerfDB (the same store
-        runtime profiling uses), appended to a bounded history list."""
+        runtime profiling uses), appended to a bounded history list.  The
+        default sub_key carries the replica label ("engine[r1]") so fleet
+        replicas keep separate histories."""
         if db is None:
             from easydist_tpu.runtime.perfdb import PerfDB
 
             db = PerfDB()
-        hist: List = db.get_op_perf(key, sub_key) or []
-        hist = (hist + [self.snapshot()])[-32:]
-        db.record_op_perf(key, sub_key, hist)
+        if sub_key is None:
+            sub_key = (f"engine[{self.replica_id}]" if self.replica_id
+                       else "engine")
+        db.append_history(key, sub_key, self.snapshot())
         if persist:
             try:
                 db.persist()

@@ -111,6 +111,26 @@ class PrefixCache:
             self.misses += max_chunks - len(nodes)
         return len(nodes) * self.chunk, nodes
 
+    def peek(self, prompt: Sequence[int],
+             max_tokens: Optional[int] = None) -> int:
+        """Length (tokens) of the longest cached whole-chunk prefix of
+        `prompt` WITHOUT touching LRU ticks or hit/miss counters — the
+        fleet router probes every replica's trie per request, and a probe
+        that mutated recency would let routing decisions evict pages the
+        chosen replica is about to restore."""
+        limit = len(prompt) if max_tokens is None else min(
+            len(prompt), max_tokens)
+        node = self._root
+        matched = 0
+        for j in range(limit // self.chunk):
+            child = node.children.get(
+                chunk_key(prompt[j * self.chunk:(j + 1) * self.chunk]))
+            if child is None:
+                break
+            matched += 1
+            node = child
+        return matched * self.chunk
+
     def lookup_node(self, nodes: List[_Node],
                     chunk_tokens: Sequence[int]) -> Optional[_Node]:
         """Child of the path `nodes` (empty = root) for `chunk_tokens`,
@@ -139,7 +159,15 @@ class PrefixCache:
                      for leaf in kv.values())
         if nbytes > self.byte_budget:
             return None
-        self._evict_to(self.byte_budget - nbytes)
+        # the path being extended must survive this commit's eviction:
+        # the tail is an unpinned leaf until the caller pins the full
+        # path, and evicting it here would attach the new node to a
+        # detached parent (unreachable subtree + byte-counter drift)
+        self.pin(nodes)
+        try:
+            self._evict_to(self.byte_budget - nbytes)
+        finally:
+            self.unpin(nodes)
         if self.bytes_used + nbytes > self.byte_budget:
             return None  # everything evictable is pinned
         node = _Node(key=key, parent=parent, kv=kv, nbytes=nbytes,
@@ -170,6 +198,64 @@ class PrefixCache:
             node = stack.pop()
             yield node
             stack.extend(node.children.values())
+
+    # ------------------------------------------------------- export/import
+    def export_path(self, prompt: Sequence[int],
+                    max_tokens: Optional[int] = None) -> List[Tuple[
+                        Tuple[int, ...], Dict[str, object]]]:
+        """Hand the longest cached whole-chunk prefix of `prompt` out as
+        [(chunk_tokens, kv)] pairs for transfer to another trie (drain
+        page migration, disaggregated-prefill handoff).  Does not evict or
+        unpin anything — the pages stay committed here; the caller decides
+        the source trie's fate."""
+        limit = len(prompt) if max_tokens is None else min(
+            len(prompt), max_tokens)
+        node = self._root
+        out: List[Tuple[Tuple[int, ...], Dict[str, object]]] = []
+        for j in range(limit // self.chunk):
+            key = chunk_key(prompt[j * self.chunk:(j + 1) * self.chunk])
+            child = node.children.get(key)
+            if child is None:
+                break
+            out.append((key, child.kv))
+            node = child
+        return out
+
+    def hot_paths(self, min_refcount: int = 0) -> List[List[Tuple[
+            Tuple[int, ...], Dict[str, object]]]]:
+        """Root-to-leaf chunk paths worth migrating on drain: every path
+        ending at a leaf whose refcount > `min_refcount`, plus (with the
+        default 0) all leaves — ordered hottest-first by the leaf's LRU
+        tick so a byte-budget-limited importer keeps the most recent."""
+        paths = []
+        for node in self._walk():
+            if node.children or node.refcount < min_refcount:
+                continue
+            path = []
+            cur = node
+            while cur is not self._root:
+                path.append((cur.key, cur.kv))
+                cur = cur.parent
+            paths.append((node.last_used, list(reversed(path))))
+        paths.sort(key=lambda t: -t[0])
+        return [p for _, p in paths]
+
+    def import_path(self, path: Sequence[Tuple[Tuple[int, ...],
+                                               Dict[str, object]]]) -> int:
+        """Commit a chunk path exported from another trie, root-first.
+        First-commit-wins exactly like `commit` (an existing node keeps
+        its kv — both sides computed bitwise-identical pages, so either
+        copy serves).  Returns the number of chunks now present along the
+        path (existing + newly committed); stops early when the byte
+        budget refuses a chunk (children without their parent would be
+        unreachable)."""
+        nodes: List[_Node] = []
+        for key, kv in path:
+            node = self.commit(nodes, key, kv)
+            if node is None:
+                break
+            nodes.append(node)
+        return len(nodes)
 
     # ----------------------------------------------------------- refcounts
     def pin(self, nodes: Sequence[_Node]) -> None:

@@ -202,5 +202,46 @@ else
     echo "static_checks: jax not importable; skipping bench.py --prefill"
 fi
 
+# fleet-serving gate: multi-replica routing must keep bitwise greedy
+# parity with the single session (including the disaggregated-prefill and
+# drain-mid-traffic arms), the affinity policy must beat uniform-random
+# on the aggregate prefix-trie hit rate, and a graceful drain under live
+# load must drop zero requests
+if python -c "import jax" >/dev/null 2>&1; then
+    echo "== bench.py --fleet (multi-replica routing + drain gate)"
+    out=$(python bench.py --fleet 2>/dev/null) || rc=1
+    echo "$out"
+    verdict=$(python - "$out" <<'PYEOF'
+import json, sys
+try:
+    r = json.loads(sys.argv[1].strip().splitlines()[-1])
+    if "error" in r:
+        print("error: " + r["error"])
+    elif not r.get("parity_greedy"):
+        print("fleet greedy ids diverge from the single-session run")
+    elif not r.get("affinity_beats_random"):
+        print(f"affinity hit rate {r.get('value')} does not beat random "
+              f"{r.get('random_hit_rate')}")
+    elif not r.get("drain_zero_drop"):
+        print(f"drain dropped {r.get('drain_dropped_requests')} request(s)")
+    elif not r.get("prefill_handoffs", 0) > 0:
+        print("disaggregated prefill never handed off a page")
+    elif r.get("perf_regression"):
+        print(f"committed-floor regression: {r.get('value')} is >10% below "
+              f"last-good {r.get('last_good_value')}")
+    else:
+        print("ok")
+except Exception as e:
+    print(f"unparseable: {e}")
+PYEOF
+)
+    if [ "$verdict" != "ok" ]; then
+        echo "static_checks: fleet gate failed ($verdict)"
+        rc=1
+    fi
+else
+    echo "static_checks: jax not importable; skipping bench.py --fleet"
+fi
+
 [ "$ran" = 0 ] && echo "static_checks: no external linters ran (configs still validated by CI tests)"
 exit $rc

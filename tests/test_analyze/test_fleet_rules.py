@@ -1,0 +1,136 @@
+"""Layer 6 fleet auditor goldens: FLEET001 (routed to tripped/draining
+replica), FLEET002 (KV handoff manifest mismatch), FLEET003 (orphaned
+pinned pages after drain).  Each known-bad fixture fires its rule exactly
+once; each clean fixture yields zero findings."""
+
+import numpy as np
+import pytest
+
+from easydist_tpu.analyze import (audit_drained_session, audit_page_handoff,
+                                  audit_routing, check_fleet_drain,
+                                  check_fleet_routing, check_page_handoff)
+from easydist_tpu.analyze.findings import AnalysisError
+from easydist_tpu.fleet import page_manifest
+from easydist_tpu.serve import PrefixCache
+
+CHUNK = 4
+
+
+def _decision(**kw):
+    d = {"request_id": 0, "replica_id": "d0", "breaker_state": "closed",
+         "draining": False, "affinity_tokens": 0, "prompt_tokens": 8,
+         "policy": "affinity"}
+    d.update(kw)
+    return d
+
+
+def _kv(fill=0.0):
+    return {"k": np.full((1, 2, CHUNK, 8), fill, np.float32),
+            "v": np.full((1, 2, CHUNK, 8), fill, np.float32)}
+
+
+def _path(n=1):
+    return [(tuple(range(j * CHUNK, (j + 1) * CHUNK)), _kv(float(j)))
+            for j in range(n)]
+
+
+class _Pool:
+    def __init__(self, trie):
+        self.trie = trie
+
+
+class _DrainedSession:
+    def __init__(self, trie, drained=True):
+        self._pools = {32: _Pool(trie)}
+        self.is_drained = drained
+
+
+class TestRouting:
+    def test_clean_log_zero_findings(self):
+        decisions = [_decision(request_id=i) for i in range(5)]
+        assert audit_routing(decisions) == []
+        assert check_fleet_routing(decisions) == []
+
+    def test_open_breaker_fires_once(self):
+        decisions = [_decision(), _decision(request_id=1,
+                                            breaker_state="open")]
+        findings = audit_routing(decisions)
+        assert len(findings) == 1
+        assert findings[0].rule_id == "FLEET001"
+        assert findings[0].severity == "error"
+        assert "request[1]" in findings[0].node
+
+    def test_draining_replica_fires_once(self):
+        findings = audit_routing([_decision(draining=True)])
+        assert len(findings) == 1 and findings[0].rule_id == "FLEET001"
+        assert "draining" in findings[0].message
+
+    def test_hook_raises_under_analyze_raise(self):
+        with pytest.raises(AnalysisError, match="FLEET001"):
+            check_fleet_routing([_decision(breaker_state="open")])
+
+
+class TestPageHandoff:
+    def test_clean_transfer_zero_findings(self):
+        path = _path(2)
+        m = page_manifest(path, src="p0", dst="d0")
+        assert audit_page_handoff(m, path) == []
+        assert check_page_handoff(m, path) == []
+
+    def test_corrupt_page_fires_once(self):
+        path = _path(1)
+        m = page_manifest(path)
+        path[0][1]["k"][0, 0, 0, 0] += 1.0
+        findings = audit_page_handoff(m, path, node="handoff[p0->d0]")
+        assert len(findings) == 1
+        assert findings[0].rule_id == "FLEET002"
+        assert findings[0].severity == "error"
+        assert "sha256" in findings[0].message
+
+    def test_hook_raises_under_analyze_raise(self):
+        path = _path(1)
+        m = page_manifest(path)
+        path[0][1]["v"][0, 0, 0, 0] += 1.0
+        with pytest.raises(AnalysisError, match="FLEET002"):
+            check_page_handoff(m, path)
+
+
+class TestDrainedSession:
+    def _trie_with_paths(self):
+        trie = PrefixCache(CHUNK, 1 << 20)
+        nodes = []
+        for j in range(2):
+            nodes.append(trie.commit(
+                nodes, list(range(j * CHUNK, (j + 1) * CHUNK)),
+                _kv(float(j))))
+        return trie, nodes
+
+    def test_unpinned_drained_trie_clean(self):
+        trie, _ = self._trie_with_paths()
+        sess = _DrainedSession(trie)
+        assert audit_drained_session(sess) == []
+        assert check_fleet_drain(sess) == []
+
+    def test_orphaned_pin_fires_once(self):
+        trie, nodes = self._trie_with_paths()
+        trie.pin([nodes[1]])  # retirement never unpinned it
+        findings = audit_drained_session(_DrainedSession(trie))
+        assert len(findings) == 1
+        assert findings[0].rule_id == "FLEET003"
+        assert findings[0].severity == "warning"
+        assert "refcount 1" in findings[0].message
+        assert "bucket[32]" in findings[0].node
+
+    def test_bookkeeping_drift_folds_in(self):
+        trie, _ = self._trie_with_paths()
+        trie.bytes_used += 17  # corrupt the counter
+        findings = audit_drained_session(_DrainedSession(trie))
+        assert len(findings) == 1
+        assert "byte accounting drift" in findings[0].message
+
+    def test_undrained_session_flagged(self):
+        trie, _ = self._trie_with_paths()
+        findings = audit_drained_session(_DrainedSession(trie,
+                                                         drained=False))
+        assert len(findings) == 1
+        assert "still holds live work" in findings[0].message

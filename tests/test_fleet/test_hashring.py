@@ -1,0 +1,65 @@
+"""Consistent-hash ring: deterministic routing, eligibility walk, and
+the bounded-remap property that makes cold-prefix placement drain-safe."""
+
+from easydist_tpu.fleet import HashRing, prefix_hash_key
+
+
+def _keys(n):
+    return [prefix_hash_key([i, i + 1, i + 2]) for i in range(n)]
+
+
+class TestPrefixHashKey:
+    def test_exact_over_token_ids(self):
+        assert prefix_hash_key([1, 2, 3]) == prefix_hash_key([1, 2, 3])
+        assert prefix_hash_key([1, 2, 3]) != prefix_hash_key([1, 2, 4])
+        # int width matters: [1] is not [0, 1] shifted
+        assert prefix_hash_key([1]) != prefix_hash_key([0, 1])
+
+    def test_empty_prefix_hashes(self):
+        assert isinstance(prefix_hash_key([]), int)
+
+
+class TestRing:
+    def test_route_deterministic(self):
+        ring = HashRing(["a", "b", "c"])
+        for k in _keys(50):
+            assert ring.route(k) == ring.route(k)
+
+    def test_all_replicas_reachable(self):
+        ring = HashRing(["a", "b", "c"], vnodes=64)
+        owners = {ring.route(k) for k in _keys(300)}
+        assert owners == {"a", "b", "c"}
+
+    def test_remove_only_remaps_victims_keys(self):
+        ring = HashRing(["a", "b", "c"])
+        keys = _keys(200)
+        before = {k: ring.route(k) for k in keys}
+        ring.remove("b")
+        for k in keys:
+            after = ring.route(k)
+            if before[k] != "b":
+                # a key that b did not own keeps its owner — drains do
+                # not reshuffle the surviving replicas' warm prefixes
+                assert after == before[k]
+            else:
+                assert after in ("a", "c")
+
+    def test_eligible_filter_walks_past_ineligible(self):
+        ring = HashRing(["a", "b", "c"])
+        for k in _keys(50):
+            got = ring.route(k, eligible=["c"])
+            assert got == "c"
+        assert ring.route(_keys(1)[0], eligible=[]) is None
+
+    def test_empty_ring_routes_none(self):
+        assert HashRing().route(123) is None
+        ring = HashRing(["a"])
+        ring.remove("a")
+        assert ring.route(123) is None
+
+    def test_add_after_remove(self):
+        ring = HashRing(["a"])
+        ring.remove("a")
+        ring.add("b")
+        assert ring.replicas() == ["b"]
+        assert ring.route(7) == "b"

@@ -1,0 +1,113 @@
+"""KV page transport: sha256 manifest round-trip, corruption detection
+down to single-bit payload flips, and the in-process transfer contract."""
+
+import numpy as np
+import pytest
+
+from easydist_tpu.fleet import (InProcessTransport, page_manifest,
+                                verify_manifest)
+
+CHUNK = 4
+
+
+def _kv(fill=0.0):
+    return {"k": np.full((1, 2, CHUNK, 8), fill, np.float32),
+            "v": np.full((1, 2, CHUNK, 8), fill, np.float32)}
+
+
+def _path(n=2):
+    return [(tuple(range(j * CHUNK, (j + 1) * CHUNK)), _kv(float(j)))
+            for j in range(n)]
+
+
+class TestManifest:
+    def test_roundtrip_clean(self):
+        path = _path()
+        m = page_manifest(path, src="p0", dst="d0")
+        assert m["src"] == "p0" and m["dst"] == "d0"
+        assert len(m["pages"]) == 2
+        assert verify_manifest(m, path) == []
+
+    def test_manifest_is_json_safe(self):
+        import json
+
+        json.dumps(page_manifest(_path()))  # no arrays leak in
+
+    def test_payload_bit_flip_detected(self):
+        path = _path()
+        m = page_manifest(path, src="p0", dst="d0")
+        path[1][1]["v"][0, 1, 2, 3] += 1e-7
+        problems = verify_manifest(m, path)
+        assert len(problems) == 1 and "sha256 mismatch" in problems[0]
+
+    def test_token_swap_detected(self):
+        path = _path()
+        m = page_manifest(path)
+        tokens, kv = path[0]
+        path[0] = (tokens[::-1], kv)
+        assert any("token ids differ" in p for p in verify_manifest(m, path))
+
+    def test_page_count_mismatch_detected(self):
+        path = _path(2)
+        m = page_manifest(path)
+        assert any("carries 1" in p for p in verify_manifest(m, path[:1]))
+
+    def test_dtype_change_detected(self):
+        path = _path()
+        m = page_manifest(path)
+        tokens, kv = path[0]
+        path[0] = (tokens, {k: v.astype(np.float64) for k, v in kv.items()})
+        assert verify_manifest(m, path)
+
+
+class _FakeSession:
+    def __init__(self):
+        self.imported = []
+
+    def import_prefix_path(self, prompt, path):
+        self.imported.append((list(prompt), list(path)))
+        return len(path)
+
+
+class TestInProcessTransport:
+    def test_transfer_verifies_and_commits(self):
+        tp = InProcessTransport()
+        dst = _FakeSession()
+        path = _path()
+        n = tp.transfer(path, dst, [0, 1, 2, 3, 4, 5, 6, 7, 9],
+                        src="p0", dst="d0")
+        assert n == 2 and tp.pages_moved == 2
+        assert len(dst.imported) == 1
+        assert len(tp.manifests) == 1
+        assert tp.manifests[0]["src"] == "p0"
+
+    def test_empty_path_is_noop(self):
+        tp = InProcessTransport()
+        assert tp.transfer([], _FakeSession(), [1, 2]) == 0
+        assert tp.manifests == []
+
+    def test_manifest_history_bounded(self):
+        tp = InProcessTransport(keep=3)
+        dst = _FakeSession()
+        for i in range(6):
+            tp.transfer(_path(1), dst, [i])
+        assert len(tp.manifests) == 3
+
+    def test_corrupt_page_raises(self, monkeypatch):
+        # corrupt the payload between manifest build and verify: FLEET002
+        # must stop the commit (analyze_raise defaults on)
+        import easydist_tpu.fleet.transport as tmod
+
+        real = tmod.page_manifest
+
+        def stale_manifest(path, src="?", dst="?"):
+            m = real(path, src=src, dst=dst)
+            path[0][1]["k"][0, 0, 0, 0] += 1.0  # flip AFTER hashing
+            return m
+
+        monkeypatch.setattr(tmod, "page_manifest", stale_manifest)
+        tp = InProcessTransport()
+        dst = _FakeSession()
+        with pytest.raises(Exception, match="FLEET002|corrupt"):
+            tp.transfer(_path(), dst, [0, 1, 2, 3, 4])
+        assert dst.imported == []  # nothing committed

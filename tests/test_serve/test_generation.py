@@ -100,13 +100,16 @@ class TestSignatureCache:
     def test_one_compiled_decode_step_across_tokens(self, model):
         cfg, params = model
         sess = _session(cfg, params)
+        # the store is shared with earlier same-model sessions via the
+        # process-level compile memo, so count growth, not absolute size
+        base = sess.stats()["decode_signatures"]["size"]
         f1 = sess.submit([1, 2, 3], max_new_tokens=5)
         sess.run_until_drained()
         sigs_after_first = sess.stats()["decode_signatures"]["size"]
         f2 = sess.submit([9, 8, 7, 6, 5], max_new_tokens=7)
         sess.run_until_drained()
         st = sess.stats()["decode_signatures"]
-        assert sigs_after_first == st["size"] == 1
+        assert sigs_after_first == st["size"] <= base + 1
         assert st["hits"] > st["misses"]
         f1.result(timeout=5), f2.result(timeout=5)
 
@@ -114,11 +117,12 @@ class TestSignatureCache:
         """Prompt lengths 2..8 collapse into the pow2 prefill pads."""
         cfg, params = model
         sess = _session(cfg, params)
+        base = sess.stats()["prefill_signatures"]["size"]  # shared store
         for n in (2, 3, 5, 7, 8):
             sess.submit(list(range(1, n + 1)), max_new_tokens=2)
         sess.run_until_drained()
-        # pads: 8 (for <=8) only -> exactly one prefill signature
-        assert sess.stats()["prefill_signatures"]["size"] == 1
+        # pads: 8 (for <=8) only -> at most one NEW prefill signature
+        assert sess.stats()["prefill_signatures"]["size"] <= base + 1
 
 
 class TestDonationAudit:
@@ -299,11 +303,12 @@ class TestPrefixReuse:
         program (fixed [rows, chunk] window) — no per-length retraces."""
         cfg, params = model
         sess = _session(cfg, params, config=_chunked_config(cfg))
+        base = sess.stats()["prefill_signatures"]["size"]  # shared store
         for n in (2, 3, 7, 9, 17):
             sess.submit(list(range(1, n + 1)), max_new_tokens=2)
         sess.run_until_drained()
         sig = sess.stats()["prefill_signatures"]
-        assert sig["size"] == 1 and sig["hits"] >= 4
+        assert sig["size"] <= base + 1 and sig["hits"] >= 4
 
     def test_ttft_recorded_per_request(self, model):
         cfg, params = model

@@ -168,3 +168,109 @@ class TestCountersAndInvariants:
     def test_chunk_key_is_exact_token_identity(self):
         assert chunk_key([1, 2, 3]) == (1, 2, 3)
         assert chunk_key(np.asarray([1, 2, 3])) == (1, 2, 3)
+
+
+class TestPinChurn:
+    """Satellite of the fleet PR: eviction pressure while pins come and
+    go must never evict a pinned page or corrupt the accounting."""
+
+    def test_eviction_pressure_under_pin_churn(self):
+        trie = PrefixCache(CHUNK, 3 * _KV_BYTES)
+        rng = np.random.RandomState(0)
+        pinned = []
+        for i in range(40):
+            tokens = rng.randint(0, 50, size=CHUNK).tolist()
+            node = trie.commit([], tokens, _kv(float(i)))
+            if node is not None and i % 3 == 0:
+                trie.pin([node])
+                pinned.append(node)
+            if pinned and i % 5 == 0:
+                trie.unpin([pinned.pop(0)])
+            # a pinned node must still be linked from the root
+            for p in pinned:
+                assert trie.lookup_node([], list(p.key)) is p
+            assert trie.bytes_used <= trie.byte_budget
+            assert trie.check_invariants() == []
+        for p in pinned:
+            trie.unpin(p and [p])
+        assert trie.check_invariants() == []
+
+    def test_pinned_path_survives_full_budget_sweep(self):
+        trie = PrefixCache(CHUNK, 2 * _KV_BYTES)
+        nodes = _commit_path(trie, [1, 2, 3, 4, 5, 6, 7, 8], 2)
+        trie.pin(nodes)
+        for i in range(10, 30):  # budget-filling churn
+            trie.commit([], [i] * CHUNK, _kv())
+        plen, got = trie.match([1, 2, 3, 4, 5, 6, 7, 8])
+        assert plen == 8 and got == nodes
+        trie.unpin(nodes)
+        assert trie.check_invariants() == []
+
+
+class TestExportImport:
+    """Fleet transfer surfaces: peek / export_path / hot_paths /
+    import_path, and the refcount contract across a round trip."""
+
+    def test_peek_matches_match_without_mutation(self):
+        trie = PrefixCache(CHUNK, 1 << 20)
+        _commit_path(trie, [1, 2, 3, 4, 5, 6, 7, 8], 2)
+        tick = trie._tick
+        hits = trie.hits
+        assert trie.peek([1, 2, 3, 4, 5, 6, 7, 8]) == 8
+        assert trie.peek([1, 2, 3, 4, 9, 9, 9, 9]) == 4
+        assert trie.peek([1, 2, 3, 4, 5, 6, 7, 8], max_tokens=7) == 4
+        assert trie.peek([9] * 8) == 0
+        assert trie._tick == tick and trie.hits == hits  # no LRU side effects
+
+    def test_export_import_roundtrip(self):
+        src = PrefixCache(CHUNK, 1 << 20)
+        prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+        _commit_path(src, prompt, 2)
+        path = src.export_path(prompt)
+        assert [list(k) for k, _ in path] == [[1, 2, 3, 4], [5, 6, 7, 8]]
+        dst = PrefixCache(CHUNK, 1 << 20)
+        assert dst.import_path(path) == 2
+        assert dst.peek(prompt) == 8
+        # bitwise: the destination serves the exact committed arrays
+        _, nodes = dst.match(prompt)
+        assert nodes[1].kv["k"][0, 0, 0, 0] == 1.0
+
+    def test_import_is_first_commit_wins(self):
+        src = PrefixCache(CHUNK, 1 << 20)
+        _commit_path(src, [1, 2, 3, 4], 1)
+        dst = PrefixCache(CHUNK, 1 << 20)
+        keep = dst.commit([], [1, 2, 3, 4], _kv(9.0))
+        assert dst.import_path(src.export_path([1, 2, 3, 4])) == 1
+        assert dst.lookup_node([], [1, 2, 3, 4]) is keep
+        assert keep.kv["k"][0, 0, 0, 0] == 9.0
+
+    def test_import_stops_at_budget_refusal(self):
+        src = PrefixCache(CHUNK, 1 << 20)
+        _commit_path(src, [1, 2, 3, 4, 5, 6, 7, 8], 2)
+        dst = PrefixCache(CHUNK, _KV_BYTES)  # room for one chunk only
+        n = dst.import_path(src.export_path([1, 2, 3, 4, 5, 6, 7, 8]))
+        assert n == 1
+        assert dst.peek([1, 2, 3, 4, 5, 6, 7, 8]) == 4
+        assert dst.check_invariants() == []
+
+    def test_refcounts_zero_after_roundtrip(self):
+        """Export/import must not leak pins on either side: both tries
+        stay fully evictable afterwards."""
+        src = PrefixCache(CHUNK, 1 << 20)
+        prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+        _commit_path(src, prompt, 2)
+        dst = PrefixCache(CHUNK, 1 << 20)
+        dst.import_path(src.export_path(prompt))
+        for trie in (src, dst):
+            assert trie.check_invariants() == []
+            assert all(n.refcount == 0 for n in trie._walk())
+
+    def test_hot_paths_orders_hottest_first(self):
+        trie = PrefixCache(CHUNK, 1 << 20)
+        _commit_path(trie, [1, 2, 3, 4, 5, 6, 7, 8], 2)
+        trie.commit([], [9, 9, 9, 9], _kv(5.0))
+        trie.match([9, 9, 9, 9])  # bump: the short path is now hottest
+        paths = trie.hot_paths()
+        assert len(paths) == 2
+        assert [list(k) for k, _ in paths[0]] == [[9, 9, 9, 9]]
+        assert [list(k) for k, _ in paths[1]] == [[1, 2, 3, 4], [5, 6, 7, 8]]
