@@ -1282,6 +1282,70 @@ def decode_main():
             f"speedup {speedup:.1f}x, parity={parity}, "
             f"signatures {sigs_warm}->{sigs_after}")
 
+        # ---- mixed-length high-occupancy: paged vs bucketed layouts.
+        # 8 prompts spanning 24..440 tokens through 8 slots at once; the
+        # bucketed layout pads each slot to its bucket and compiles one
+        # decode step per bucket, the paged layout maps just-enough
+        # 64-token pages and serves every length from ONE compiled step.
+        # Gates: bitwise greedy parity paged == bucketed, paged decode
+        # signature count == 1, paged tokens/s >= bucketed, paged
+        # bytes/seq strictly below bucketed.
+        from easydist_tpu.serve.batcher import select_bucket
+
+        m_buckets, m_chunk, m_new = (64, 128, 256, 512), 64, 16
+        m_lengths = [24, 40, 90, 150, 200, 300, 400, 440]
+        m_prompts = [rng.randint(0, cfg.vocab, size=L).tolist()
+                     for L in m_lengths]
+
+        def run_layout(layout):
+            sconf = ServeConfig(
+                decode_buckets=m_buckets, max_decode_slots=8,
+                prefill_chunk=m_chunk, prefill_batch=4,
+                kv_layout=layout,
+                kv_arena_pages=128 if layout == "paged" else 0)
+            s = GenerationSession.for_gpt(params, cfg, config=sconf)
+            # two warm waves (uncommitted->committed sharding signature,
+            # as above); they also seed the prefix trie, so the timed
+            # paged wave restores its prefixes by page mapping alone
+            for _ in range(2):
+                warm = [s.submit(p, max_new_tokens=2) for p in m_prompts]
+                s.run_until_drained()
+                [f.result(timeout=5) for f in warm]
+            t0 = time.perf_counter()
+            futs = [s.submit(p, max_new_tokens=m_new) for p in m_prompts]
+            s.run_until_drained()
+            wall = time.perf_counter() - t0
+            ids = [f.result(timeout=5)["ids"] for f in futs]
+            return s, ids, len(m_prompts) * m_new / wall
+
+        sess_b, ids_b, tps_b = run_layout("bucketed")
+        sess_p, ids_p, tps_p = run_layout("paged")
+
+        # slot bytes/seq, measured from the live pools: bucketed pins
+        # each request to a whole padded slot of its admission bucket;
+        # paged maps exactly the pages admission reserves
+        def bucketed_slot_bytes(bucket):
+            pool = sess_b._pools[bucket]
+            return sum(int(l.nbytes)
+                       for l in jax.tree_util.tree_leaves(pool.cache)) \
+                // pool.n_slots
+
+        bytes_b = sum(
+            bucketed_slot_bytes(select_bucket(len(p) + 1, m_buckets))
+            for p in m_prompts) / len(m_prompts)
+        ppool = next(iter(sess_p._pools.values()))
+        bytes_p = sum(
+            ppool.page_bytes * ppool.pages_needed(len(p), m_new)
+            for p in m_prompts) / len(m_prompts)
+
+        paged_parity = ids_p == ids_b
+        paged_sigs = sess_p.stats()["decode_signatures"]["size"]
+        psnap = sess_p.metrics.snapshot()
+        log(f"# decode bench (mixed): paged {tps_p:.1f} tok/s vs "
+            f"bucketed {tps_b:.1f}, bytes/seq {bytes_p:.0f} vs "
+            f"{bytes_b:.0f}, parity={paged_parity}, "
+            f"paged signatures {paged_sigs}")
+
         # MFU vs the calibrate-layer datasheet peak: ~2 FLOPs per param
         # per generated token (decode is matmul-dominated; the per-token
         # cache-attention term is negligible at this size).  None when the
@@ -1305,10 +1369,24 @@ def decode_main():
             tokens_generated=int(
                 snap["counters"].get("tokens_generated", 0)),
             slot_occupancy=snap["gauges"].get("decode_slot_occupancy"),
+            paged_parity_greedy=bool(paged_parity),
+            paged_signature_constant=bool(paged_sigs == 1),
+            paged_tokens_per_s=round(tps_p, 1),
+            bucketed_tokens_per_s=round(tps_b, 1),
+            paged_bytes_per_seq=round(bytes_p),
+            bucketed_bytes_per_seq=round(bytes_b),
+            kv_pages_in_use=psnap["gauges"].get("kv_pages_in_use"),
+            kv_page_utilization=psnap["gauges"].get(
+                "kv_page_utilization"),
+            copy_on_restore_bytes_saved=int(
+                psnap["counters"].get("copy_on_restore_bytes_saved", 0)),
             device=kind, mfu=mfu,
             seq=seq, prompt_len=prompt_len, max_new_tokens=max_new,
-            verdict="ok" if (speedup >= 5.0 and parity and sig_constant)
+            verdict="ok" if (speedup >= 5.0 and parity and sig_constant
+                             and paged_parity and paged_sigs == 1
+                             and tps_p >= tps_b and bytes_p < bytes_b)
             else "regression")
+        sess_p.metrics.export(sub_key="decode_bench_paged")
         sess.metrics.export(sub_key="decode_bench")
     except Exception as e:  # always land the JSON line
         import traceback
@@ -1393,6 +1471,27 @@ def prefill_main():
             f"off {ttft_off*1e3:.1f}ms "
             f"(wall {wall_on:.1f}s vs {wall_off:.1f}s)")
 
+        # paged-layout pass over the same traffic: the prefix restore is
+        # a host-side page-mapping, so every follower's restored bytes
+        # land in copy_on_restore_bytes_saved instead of a staging copy
+        sconf_p = ServeConfig(decode_buckets=(seq,), max_decode_slots=4,
+                              prefill_chunk=chunk, prefill_batch=4,
+                              kv_layout="paged", kv_arena_pages=64)
+        sess_p = GenerationSession.for_gpt(params, cfg, config=sconf_p)
+        wp = sess_p.submit(warm_prompt, max_new_tokens=1)
+        s0p = sess_p.submit(prompts[0], max_new_tokens=1)
+        sess_p.run_until_drained()
+        futs_p = [sess_p.submit(p, max_new_tokens=1)
+                  for p in prompts[1:]]
+        sess_p.run_until_drained()
+        wp.result(timeout=5)
+        ids_paged = [s0p.result(timeout=5)["ids"]] + \
+            [f.result(timeout=5)["ids"] for f in futs_p]
+        paged_saved = int(sess_p.metrics.snapshot()["counters"].get(
+            "copy_on_restore_bytes_saved", 0))
+        log(f"# prefill bench: paged copy_on_restore saved "
+            f"{paged_saved} bytes, parity={ids_paged == ids_on}")
+
         # full-re-forward reference first token for a prompt sample
         fwd = jax.jit(lambda t: gpt_apply(params, cfg, t))
         ref_ok = True
@@ -1431,11 +1530,14 @@ def prefill_main():
             trie_nodes=int(trie["nodes"]),
             trie_bytes=int(trie["bytes_used"]),
             trie_evictions=int(trie["evictions"]),
+            paged_parity_greedy=bool(ids_paged == ids_on),
+            copy_on_restore_bytes_saved=paged_saved,
             device=kind, mfu=mfu,
             seq=seq, shared_prefix_len=shared_len, n_requests=n_req,
             prefill_chunk=chunk,
             verdict="ok" if (speedup >= 2.0 and parity and ref_ok
-                             and sig_constant) else "regression")
+                             and sig_constant and ids_paged == ids_on
+                             and paged_saved > 0) else "regression")
         sess_on.metrics.export(sub_key="prefill_bench")
     except Exception as e:  # always land the JSON line
         import traceback

@@ -30,6 +30,13 @@ A rule-based analyzer that runs after solving and before execution
            length-masked attention over the full bucket window so stale
            cache rows cannot leak into live logits, prefix-trie
            refcount/byte-accounting integrity);
+  layer 7  paged-KV auditor (`audit_page_table`) — KV001 cross-checks
+           the paged decode cache's host bookkeeping (kv/pool.py page
+           refcounts, kv/table.py slot->page tables, prefix-trie page
+           references): a freed page under a live table entry, a page
+           with more holders than refcount, double frees, leaked pages,
+           or byte-conservation drift all mean one sequence silently
+           reads or reuses another's K/V;
   layer 6  fleet auditor (`audit_routing`, `audit_page_handoff`,
            `audit_drained_session`) — multi-replica serving hygiene:
            FLEET001 routing into a tripped-breaker/draining replica,
@@ -53,6 +60,7 @@ from .findings import (RULES, SEV_INFO, AnalysisError, AnalysisReport,
 from .fleet_rules import (audit_drained_session, audit_page_handoff,
                           audit_routing)
 from .jaxpr_rules import lint_bucket_plan, lint_fn, lint_jaxpr
+from .kv_rules import audit_page_table
 from .memory_rules import (audit_remat_plan, check_hbm_budget,
                            recompute_liveness, remat_advisory,
                            resolve_hbm_budget, verify_memory_plan)
@@ -84,6 +92,7 @@ __all__ = [
     "check_chunked_prefill", "check_prefix_cache",
     "audit_routing", "audit_page_handoff", "audit_drained_session",
     "check_fleet_routing", "check_page_handoff", "check_fleet_drain",
+    "audit_page_table", "check_page_table",
 ]
 
 
@@ -181,6 +190,24 @@ def check_prefix_cache(trie, node: str = "prefix_cache"):
     from easydist_tpu import config as edconfig
 
     findings = audit_prefix_cache(trie, node=node)
+    report = AnalysisReport(findings)
+    if report.errors() and edconfig.analyze_raise:
+        report.raise_on_errors()
+    for f in findings:
+        logger.warning("[analyze] %s", f)
+    return findings
+
+
+def check_page_table(pool, table, trie=None, node: str = "kv"):
+    """Runtime self-check hook for the paged KV session (KV001): audit
+    the page pool / page table / prefix-trie bookkeeping against each
+    other and raise (or log, with the escape hatch) on error findings —
+    serving on corrupt page accounting reads or frees another sequence's
+    K/V, bitwise-silently.  Returns the findings so callers/tests can
+    assert on them."""
+    from easydist_tpu import config as edconfig
+
+    findings = audit_page_table(pool, table, trie=trie, node=node)
     report = AnalysisReport(findings)
     if report.errors() and edconfig.analyze_raise:
         report.raise_on_errors()

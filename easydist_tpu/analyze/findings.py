@@ -124,6 +124,16 @@ RULES: Dict[str, tuple] = {
                  "(stale-row leakage — restored/garbage cache rows could "
                  "leak into live logits), or prefix-trie refcount/byte "
                  "accounting drift"),
+    # ---- layer 7: paged-KV auditor (page-table/refcount consistency,
+    #      analyze/kv_rules.py)
+    "KV001": (SEV_ERROR,
+              "paged-KV bookkeeping broken: a table entry points at a "
+              "freed page, a page has more holders (table rows + trie "
+              "refs) than its refcount, or the pool/table invariants "
+              "(double free, leaked page, byte conservation, hole in a "
+              "row's live prefix) fail — attention would read or the "
+              "allocator would reuse another sequence's K/V, "
+              "bitwise-silently"),
     # ---- layer 6: fleet auditor (multi-replica routing / KV handoff /
     #      drain hygiene, analyze/fleet_rules.py)
     "FLEET001": (SEV_ERROR,

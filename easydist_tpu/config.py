@@ -226,9 +226,10 @@ remat_policy = os.environ.get("EASYDIST_REMAT_POLICY", "none")
 # attention backend for the cache-carrying decode step: "auto" (Pallas
 # single-query flash kernel on TPU, masked dot_general elsewhere), "flash"
 # (force the kernel; interpreted off-TPU), "xla" (force the masked
-# dot_general path).  TRACE-AFFECTING: the backends emit different
-# programs for identical input shapes, so this is part of the
-# strategy-cache salt.
+# dot_general path), "paged" (force the page-gathering kernel in
+# `paged_decode_attention`; contiguous callers degrade to auto).
+# TRACE-AFFECTING: the backends emit different programs for identical
+# input shapes, so this is part of the strategy-cache salt.
 decode_attention_backend = os.environ.get("EASYDIST_DECODE_ATTENTION",
                                           "auto")
 # K/V rows streamed per grid step by the decode kernel (VMEM residency per

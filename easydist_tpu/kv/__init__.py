@@ -1,0 +1,38 @@
+"""Paged KV subsystem: a refcounted page-pool allocator over one
+preallocated HBM arena plus an int32 page-table indirection per live
+sequence.
+
+The slot-pool decode cache (serve/generation.py PR 8-11) pads every
+sequence to its bucket's max_len, so HBM per slot is worst-case and
+occupancy caps out under mixed-length traffic.  This package makes the
+fixed-size `page_tokens`-token KV page — already the unit the prefix trie
+commits and the fleet transport ships — THE allocation unit for decode
+storage too:
+
+  * `pool.PagePool` — host-side free-list allocator with per-page
+    refcounts.  Copy-on-write sharing with the prefix trie: a restored
+    prefix MAPS its committed pages into the sequence's page table
+    (refcount bump) instead of `dynamic_update_slice`-copying bytes, and
+    serving never writes a shared page (writes land at positions past the
+    restored prefix, in freshly allocated pages), so the "copy" half of
+    COW never runs on the serving path.
+  * `table.PageTable` — per-slot int32 page indices, fixed
+    [max_slots, max_pages] shape so the compiled decode step's signature
+    stays closed over arbitrary sequence lengths.  Unmapped entries hold
+    the sentinel `n_pages` (one past the arena): scatter writes through a
+    sentinel drop (`mode="drop"`), gathers clip and the garbage row is
+    masked to -inf before softmax.
+
+Arena layout matches the bucketed cache with pages replacing the batch
+axis — {"k","v"}: [layers, n_pages, (kv_)heads, page_tokens, head_dim] —
+so `kv_cache_specs` shards heads on "tp" identically for both layouts.
+Analyze rule KV001 (`analyze/kv_rules.py`) audits the pool/table/trie
+bookkeeping; `check_invariants` here is the raw audit it wraps.
+"""
+
+from __future__ import annotations
+
+from .pool import PagePool
+from .table import PageTable
+
+__all__ = ["PagePool", "PageTable"]

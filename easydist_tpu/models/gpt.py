@@ -376,6 +376,170 @@ def gpt_decode_step(params, cfg: GPTConfig, cache, token, pos):
     return cache, x.astype(jnp.float32) @ params["wte"].T
 
 
+# ------------------------------------------------------- paged KV decode
+#
+# Page-table variants of the serving forwards: K/V lives in one
+# preallocated page arena ({"k","v"}: [layers, n_pages, heads,
+# page_tokens, head_dim]) and each sequence's int32 page-table row says
+# which arena page holds each `page_tokens`-token window.  The arena is
+# threaded through (and donated) exactly like the contiguous cache; the
+# table is a few KiB of int32 pushed fresh each step.  Unmapped/dead
+# entries hold the sentinel `n_pages`: writes through it scatter with
+# mode="drop" (deterministically discarded), reads clip to a real page
+# whose rows the length mask zeroes before softmax.
+
+
+def init_kv_pages(cfg: GPTConfig, n_pages: int, page_tokens: int,
+                  dtype=None):
+    """Zeroed page arena {"k", "v"}: [layers, n_pages, heads, page_tokens,
+    head_dim].  Pages replace the batch axis of `init_kv_cache` at the
+    same dim index, so `kv_cache_specs` shards heads (dim 2) on "tp"
+    identically for both layouts."""
+    if n_pages < 1:
+        raise ValueError(f"n_pages must be >= 1, got {n_pages}")
+    if page_tokens < 1:
+        raise ValueError(f"page_tokens must be >= 1, got {page_tokens}")
+    hd = cfg.dim // cfg.heads
+    dt = jnp.dtype(cfg.dtype if dtype in (None, "auto") else dtype)
+    shape = (cfg.layers, n_pages, cfg.heads, page_tokens, hd)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def _pages_write_row(pages_layer, new, write_page, offset):
+    """Write one new K or V row per sequence through the page table:
+    pages_layer [n_pages, h, pt, hd], new [b, h, hd], write_page int32 [b]
+    (the arena page holding each row's current window; sentinel n_pages
+    for dead rows), offset int32 [b] (position within the page).  The two
+    advanced indices put the batch dim in front of the update, and
+    mode="drop" discards sentinel writes — dead rows touch nothing."""
+    return pages_layer.at[write_page, :, offset, :].set(
+        new.astype(pages_layer.dtype), mode="drop")
+
+
+def _pages_write_chunk(pages_layer, new, write_page):
+    """Write one full page-sized chunk of K or V per sequence:
+    pages_layer [n_pages, h, pt, hd], new [b, h, pt, hd], write_page
+    int32 [b].  Chunked prefill is page-aligned by construction
+    (page_tokens == prefill chunk), so a chunk always fills exactly one
+    freshly-allocated page; sentinel rows drop."""
+    return pages_layer.at[write_page].set(
+        new.astype(pages_layer.dtype), mode="drop")
+
+
+def gpt_prefill_chunk_paged(params, cfg: GPTConfig, pages, table, tokens,
+                            start_pos, lengths):
+    """`gpt_prefill_chunk` with the cache indirected through a page table:
+    `pages` is the arena, `table` int32 [batch, max_pages] maps each row's
+    windows to arena pages (sentinel-padded), and the chunk's K/V is
+    written INTO the row's own page for window `start_pos // page_tokens`
+    — there is no staging cache and no migrate/restore copy on the paged
+    path; a restored prefix is just table entries pointing at the trie's
+    committed pages.  Attention gathers the virtual contiguous cache
+    [batch, heads, max_pages * page_tokens, head_dim] through the table,
+    so when that length equals the bucketed window the lowered program
+    matches `gpt_prefill_chunk` shape-for-shape and the logits are
+    bitwise identical.  Requires tokens.shape[1] == page_tokens."""
+    from easydist_tpu.ops import chunk_attention, gather_pages
+
+    dtype = jnp.dtype(cfg.dtype)
+    heads = cfg.heads
+    b, c_len = tokens.shape
+    pt = pages["k"].shape[3]
+    if c_len != pt:
+        raise ValueError(f"paged prefill chunk {c_len} != page_tokens {pt} "
+                         f"(chunks must fill exactly one page)")
+    hd = cfg.dim // heads
+    start = start_pos.astype(jnp.int32)
+    tbl = table.astype(jnp.int32)
+    # the page receiving this chunk: the row's window start // page_tokens
+    # (sentinel for inactive rows -> the writes drop)
+    wp = jnp.take_along_axis(tbl, (start // pt)[:, None], axis=1)[:, 0]
+    abs_pos = start[:, None] + jnp.arange(c_len, dtype=jnp.int32)[None, :]
+    x = params["wte"][tokens].astype(dtype) \
+        + params["wpe"][abs_pos].astype(dtype)
+    new_k, new_v = [], []
+    for li, blk in enumerate(_block_list(params, cfg)):
+        p_at = blk["attn"]
+        h_in = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"]).astype(dtype)
+        qkv = h_in @ p_at["qkv"]["w"].astype(dtype) \
+            + p_at["qkv"]["b"].astype(dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, c_len, heads, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(b, c_len, heads, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(b, c_len, heads, hd).transpose(0, 2, 1, 3)
+        pk = _pages_write_chunk(pages["k"][li], k, wp)
+        pv = _pages_write_chunk(pages["v"][li], v, wp)
+        new_k.append(pk)
+        new_v.append(pv)
+        # gather AFTER the write so the chunk attends its own fresh page
+        ck = gather_pages(pk, tbl)
+        cv = gather_pages(pv, tbl)
+        att = chunk_attention(q, ck.astype(dtype), cv.astype(dtype),
+                              abs_pos)
+        att = att.transpose(0, 2, 1, 3).reshape(b, c_len, cfg.dim)
+        x = x + (att @ p_at["proj"]["w"].astype(dtype)
+                 + p_at["proj"]["b"].astype(dtype))
+        h = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"]).astype(dtype)
+        h = jax.nn.gelu(h @ blk["mlp"]["fc"]["w"].astype(dtype)
+                        + blk["mlp"]["fc"]["b"].astype(dtype))
+        x = x + (h @ blk["mlp"]["proj"]["w"].astype(dtype)
+                 + blk["mlp"]["proj"]["b"].astype(dtype))
+    pages = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
+    rel_last = jnp.clip(lengths.astype(jnp.int32) - 1 - start, 0, c_len - 1)
+    last = jnp.take_along_axis(x, rel_last[:, None, None], axis=1)[:, 0]
+    return pages, last.astype(jnp.float32) @ params["wte"].T
+
+
+def gpt_decode_step_paged(params, cfg: GPTConfig, pages, table, token, pos):
+    """`gpt_decode_step` against the page arena: the new token's K/V row
+    lands in the page holding window `pos // page_tokens` at offset
+    `pos % page_tokens`, and attention runs through
+    `ops.paged_decode_attention` (page-gathering Pallas kernel on TPU,
+    gather + masked dot_general elsewhere).  The table's fixed
+    [batch, max_pages] shape keeps ONE compiled signature across
+    arbitrary per-row lengths — the whole point of the paged pool."""
+    from easydist_tpu.ops import paged_decode_attention
+
+    dtype = jnp.dtype(cfg.dtype)
+    heads = cfg.heads
+    b = token.shape[0]
+    pt = pages["k"].shape[3]
+    hd = cfg.dim // heads
+    pos = pos.astype(jnp.int32)
+    tbl = table.astype(jnp.int32)
+    wp = jnp.take_along_axis(tbl, (pos // pt)[:, None], axis=1)[:, 0]
+    off = pos % pt
+    x = params["wte"][token].astype(dtype) \
+        + params["wpe"][pos].astype(dtype)
+    new_k, new_v = [], []
+    for li, blk in enumerate(_block_list(params, cfg)):
+        p_at = blk["attn"]
+        h_in = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"]).astype(dtype)
+        qkv = h_in @ p_at["qkv"]["w"].astype(dtype) \
+            + p_at["qkv"]["b"].astype(dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, heads, hd)
+        pk = _pages_write_row(pages["k"][li], k.reshape(b, heads, hd),
+                              wp, off)
+        pv = _pages_write_row(pages["v"][li], v.reshape(b, heads, hd),
+                              wp, off)
+        new_k.append(pk)
+        new_v.append(pv)
+        att = paged_decode_attention(q, pk.astype(dtype), pv.astype(dtype),
+                                     tbl, pos + 1)
+        x = x + (att.reshape(b, cfg.dim) @ p_at["proj"]["w"].astype(dtype)
+                 + p_at["proj"]["b"].astype(dtype))
+        h = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"]).astype(dtype)
+        h = jax.nn.gelu(h @ blk["mlp"]["fc"]["w"].astype(dtype)
+                        + blk["mlp"]["fc"]["b"].astype(dtype))
+        x = x + (h @ blk["mlp"]["proj"]["w"].astype(dtype)
+                 + blk["mlp"]["proj"]["b"].astype(dtype))
+    pages = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
+    return pages, x.astype(jnp.float32) @ params["wte"].T
+
+
 def gpt_loss(params, cfg: GPTConfig, tokens, targets):
     logits = gpt_apply(params, cfg, tokens)
     logp = jax.nn.log_softmax(logits, axis=-1)

@@ -330,6 +330,140 @@ def llama_decode_step(params, cfg: LlamaConfig, cache, token, pos):
     return cache, x.astype(jnp.float32) @ params["wte"].T
 
 
+# ------------------------------------------------------- paged KV decode
+#
+# Page-table variants (the llama mirror of gpt.py's): the arena stores
+# ROPED keys at kv_heads granularity — [layers, n_pages, kv_heads,
+# page_tokens, head_dim] — so page HBM scales with kv_heads and the GQA
+# repeat happens at attention time, matching the bucketed path's
+# repeat-then-attend order bitwise.
+
+
+def init_kv_pages(cfg: LlamaConfig, n_pages: int, page_tokens: int,
+                  dtype=None):
+    """Zeroed page arena {"k", "v"}: [layers, n_pages, kv_heads,
+    page_tokens, head_dim]."""
+    if n_pages < 1:
+        raise ValueError(f"n_pages must be >= 1, got {n_pages}")
+    if page_tokens < 1:
+        raise ValueError(f"page_tokens must be >= 1, got {page_tokens}")
+    hd = cfg.dim // cfg.heads
+    dt = jnp.dtype(cfg.dtype if dtype in (None, "auto") else dtype)
+    shape = (cfg.layers, n_pages, cfg.kv_heads, page_tokens, hd)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def _pages_write_row(pages_layer, new, write_page, offset):
+    """pages_layer [n_pages, n, pt, hd], new [b, n, hd], write_page/offset
+    int32 [b]; sentinel write_page entries drop (dead rows)."""
+    return pages_layer.at[write_page, :, offset, :].set(
+        new.astype(pages_layer.dtype), mode="drop")
+
+
+def _pages_write_chunk(pages_layer, new, write_page):
+    """pages_layer [n_pages, n, pt, hd], new [b, n, pt, hd], write_page
+    int32 [b] — one full page per chunk; sentinel rows drop."""
+    return pages_layer.at[write_page].set(
+        new.astype(pages_layer.dtype), mode="drop")
+
+
+def llama_prefill_chunk_paged(params, cfg: LlamaConfig, pages, table,
+                              tokens, start_pos, lengths):
+    """`llama_prefill_chunk` through a page table: the chunk's roped K and
+    V fill the row's own page for window `start_pos // page_tokens` (no
+    staging cache, no restore copy), and attention gathers the virtual
+    contiguous cache through the table, GQA-repeated after the gather.
+    Requires tokens.shape[1] == page_tokens."""
+    from easydist_tpu.ops import chunk_attention, gather_pages
+
+    dtype = jnp.dtype(cfg.dtype)
+    b, c_len = tokens.shape
+    pt = pages["k"].shape[3]
+    if c_len != pt:
+        raise ValueError(f"paged prefill chunk {c_len} != page_tokens {pt} "
+                         f"(chunks must fill exactly one page)")
+    hd = cfg.dim // cfg.heads
+    start = start_pos.astype(jnp.int32)
+    tbl = table.astype(jnp.int32)
+    wp = jnp.take_along_axis(tbl, (start // pt)[:, None], axis=1)[:, 0]
+    abs_pos = start[:, None] + jnp.arange(c_len, dtype=jnp.int32)[None, :]
+    x = params["wte"][tokens].astype(dtype)
+    new_k, new_v = [], []
+    for li, blk in enumerate(params["blocks"]):
+        hx = _rmsnorm(x, blk["attn_norm"]).astype(dtype)
+
+        def heads(y, n):
+            return y.reshape(b, c_len, n, hd).transpose(0, 2, 1, 3)
+
+        q = heads(hx @ blk["wq"].astype(dtype), cfg.heads)
+        k = heads(hx @ blk["wk"].astype(dtype), cfg.kv_heads)
+        v = heads(hx @ blk["wv"].astype(dtype), cfg.kv_heads)
+        q = _rope_abs(q.astype(jnp.float32), abs_pos,
+                      cfg.rope_theta).astype(dtype)
+        k = _rope_abs(k.astype(jnp.float32), abs_pos,
+                      cfg.rope_theta).astype(dtype)
+        pk = _pages_write_chunk(pages["k"][li], k, wp)
+        pv = _pages_write_chunk(pages["v"][li], v, wp)
+        new_k.append(pk)
+        new_v.append(pv)
+        kf = gather_pages(pk, tbl, n_heads=cfg.heads).astype(dtype)
+        vf = gather_pages(pv, tbl, n_heads=cfg.heads).astype(dtype)
+        att = chunk_attention(q, kf, vf, abs_pos)
+        out = att.transpose(0, 2, 1, 3).reshape(b, c_len, cfg.heads * hd)
+        x = x + out @ blk["wo"].astype(dtype)
+        hx = _rmsnorm(x, blk["ffn_norm"]).astype(dtype)
+        gated = jax.nn.silu(hx @ blk["w_gate"].astype(dtype)) \
+            * (hx @ blk["w_up"].astype(dtype))
+        x = x + gated @ blk["w_down"].astype(dtype)
+    pages = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    x = _rmsnorm(x, params["norm_f"])
+    rel_last = jnp.clip(lengths.astype(jnp.int32) - 1 - start, 0, c_len - 1)
+    last = jnp.take_along_axis(x, rel_last[:, None, None], axis=1)[:, 0]
+    return pages, last.astype(jnp.float32) @ params["wte"].T
+
+
+def llama_decode_step_paged(params, cfg: LlamaConfig, pages, table, token,
+                            pos):
+    """`llama_decode_step` against the page arena: the new roped K/V row
+    lands at window `pos // page_tokens`, offset `pos % page_tokens`, and
+    attention runs through `ops.paged_decode_attention` (the kernel maps
+    query head -> kv head in its index maps; the fallback gathers then
+    GQA-repeats, bitwise-matching the bucketed repeat-then-attend)."""
+    from easydist_tpu.ops import paged_decode_attention
+
+    dtype = jnp.dtype(cfg.dtype)
+    b = token.shape[0]
+    pt = pages["k"].shape[3]
+    hd = cfg.dim // cfg.heads
+    pos = pos.astype(jnp.int32)
+    tbl = table.astype(jnp.int32)
+    wp = jnp.take_along_axis(tbl, (pos // pt)[:, None], axis=1)[:, 0]
+    off = pos % pt
+    x = params["wte"][token].astype(dtype)
+    new_k, new_v = [], []
+    for li, blk in enumerate(params["blocks"]):
+        hx = _rmsnorm(x, blk["attn_norm"]).astype(dtype)
+        q = (hx @ blk["wq"].astype(dtype)).reshape(b, cfg.heads, hd)
+        k = (hx @ blk["wk"].astype(dtype)).reshape(b, cfg.kv_heads, hd)
+        v = (hx @ blk["wv"].astype(dtype)).reshape(b, cfg.kv_heads, hd)
+        q = _rope_at(q.astype(jnp.float32), pos, cfg.rope_theta).astype(dtype)
+        k = _rope_at(k.astype(jnp.float32), pos, cfg.rope_theta).astype(dtype)
+        pk = _pages_write_row(pages["k"][li], k, wp, off)
+        pv = _pages_write_row(pages["v"][li], v, wp, off)
+        new_k.append(pk)
+        new_v.append(pv)
+        att = paged_decode_attention(q, pk.astype(dtype), pv.astype(dtype),
+                                     tbl, pos + 1)
+        x = x + att.reshape(b, cfg.heads * hd) @ blk["wo"].astype(dtype)
+        hx = _rmsnorm(x, blk["ffn_norm"]).astype(dtype)
+        gated = jax.nn.silu(hx @ blk["w_gate"].astype(dtype)) \
+            * (hx @ blk["w_up"].astype(dtype))
+        x = x + gated @ blk["w_down"].astype(dtype)
+    pages = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    x = _rmsnorm(x, params["norm_f"])
+    return pages, x.astype(jnp.float32) @ params["wte"].T
+
+
 def llama_loss(params, cfg: LlamaConfig, tokens, targets):
     logits = llama_apply(params, cfg, tokens)
     logp = jax.nn.log_softmax(logits, axis=-1)

@@ -1,4 +1,6 @@
 """Pallas TPU kernels for hot ops."""
 
 from .flash_attention import (chunk_attention, decode_attention,  # noqa: F401
-                              flash_attention, flash_decode_attention)
+                              flash_attention, flash_decode_attention,
+                              flash_paged_decode_attention, gather_pages,
+                              paged_decode_attention)

@@ -532,6 +532,10 @@ def decode_attention(q, k, v, lengths, scale: Optional[float] = None,
         lengths = jnp.broadcast_to(lengths, (q.shape[0],))
     if backend is None:
         backend = edconfig.decode_attention_backend
+    if backend == "paged":
+        # "paged" selects the page-gathering kernel in paged_decode_attention;
+        # contiguous callers degrade to auto (there is no table to chase)
+        backend = "auto"
     if backend == "auto":
         backend = "flash" if jax.default_backend() == "tpu" else "xla"
     if backend == "flash":
@@ -539,7 +543,186 @@ def decode_attention(q, k, v, lengths, scale: Optional[float] = None,
     if backend == "xla":
         return _decode_attention_xla(q, k, v, lengths, scale)
     raise ValueError(f"unknown decode attention backend {backend!r}; "
-                     f"expected auto|flash|xla")
+                     f"expected auto|flash|xla|paged")
+
+
+# ------------------------------------------------- paged decode
+
+
+def gather_pages(pages, table, n_heads: Optional[int] = None):
+    """Materialize the contiguous "virtual cache" a page table describes.
+
+    pages: [n_pages, kv_heads, page_tokens, d] (one layer of the arena);
+    table: int32 [batch, max_pages] arena page per window (sentinel
+    `n_pages` for unmapped).  Returns [batch, heads, max_pages *
+    page_tokens, d]: sentinel entries clip to the last real page, whose
+    rows sit at masked positions (>= the row's length) so their softmax
+    weight is exactly zero — garbage values are unobservable as long as
+    they are finite, which arena zeros/stale KV always are.  `n_heads`
+    repeats kv_heads GQA-style AFTER the gather, matching the bucketed
+    llama path's repeat-then-attend order bitwise."""
+    n_pages, kvh, pt, d = pages.shape
+    b, mp = table.shape
+    idx = jnp.clip(table.astype(jnp.int32), 0, n_pages - 1)
+    v = jnp.take(pages, idx, axis=0)                 # [b, mp, kvh, pt, d]
+    v = v.transpose(0, 2, 1, 3, 4).reshape(b, kvh, mp * pt, d)
+    if n_heads is not None and n_heads != kvh:
+        v = jnp.repeat(v, n_heads // kvh, axis=1)
+    return v
+
+
+def _paged_decode_attention_xla(q, k_pages, v_pages, table, lengths,
+                                scale: float):
+    """Gather-then-mask fallback: reconstruct the virtual contiguous cache
+    through the page table, then run the exact `_decode_attention_xla`
+    einsum.  When max_pages * page_tokens equals the bucketed cache
+    length, every downstream shape (and therefore the lowered reduction
+    order) matches the bucketed path — the bitwise-parity spine of the
+    paged serving tests."""
+    h = q.shape[1]
+    kf = gather_pages(k_pages, table, n_heads=h)
+    vf = gather_pages(v_pages, table, n_heads=h)
+    return _decode_attention_xla(q, kf, vf, lengths, scale)
+
+
+def _flash_paged_decode_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref,
+                               o_ref, o_scr, m_scr, l_scr, *, scale: float,
+                               page_tokens: int, n_pages_max: int):
+    """The single-query decode kernel with the K/V stream indirected
+    through the page table: grid step pi wants the page holding tokens
+    [pi*pt, (pi+1)*pt), and the BlockSpec index map (not the kernel body)
+    resolves it via the scalar-prefetched table, so dead windows clamp to
+    a repeated index and Pallas skips their DMA entirely."""
+    bi = pl.program_id(0)
+    pi = pl.program_id(2)
+
+    @pl.when(pi == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        o_scr[...] = jnp.zeros_like(o_scr)
+
+    length = len_ref[bi]
+
+    @pl.when(pi * page_tokens < length)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale        # [1, d]
+        k_blk = k_ref[0, 0].astype(jnp.float32)         # [pt, d]
+        v_blk = v_ref[0, 0].astype(jnp.float32)
+        s = q @ k_blk.T                                 # [1, pt]
+        k_pos = pi * page_tokens + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(k_pos < length, s, _NEG_INF)
+        m_prev = m_scr[...]
+        l_prev = l_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[...] = m_new
+        l_scr[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        o_scr[...] = o_scr[...] * alpha + p @ v_blk
+
+    @pl.when(pi == n_pages_max - 1)
+    def _write():
+        o_ref[0] = (o_scr[...] / jnp.maximum(l_scr[...], 1e-30)).astype(
+            o_ref.dtype)
+
+
+def flash_paged_decode_attention(q, k_pages, v_pages, table, lengths,
+                                 scale: Optional[float] = None,
+                                 interpret: Optional[bool] = None):
+    """Single-query flash attention through a page table (paged decode).
+
+    q: [batch, heads, head_dim]; k_pages/v_pages: [n_pages, kv_heads,
+    page_tokens, head_dim] arena layers; table: int32 [batch, max_pages];
+    lengths: int32 [batch].  The table and lengths ride
+    `PrefetchScalarGridSpec` scalar prefetch: they land in SMEM before the
+    grid runs, so the K/V BlockSpec index maps can chase the indirection
+    and clamp dead windows (>= the row's live page count) to the last
+    live page — a repeated index that Pallas serves without re-DMA, the
+    paged extension of the contiguous kernel's dead-block skip.  GQA maps
+    query head hi to kv head hi // (heads // kv_heads) in the same index
+    maps.  Returns [batch, heads, head_dim]."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, h, d = q.shape
+    n_pages, kvh, pt, _ = k_pages.shape
+    mp = table.shape[1]
+    if h % kvh:
+        raise ValueError(f"heads {h} not a multiple of kv_heads {kvh}")
+    rep = h // kvh
+    tbl = jnp.asarray(table, jnp.int32)
+    lens = jnp.asarray(lengths, jnp.int32)
+
+    def kv_map(bi, hi, pi, tbl_ref, len_ref):
+        # dead windows (pi past the row's live pages) clamp to the last
+        # live one: repeated index -> no DMA; @pl.when skips the compute
+        last_live = jnp.maximum(
+            jax.lax.div(len_ref[bi] + pt - 1, pt) - 1, 0)
+        page = tbl_ref[bi, jnp.minimum(pi, last_live)]
+        return (jnp.clip(page, 0, n_pages - 1), hi // rep, 0, 0)
+
+    kernel = functools.partial(_flash_paged_decode_kernel, scale=scale,
+                               page_tokens=pt, n_pages_max=mp)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h, mp),
+        in_specs=[
+            pl.BlockSpec((1, 1, d),
+                         lambda bi, hi, pi, tbl_ref, len_ref: (bi, hi, 0)),
+            pl.BlockSpec((1, 1, pt, d), kv_map),
+            pl.BlockSpec((1, 1, pt, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, d), lambda bi, hi, pi, tbl_ref, len_ref: (bi, hi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, d), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        compiler_params=tpu_compiler_params(
+            pltpu,
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(tbl, lens, q, k_pages, v_pages)
+    return out
+
+
+def paged_decode_attention(q, k_pages, v_pages, table, lengths,
+                           scale: Optional[float] = None,
+                           backend: Optional[str] = None):
+    """Backend-dispatching paged decode attention (the models' paged
+    decode steps call this): the Pallas page-gathering kernel on TPU, the
+    gather + masked dot_general path elsewhere.
+    `EASYDIST_DECODE_ATTENTION` forces it — "paged"/"flash" pick the
+    kernel, "xla" the gather fallback — and the value rides the same
+    strategy-cache salt entry as the contiguous knob."""
+    from easydist_tpu import config as edconfig
+
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    lengths = jnp.asarray(lengths, jnp.int32)
+    if lengths.ndim == 0:
+        lengths = jnp.broadcast_to(lengths, (q.shape[0],))
+    if backend is None:
+        backend = edconfig.decode_attention_backend
+    if backend == "auto":
+        backend = "paged" if jax.default_backend() == "tpu" else "xla"
+    if backend in ("paged", "flash"):
+        return flash_paged_decode_attention(q, k_pages, v_pages, table,
+                                            lengths, scale=scale)
+    if backend == "xla":
+        return _paged_decode_attention_xla(q, k_pages, v_pages, table,
+                                           lengths, scale)
+    raise ValueError(f"unknown paged decode attention backend {backend!r}; "
+                     f"expected auto|paged|flash|xla")
 
 
 def _chunk_attention_xla(q, k, v, q_pos, scale: float):

@@ -94,6 +94,17 @@ class ServeConfig:
         position 0 (bitwise-identical outputs either way).
     prefix_cache_bytes: LRU byte budget per decode bucket's trie; 0
         disables committing.
+    kv_layout: decode KV storage — "bucketed" (one padded slot pool per
+        decode bucket, the PR 8-11 layout) or "paged" (ONE page-granular
+        pool over a preallocated arena: arbitrary lengths in one compiled
+        decode step, no bucket padding, zero-copy prefix restore; needs
+        the paged model callables `for_gpt`/`for_llama` wire).
+    kv_page_tokens: tokens per KV page in the paged layout; 0 = the
+        effective prefill chunk (pages ARE the prefix-trie chunks, which
+        is what makes restore a pure table mapping).
+    kv_arena_pages: arena size in pages; 0 = auto
+        (max_decode_slots * pages-per-sequence + one sequence's worth of
+        headroom for trie-held pages).
     """
     batch_buckets: Tuple[int, ...] = (1, 2, 4, 8)
     seq_buckets: Optional[Tuple[int, ...]] = None
@@ -118,6 +129,9 @@ class ServeConfig:
     prefill_chunks_per_step: int = 4
     enable_prefix_cache: bool = True
     prefix_cache_bytes: int = 64 * 2**20
+    kv_layout: str = "bucketed"
+    kv_page_tokens: int = 0
+    kv_arena_pages: int = 0
 
     def __post_init__(self):
         if not self.batch_buckets:
@@ -177,6 +191,33 @@ class ServeConfig:
         if self.prefix_cache_bytes < 0:
             raise ValueError(f"prefix_cache_bytes must be >= 0 "
                              f"(0 disables), got {self.prefix_cache_bytes}")
+        if self.kv_layout not in ("bucketed", "paged"):
+            raise ValueError(f"kv_layout must be 'bucketed' or 'paged', "
+                             f"got {self.kv_layout!r}")
+        if self.kv_page_tokens < 0:
+            raise ValueError(f"kv_page_tokens must be >= 0 (0 = the "
+                             f"effective prefill chunk), "
+                             f"got {self.kv_page_tokens}")
+        if self.kv_arena_pages < 0:
+            raise ValueError(f"kv_arena_pages must be >= 0 (0 = auto), "
+                             f"got {self.kv_arena_pages}")
+        if self.kv_layout == "paged":
+            cap = max(self.decode_buckets)
+            pt = self.kv_page_tokens or min(self.prefill_chunk, cap)
+            if pt != min(self.prefill_chunk, cap):
+                # pages ARE the prefix-trie chunks: a paged prefill chunk
+                # fills exactly one page, and a restored trie node maps
+                # exactly one page — different granularities would force
+                # copy-on-restore back in
+                raise ValueError(
+                    f"kv_page_tokens {pt} must equal the effective "
+                    f"prefill chunk {min(self.prefill_chunk, cap)} in the "
+                    f"paged layout (pages are the trie chunks)")
+            if cap % pt != 0:
+                raise ValueError(
+                    f"max decode bucket {cap} is not a multiple of "
+                    f"kv_page_tokens {pt}; pages must tile the sequence "
+                    f"capacity exactly")
 
 
 class ServeEngine:

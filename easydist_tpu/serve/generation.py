@@ -25,6 +25,18 @@ the serving half of the cache-carrying model API
   * **bucketed KV pool + one compiled decode step** — unchanged from
     PR 9: one slot pool per `ServeConfig.decode_buckets` entry, decode
     always steps ALL slots, slots recycle through a free list;
+  * **paged KV pool** (`ServeConfig.kv_layout="paged"`) — ALL buckets
+    collapse into ONE page-granular pool over a preallocated arena
+    (kv/pool.py + kv/table.py): sequences of any length share one
+    compiled decode step (the int32 page table, fixed
+    [max_slots, max_pages], is the only per-step state that varies), a
+    restored prefix is table entries pointing at trie-committed pages
+    (zero copies — the bucketed path `dynamic_update_slice`-copies every
+    restored chunk), and prefill writes arena pages directly through the
+    table (no staging cache, no migrate).  Admission reserves every page
+    a sequence can ever touch up front, so the table row is static for
+    the slot's life; analyze rule KV001 audits the refcount/table
+    bookkeeping at first decode and every retire;
   * **donated caches** — pool and staging are positional arg 0 and
     output 0 of their compiled callables, so `infer_state_io` pairs and
     donates them; XLA updates in place instead of copying.  `analyze`
@@ -48,6 +60,8 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
+
+from easydist_tpu.kv import PagePool, PageTable
 
 from .admission import ReplicaDrainingError, RequestTooLargeError
 from .batcher import select_bucket
@@ -135,6 +149,89 @@ class _BucketPool:
         return len(self.slots)
 
 
+class _PagedPool:
+    """The paged layout's single pool: one preallocated page arena, a
+    refcounted page allocator, and a fixed [n_slots, max_pages] page
+    table shared by every request regardless of length (`bucket` is the
+    capacity cap — max(decode_buckets) — not a padding granularity).
+    Prefill jobs write arena pages directly through the table, so there
+    is no staging cache and no migrate; a restored prefix is table
+    entries pointing at trie-committed pages (zero-copy)."""
+
+    def __init__(self, bucket: int, n_slots: int, init_pages,
+                 n_rows: int, chunk: int, prefix_bytes: int,
+                 n_pages: int):
+        self.bucket = bucket
+        self.n_slots = n_slots
+        self.chunk = chunk                       # page_tokens
+        self.max_pages = bucket // chunk
+        if n_pages < self.max_pages:
+            raise ValueError(
+                f"kv_arena_pages {n_pages} cannot hold even one "
+                f"full-length sequence ({self.max_pages} pages)")
+        self.n_rows = n_rows
+        self.arena = init_pages(n_pages, chunk)
+        self.page_bytes = sum(int(self.arena[k].nbytes) // n_pages
+                              for k in ("k", "v"))
+        self.pool = PagePool(n_pages, chunk, page_bytes=self.page_bytes)
+        self.table = PageTable(n_slots, self.max_pages, n_pages)
+        self.free: List[int] = list(range(n_slots))
+        self.slots: Dict[int, _Slot] = {}
+        self.free_rows: List[int] = list(range(n_rows))
+        self.jobs: Dict[int, _PrefillJob] = {}
+        self.trie: Optional[PrefixCache] = \
+            PrefixCache(chunk, prefix_bytes,
+                        on_evict=self._release_evicted) \
+            if prefix_bytes else None
+
+    def _release_evicted(self, node) -> None:
+        # trie eviction drops the trie's hold on the node's arena page;
+        # the page only frees when no live slot still maps it
+        self.pool.release(node.kv["page"])
+
+    @property
+    def n_active(self) -> int:
+        return len(self.slots)
+
+    def pages_needed(self, prompt_len: int, max_new: int) -> int:
+        """Worst-case pages one sequence touches: prefill writes
+        ceil(prompt/chunk) whole pages, decode writes up to
+        `max_new - 1` more positions, everything capped at the bucket
+        (retirement fires at pos >= bucket)."""
+        cap = min(self.bucket, prompt_len + max_new)
+        return -(-cap // self.chunk)
+
+    def make_room(self, n_pages: int) -> bool:
+        """Free arena pages until `n_pages` are available, evicting
+        unpinned trie nodes LRU-first (an eviction only yields a free
+        page when no live slot shares it).  Returns availability."""
+        if self.trie is not None:
+            while self.pool.n_free < n_pages:
+                if not self.trie.evict_lru():
+                    break
+        return self.pool.n_free >= n_pages
+
+    def occupancy(self):
+        """(pages_in_use, real tokens held) for the kv gauges: slots
+        hold `pos` cached tokens, jobs `start` (restored + prefilled so
+        far), trie-only pages a whole chunk each; reserved-but-unwritten
+        pages count capacity only — that gap IS the fragmentation the
+        `kv_page_utilization` gauge measures."""
+        tokens = sum(min(s.pos, self.bucket) for s in self.slots.values())
+        tokens += sum(j.start for j in self.jobs.values())
+        if self.trie is not None:
+            mapped = set()
+            for idx in self.slots:
+                mapped.update(self.table.mapped(idx))
+            for job in self.jobs.values():
+                mapped.update(self.table.mapped(job.slot_idx))
+            for node in self.trie._walk():
+                if node.kv["page"] not in mapped:
+                    mapped.add(node.kv["page"])
+                    tokens += self.chunk
+        return self.pool.in_use, tokens
+
+
 class GenerationSession:
     """Continuous-batching token generation over a cache-carrying model.
 
@@ -163,6 +260,9 @@ class GenerationSession:
     def __init__(self, params, *, model_prefill: Callable,
                  model_decode: Callable, init_cache: Callable,
                  model_prefill_chunk: Optional[Callable] = None,
+                 model_prefill_chunk_paged: Optional[Callable] = None,
+                 model_decode_paged: Optional[Callable] = None,
+                 init_pages: Optional[Callable] = None,
                  config: Optional[ServeConfig] = None, mesh=None,
                  eos_id: Optional[int] = None,
                  max_prompt_len: Optional[int] = None,
@@ -189,6 +289,15 @@ class GenerationSession:
         self._closed = False
         self._init_cache = init_cache
         self._chunked = model_prefill_chunk is not None
+        self._paged = self.config.kv_layout == "paged"
+        if self._paged and (model_prefill_chunk_paged is None
+                            or model_decode_paged is None
+                            or init_pages is None):
+            raise ValueError(
+                "kv_layout='paged' requires model_prefill_chunk_paged, "
+                "model_decode_paged, and init_pages (the for_gpt/"
+                "for_llama constructors wire all three)")
+        self._init_pages = init_pages
         self._pending: collections.deque = collections.deque()
         self._pools: Dict[int, _BucketPool] = {}
         self._next_request_id = 0
@@ -238,6 +347,46 @@ class GenerationSession:
             pool, logits = model_decode(params, pool, token, pos)
             return pool, jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
+        # paged-layout programs: arena first for donation pairing, the
+        # int32 page table crosses as data every call (fixed shape — the
+        # signature stays closed over arbitrary per-row lengths).
+        # Compiled lazily via `_paged_c` so bucketed sessions never pay
+        # for them; export/import move single pages for fleet handoff.
+        def _prefill_chunk_paged(arena, params, table, tokens, start,
+                                 lengths):
+            import jax.numpy as jnp
+
+            arena, logits = model_prefill_chunk_paged(
+                params, arena, table, tokens, start, lengths)
+            return arena, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        def _decode_paged(arena, params, table, token, pos):
+            import jax.numpy as jnp
+
+            arena, logits = model_decode_paged(params, arena, table,
+                                               token, pos)
+            return arena, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        def _page_export(arena, page):
+            import jax
+
+            return {k: jax.lax.dynamic_index_in_dim(
+                        arena[k], page, axis=1, keepdims=False)
+                    for k in ("k", "v")}
+
+        def _page_import(arena, chunk_kv, page):
+            import jax
+
+            return {k: jax.lax.dynamic_update_index_in_dim(
+                        arena[k], chunk_kv[k].astype(arena[k].dtype),
+                        page, axis=1)
+                    for k in ("k", "v")}
+
+        self._paged_defs = (
+            {"chunk": _prefill_chunk_paged, "decode": _decode_paged,
+             "export": _page_export, "import": _page_import}
+            if model_prefill_chunk_paged is not None else {})
+
         # pool/staging is arg 0 and output 0 of every mutating compiled
         # callable, so state_io="auto" pairs it and XLA gets the buffer
         # donated; _extract's output is chunk-shaped (no pairing, no
@@ -263,13 +412,14 @@ class GenerationSession:
                       easydist_compile(_restore, mesh=mesh),
                       easydist_compile(_migrate, mesh=mesh),
                       easydist_compile(_decode, mesh=mesh),
-                      {})
+                      {}, {})
             if memo_key:
                 while len(_COMPILED_MEMO) >= 32:  # live sessions keep refs
                     _COMPILED_MEMO.pop(next(iter(_COMPILED_MEMO)))
                 _COMPILED_MEMO[memo_key] = shared
         (self._prefill_c, self._prefill_chunk_c, self._restore_c,
-         self._migrate_c, self._decode_c, self._extract_cs) = shared
+         self._migrate_c, self._decode_c, self._extract_cs,
+         self._paged_cs) = shared
 
     def _extract_for(self, chunk_len: int) -> Callable:
         """Compiled chunk extractor for one chunk size (the slice size
@@ -292,6 +442,18 @@ class GenerationSession:
 
             fn = easydist_compile(_extract, mesh=self.mesh)
             self._extract_cs[chunk_len] = fn
+        return fn
+
+    def _paged_c(self, name: str) -> Callable:
+        """Compiled paged program ("chunk" / "decode" / "export" /
+        "import"), built on first use and shared through the process
+        memo exactly like `_extract_for`."""
+        fn = self._paged_cs.get(name)
+        if fn is None:
+            from easydist_tpu.jaxfront import easydist_compile
+
+            fn = easydist_compile(self._paged_defs[name], mesh=self.mesh)
+            self._paged_cs[name] = fn
         return fn
 
     # ------------------------------------------------------------ admission
@@ -333,11 +495,28 @@ class GenerationSession:
             len(p.jobs) + p.n_active for p in self._pools.values())
 
     # ------------------------------------------------------------- plumbing
-    def _pool_for(self, bucket: int) -> _BucketPool:
+    def _pool_for(self, bucket: int):
+        cfg = self.config
+        if self._paged:
+            # every bucket collapses into the one page-granular pool:
+            # lengths are a page-table concern, not a compile-signature
+            # concern, so there is nothing to bucket by
+            bucket = max(cfg.decode_buckets)
         pool = self._pools.get(bucket)
         if pool is None:
-            cfg = self.config
-            if self._chunked:
+            if self._paged:
+                chunk = cfg.kv_page_tokens or min(cfg.prefill_chunk,
+                                                  bucket)
+                max_pages = bucket // chunk
+                n_pages = cfg.kv_arena_pages or \
+                    (cfg.max_decode_slots + 1) * max_pages
+                pool = _PagedPool(
+                    bucket, cfg.max_decode_slots, self._pages_factory,
+                    n_rows=cfg.prefill_batch, chunk=chunk,
+                    prefix_bytes=(cfg.prefix_cache_bytes
+                                  if cfg.enable_prefix_cache else 0),
+                    n_pages=n_pages)
+            elif self._chunked:
                 pool = _BucketPool(
                     bucket, cfg.max_decode_slots, self._cache_factory,
                     n_rows=cfg.prefill_batch,
@@ -353,6 +532,11 @@ class GenerationSession:
     def _cache_factory(self, batch: int, max_len: int):
         dtype = self.config.kv_cache_dtype
         return self._init_cache(batch, max_len,
+                                None if dtype == "auto" else dtype)
+
+    def _pages_factory(self, n_pages: int, page_tokens: int):
+        dtype = self.config.kv_cache_dtype
+        return self._init_pages(n_pages, page_tokens,
                                 None if dtype == "auto" else dtype)
 
     def _prefill_pad(self, plen: int, bucket: int) -> int:
@@ -378,8 +562,10 @@ class GenerationSession:
         pool = self._pool_for(bucket)
         if not pool.free:
             return False
-        if self._chunked and not pool.free_rows:
+        if (self._chunked or self._paged) and not pool.free_rows:
             return False
+        if self._paged:
+            return self._admit_one_paged(pool)
         self._pending.popleft()
         if fut.set_running_or_notify_cancel() is False:
             return True  # cancelled while queued; slot stays free
@@ -430,14 +616,64 @@ class GenerationSession:
         self._maybe_retire(pool, slot_idx)
         return True
 
+    def _admit_one_paged(self, pool: _PagedPool) -> bool:
+        """Paged admission: reserve EVERY page the sequence can ever
+        touch up front (decode crossing a page boundary must find the
+        page already mapped — a sentinel there silently drops the
+        token's K/V), mapping the trie's committed prefix pages in place
+        of the bucketed layout's restore copies.  Defers (returns False,
+        request stays queued) when the arena cannot make room."""
+        prompt, max_new, eos, fut, t_submit = self._pending[0]
+        prefix_len, nodes = 0, []
+        if pool.trie is not None:
+            # cap below len(prompt): at least one real token must run
+            # through prefill so the finishing chunk produces logits
+            prefix_len, nodes = pool.trie.match(
+                prompt, max_tokens=len(prompt) - 1)
+            pool.trie.pin(nodes)  # survive make_room's evictions
+        n_need = pool.pages_needed(len(prompt), max_new)
+        if not pool.make_room(n_need - len(nodes)):
+            if pool.trie is not None:
+                pool.trie.unpin(nodes)
+            return False
+        self._pending.popleft()
+        if fut.set_running_or_notify_cancel() is False:
+            if pool.trie is not None:
+                pool.trie.unpin(nodes)
+            return True  # cancelled while queued; nothing reserved yet
+        slot_idx = pool.free.pop()
+        row = pool.free_rows.pop()
+        # zero-copy restore: the slot's leading windows point at the
+        # trie's pages (shared, read-only by construction — writes only
+        # land past the prefix); the bucketed path would
+        # dynamic_update_slice-copy these bytes into staging here
+        for j, node in enumerate(nodes):
+            pid = node.kv["page"]
+            pool.pool.share(pid)
+            pool.table.map(slot_idx, j, pid)
+        for j in range(len(nodes), n_need):
+            pool.table.map(slot_idx, j, pool.pool.alloc())
+        if nodes:
+            self.metrics.record_copy_on_restore_saved(
+                len(nodes) * pool.page_bytes)
+        self.metrics.record_admission(len(prompt), prefix_len)
+        pool.jobs[row] = _PrefillJob(
+            request_id=self._next_request_id, future=fut, prompt=prompt,
+            max_new=max_new, eos_id=eos, row=row, slot_idx=slot_idx,
+            start=prefix_len, prefix_nodes=nodes, t_submit=t_submit)
+        self._next_request_id += 1
+        return True
+
     # ----------------------------------------------------- chunked prefill
-    def _prefill_round(self, pool: _BucketPool, max_chunks: int) -> int:
+    def _prefill_round(self, pool, max_chunks: int) -> int:
         """Run up to `max_chunks` batched chunk calls on `pool`'s staging
         rows; finished jobs commit to the trie, migrate to their slot, and
         free their row.  Returns the number of chunk calls executed."""
         import jax
         import jax.numpy as jnp
 
+        if self._paged:
+            return self._prefill_round_paged(pool, max_chunks)
         calls = 0
         c_len = pool.chunk
         while pool.jobs and calls < max_chunks:
@@ -468,6 +704,103 @@ class GenerationSession:
                 if job.start >= len(job.prompt):
                     self._finish_prefill(pool, row, int(first[row]))
         return calls
+
+    def _prefill_round_paged(self, pool: _PagedPool,
+                             max_chunks: int) -> int:
+        """Paged `_prefill_round`: each chunk writes straight into the
+        arena through the job's table row (no staging, no migrate, and a
+        restored prefix needed no copy to begin with).  Idle rows get an
+        all-sentinel table row so their writes drop and their logits are
+        garbage nobody reads — one compiled signature regardless of
+        which rows are live."""
+        import jax
+        import jax.numpy as jnp
+
+        calls = 0
+        c_len = pool.chunk
+        while pool.jobs and calls < max_chunks:
+            tokens = np.full((pool.n_rows, c_len),
+                             int(self.config.pad_value), np.int32)
+            start = np.zeros((pool.n_rows,), np.int32)
+            lengths = np.ones((pool.n_rows,), np.int32)
+            tbl = np.full((pool.n_rows, pool.max_pages),
+                          pool.pool.sentinel, np.int32)
+            for row, job in pool.jobs.items():
+                seg = job.prompt[job.start:job.start + c_len]
+                tokens[row, :len(seg)] = seg
+                start[row] = job.start
+                lengths[row] = len(job.prompt)
+                tbl[row] = pool.table.array[job.slot_idx]
+            args = (pool.arena, self.params, jnp.asarray(tbl),
+                    jnp.asarray(tokens), jnp.asarray(start),
+                    jnp.asarray(lengths))
+            result = self._paged_c("chunk").get_compiled(*args)
+            if pool.bucket not in self._audited_prefill:
+                self._audited_prefill.add(pool.bucket)
+                # SERVE002's jaxpr walk asserts the bucketed staging
+                # idiom (dynamic_update_slice restore); the paged
+                # program replaces it with table writes, audited
+                # host-side by KV001 — only the donation half applies
+                try:
+                    from easydist_tpu.analyze import check_decode_donation
+
+                    check_decode_donation(
+                        result,
+                        node=f"prefill_chunk_paged[cap={pool.bucket}]")
+                except ImportError:
+                    pass
+            t0 = time.perf_counter()
+            pool.arena, first = result.tree_jitted(*args)
+            first = np.asarray(jax.block_until_ready(first))
+            self.metrics.record_prefill_chunk(
+                pool.n_rows, c_len, time.perf_counter() - t0)
+            calls += 1
+            for row in list(pool.jobs):
+                job = pool.jobs[row]
+                job.start += c_len
+                if job.start >= len(job.prompt):
+                    self._finish_prefill_paged(pool, row,
+                                               int(first[row]))
+        return calls
+
+    def _finish_prefill_paged(self, pool: _PagedPool, row: int,
+                              first_token: int) -> None:
+        """One paged job's last chunk ran: commit its whole-chunk pages
+        into the trie as page REFERENCES (share + {"page": id} — no
+        extraction copy), free the row, open the decode slot."""
+        job = pool.jobs.pop(row)
+        pinned = list(job.prefix_nodes)
+        if pool.trie is not None:
+            nodes = list(job.prefix_nodes)
+            for j in range(len(nodes), len(job.prompt) // pool.chunk):
+                chunk_toks = job.prompt[j * pool.chunk:
+                                        (j + 1) * pool.chunk]
+                node = pool.trie.lookup_node(nodes, chunk_toks)
+                if node is None:
+                    pid = int(pool.table.array[job.slot_idx, j])
+                    pool.pool.share(pid)       # the trie's hold
+                    node = pool.trie.commit(nodes, chunk_toks,
+                                            {"page": pid},
+                                            nbytes=pool.page_bytes)
+                    if node is None:
+                        pool.pool.release(pid)  # budget refused it
+                if node is None:
+                    break  # byte budget exhausted; partial path is fine
+                nodes.append(node)
+            pool.trie.unpin(job.prefix_nodes)
+            pool.trie.pin(nodes)
+            pinned = nodes
+            self._audit_prefix_cache(pool)
+        pool.free_rows.append(row)
+        self.metrics.observe("ttft", time.perf_counter() - job.t_submit)
+
+        slot = _Slot(request_id=job.request_id, future=job.future,
+                     pos=len(job.prompt), token=first_token,
+                     max_new=job.max_new, eos_id=job.eos_id,
+                     pinned=pinned, prompt=job.prompt)
+        slot.generated.append(slot.token)
+        pool.slots[job.slot_idx] = slot
+        self._maybe_retire(pool, job.slot_idx)
 
     def _finish_prefill(self, pool: _BucketPool, row: int,
                         first_token: int) -> None:
@@ -511,11 +844,16 @@ class GenerationSession:
         self._maybe_retire(pool, job.slot_idx)
 
     # ------------------------------------------------------------- decoding
-    def _retire(self, pool: _BucketPool, slot_idx: int, reason: str) -> None:
+    def _retire(self, pool, slot_idx: int, reason: str) -> None:
         slot = pool.slots.pop(slot_idx)
         pool.free.append(slot_idx)
+        if self._paged:
+            for pid in pool.table.unmap_row(slot_idx):
+                pool.pool.release(pid)
         if pool.trie is not None and slot.pinned:
             pool.trie.unpin(slot.pinned)
+        if self._paged:
+            self._audit_kv(pool, f"retire[{reason}]")
         slot.future.set_result({"ids": list(slot.generated),
                                 "finish_reason": reason})
         self.metrics.inc("requests_completed")
@@ -532,9 +870,11 @@ class GenerationSession:
             return False
         return True
 
-    def _decode_round(self, pool: _BucketPool) -> None:
+    def _decode_round(self, pool) -> None:
         """One compiled decode step over ALL slots of `pool` (fixed
-        shapes: the signature cache stays at one entry per bucket)."""
+        shapes: the signature cache stays at one entry per bucket — and
+        at ONE entry total for the paged layout, whose only per-step
+        variation is page-table DATA)."""
         import jax
         import jax.numpy as jnp
 
@@ -543,14 +883,33 @@ class GenerationSession:
         for idx, slot in pool.slots.items():
             token[idx] = slot.token
             pos[idx] = slot.pos
-        args = (pool.cache, self.params, jnp.asarray(token),
-                jnp.asarray(pos))
-        result = self._decode_c.get_compiled(*args)
+        if self._paged:
+            # only actively-decoding rows expose their table row: a
+            # reserved-but-still-prefilling slot's pages (possibly
+            # SHARED prefix pages) must not take the dead-row write this
+            # step lands at pos 0 — sentinel rows drop it instead
+            tbl = np.full((pool.n_slots, pool.max_pages),
+                          pool.pool.sentinel, np.int32)
+            for idx in pool.slots:
+                tbl[idx] = pool.table.array[idx]
+            args = (pool.arena, self.params, jnp.asarray(tbl),
+                    jnp.asarray(token), jnp.asarray(pos))
+            compiled = self._paged_c("decode")
+        else:
+            args = (pool.cache, self.params, jnp.asarray(token),
+                    jnp.asarray(pos))
+            compiled = self._decode_c
+        result = compiled.get_compiled(*args)
         if pool.bucket not in self._audited:
             self._audited.add(pool.bucket)
             self._audit_donation(result, pool.bucket)
+            if self._paged:
+                self._audit_kv(pool, "first_decode")
         t0 = time.perf_counter()
-        pool.cache, nxt = result.tree_jitted(*args)
+        if self._paged:
+            pool.arena, nxt = result.tree_jitted(*args)
+        else:
+            pool.cache, nxt = result.tree_jitted(*args)
         nxt = np.asarray(jax.block_until_ready(nxt))
         dt = time.perf_counter() - t0
         n_active = pool.n_active
@@ -561,6 +920,9 @@ class GenerationSession:
             slot.generated.append(slot.token)
             self._maybe_retire(pool, idx)
         self.metrics.record_decode_step(n_active, pool.n_slots, dt)
+        if self._paged:
+            in_use, tokens = pool.occupancy()
+            self.metrics.record_kv_pool(in_use, tokens, pool.chunk)
 
     def _audit_donation(self, result, bucket: int) -> None:
         try:
@@ -579,13 +941,24 @@ class GenerationSession:
         except ImportError:
             pass
 
-    def _audit_prefix_cache(self, pool: _BucketPool) -> None:
+    def _audit_prefix_cache(self, pool) -> None:
         try:
             from easydist_tpu.analyze import check_prefix_cache
 
             check_prefix_cache(pool.trie,
                                node=f"prefix_cache[bucket={pool.bucket}]")
         except ImportError:
+            pass
+
+    def _audit_kv(self, pool: _PagedPool, where: str) -> None:
+        """KV001: page-table/refcount audit at the state transitions
+        where drift would matter (first decode, every retire)."""
+        try:
+            from easydist_tpu.analyze import check_page_table
+
+            check_page_table(pool.pool, pool.table, trie=pool.trie,
+                             node=f"kv[{where}]")
+        except ImportError:  # analyze is an optional layer at runtime
             pass
 
     # ------------------------------------------------------------- driving
@@ -597,7 +970,7 @@ class GenerationSession:
         (decode tokens; prefill first-tokens count via `prefills`)."""
         while self._admit_one():
             pass
-        if self._chunked:
+        if self._chunked or self._paged:
             budget = self.config.prefill_chunks_per_step
             for pool in self._pools.values():
                 if budget <= 0:
@@ -650,26 +1023,76 @@ class GenerationSession:
         hottest-first (prefix_cache.hot_paths) — what a router re-imports
         into surviving replicas on drain so shared-prefix traffic does
         not re-pay prefill after a scale-down."""
-        return {b: p.trie.hot_paths() for b, p in self._pools.items()
-                if p.trie is not None}
+        return {b: ([self._materialize_path(p, path)
+                     for path in p.trie.hot_paths()]
+                    if self._paged else p.trie.hot_paths())
+                for b, p in self._pools.items() if p.trie is not None}
 
     # ------------------------------------------------- fleet trie access
+    def _trie_bucket(self, bucket: Optional[int]) -> Optional[int]:
+        """The pool key `bucket` maps to: itself, or the single paged
+        pool's capacity cap."""
+        if bucket is None:
+            return None
+        return max(self.config.decode_buckets) if self._paged else bucket
+
+    def _materialize_path(self, pool, path: List[tuple]) -> List[tuple]:
+        """Fleet transport of paged trie entries: replace {"page": id}
+        references with the page's actual K/V (the same
+        [layers, heads, chunk, head_dim] arrays a bucketed trie commits),
+        so exported paths are layout-agnostic on the wire."""
+        import jax.numpy as jnp
+
+        out = []
+        for key, kv in path:
+            if isinstance(kv, dict) and set(kv) == {"page"}:
+                kv = self._paged_c("export")(
+                    pool.arena, jnp.asarray(int(kv["page"]), jnp.int32))
+            out.append((key, kv))
+        return out
+
+    def _import_path_paged(self, pool, path: Sequence[tuple]) -> int:
+        """Commit a transported (materialized) chunk path into the paged
+        trie: each chunk lands in a freshly allocated arena page, written
+        by the compiled import program and committed as a page
+        reference.  First-commit-wins like `PrefixCache.import_path`;
+        stops when the arena or the trie budget refuses a page."""
+        import jax.numpy as jnp
+
+        nodes: List[object] = []
+        for key, kv in path:
+            node = pool.trie.lookup_node(nodes, key)
+            if node is None:
+                if not pool.make_room(1):
+                    break
+                pid = pool.pool.alloc()
+                pool.arena = self._paged_c("import")(
+                    pool.arena, kv, jnp.asarray(pid, jnp.int32))
+                node = pool.trie.commit(nodes, key, {"page": pid},
+                                       nbytes=pool.page_bytes)
+                if node is None:
+                    pool.pool.release(pid)
+                    break
+            nodes.append(node)
+        return len(nodes)
+
     def bucket_chunk(self, prompt: Sequence[int]) -> Optional[int]:
         """Trie page size (tokens) for the bucket `prompt` decodes in, or
         None when the prompt fits no bucket / prefix reuse is off."""
         bucket = select_bucket(len(prompt) + 1, self.config.decode_buckets)
-        if bucket is None or not self._chunked \
+        if bucket is None or not (self._chunked or self._paged) \
                 or not self.config.enable_prefix_cache \
                 or not self.config.prefix_cache_bytes:
             return None
-        return min(self.config.prefill_chunk, bucket)
+        return min(self.config.prefill_chunk, self._trie_bucket(bucket))
 
     def prefix_affinity(self, prompt: Sequence[int]) -> int:
         """Tokens of `prompt` already committed in this session's trie —
         non-mutating (PrefixCache.peek), so a router can probe every
         replica without disturbing LRU state."""
         bucket = select_bucket(len(prompt) + 1, self.config.decode_buckets)
-        pool = self._pools.get(bucket) if bucket is not None else None
+        pool = self._pools.get(self._trie_bucket(bucket)) \
+            if bucket is not None else None
         if pool is None or pool.trie is None:
             return 0
         return pool.trie.peek(prompt, max_tokens=len(prompt) - 1)
@@ -677,12 +1100,15 @@ class GenerationSession:
     def export_prefix_path(self, prompt: Sequence[int],
                            max_tokens: Optional[int] = None) -> List[tuple]:
         """Committed chunk path for `prompt`'s longest cached prefix, as
-        [(chunk_tokens, kv)] for transport to another replica."""
+        [(chunk_tokens, kv)] for transport to another replica (paged
+        sessions materialize their page references into real arrays)."""
         bucket = select_bucket(len(prompt) + 1, self.config.decode_buckets)
-        pool = self._pools.get(bucket) if bucket is not None else None
+        pool = self._pools.get(self._trie_bucket(bucket)) \
+            if bucket is not None else None
         if pool is None or pool.trie is None:
             return []
-        return pool.trie.export_path(prompt, max_tokens=max_tokens)
+        path = pool.trie.export_path(prompt, max_tokens=max_tokens)
+        return self._materialize_path(pool, path) if self._paged else path
 
     def import_prefix_path(self, prompt: Sequence[int],
                            path: Sequence[tuple]) -> int:
@@ -695,6 +1121,8 @@ class GenerationSession:
         pool = self._pool_for(bucket)
         if pool.trie is None:
             return 0
+        if self._paged:
+            return self._import_path_paged(pool, path)
         return pool.trie.import_path(path)
 
     def import_hot_pages(self, pages: Dict[int, List[List[tuple]]]) -> int:
@@ -710,7 +1138,8 @@ class GenerationSession:
             if pool.trie is None:
                 continue
             for path in paths:
-                total += pool.trie.import_path(path)
+                total += (self._import_path_paged(pool, path)
+                          if self._paged else pool.trie.import_path(path))
         return total
 
     def evacuate(self) -> List[Dict[str, object]]:
@@ -737,6 +1166,9 @@ class GenerationSession:
                 job = pool.jobs.pop(row)
                 pool.free_rows.append(row)
                 pool.free.append(job.slot_idx)
+                if self._paged:
+                    for pid in pool.table.unmap_row(job.slot_idx):
+                        pool.pool.release(pid)
                 if pool.trie is not None:
                     pool.trie.unpin(job.prefix_nodes)
                 job.future.set_result(
@@ -772,12 +1204,21 @@ class GenerationSession:
                 b: {"active": p.n_active, "free": len(p.free),
                     "prefilling": len(p.jobs),
                     "free_rows": len(p.free_rows),
-                    "prefix_cache": (p.trie.stats() if p.trie else None)}
+                    "prefix_cache": (p.trie.stats() if p.trie else None),
+                    **({"kv_pool": p.pool.stats(),
+                        "kv_table_mapped": int(
+                            (p.table.array != p.table.sentinel).sum())}
+                       if self._paged else {})}
                 for b, p in self._pools.items()},
-            "decode_signatures": self._decode_c.cache_stats(),
+            "decode_signatures": (
+                self._paged_cs["decode"].cache_stats()
+                if self._paged and "decode" in self._paged_cs
+                else self._decode_c.cache_stats()),
             "prefill_signatures": (
-                self._prefill_chunk_c if self._chunked
-                else self._prefill_c).cache_stats(),
+                self._paged_cs["chunk"].cache_stats()
+                if self._paged and "chunk" in self._paged_cs
+                else (self._prefill_chunk_c if self._chunked
+                      else self._prefill_c).cache_stats()),
             "migrate_signatures": self._migrate_c.cache_stats(),
             "metrics": self.metrics.snapshot(),
         }
@@ -801,6 +1242,12 @@ class GenerationSession:
                 p, cfg, c, t, pos),
             init_cache=lambda b, L, dt=None: gpt.init_kv_cache(
                 cfg, b, L, dtype=dt),
+            model_prefill_chunk_paged=lambda p, pg, tb, t, s, l:
+                gpt.gpt_prefill_chunk_paged(p, cfg, pg, tb, t, s, l),
+            model_decode_paged=lambda p, pg, tb, t, pos:
+                gpt.gpt_decode_step_paged(p, cfg, pg, tb, t, pos),
+            init_pages=lambda n, t, dt=None: gpt.init_kv_pages(
+                cfg, n, t, dtype=dt),
             max_prompt_len=cfg.seq, **kw)
 
     @classmethod
@@ -822,4 +1269,10 @@ class GenerationSession:
                 p, cfg, c, t, pos),
             init_cache=lambda b, L, dt=None: llama.init_kv_cache(
                 cfg, b, L, dtype=dt),
+            model_prefill_chunk_paged=lambda p, pg, tb, t, s, l:
+                llama.llama_prefill_chunk_paged(p, cfg, pg, tb, t, s, l),
+            model_decode_paged=lambda p, pg, tb, t, pos:
+                llama.llama_decode_step_paged(p, cfg, pg, tb, t, pos),
+            init_pages=lambda n, t, dt=None: llama.init_kv_pages(
+                cfg, n, t, dtype=dt),
             **kw)

@@ -169,6 +169,28 @@ class ServeMetrics:
                 self._gauges["prefill_padding_ratio"] = padded / real
             self.execute.observe(chunk_s)
 
+    def record_kv_pool(self, pages_in_use: int, mapped_tokens: int,
+                       page_tokens: int) -> None:
+        """Paged-KV pool occupancy: `pages_in_use` arena pages are live
+        (slot-mapped or trie-held) holding `mapped_tokens` real tokens of
+        `pages_in_use * page_tokens` capacity.  `kv_page_utilization` is
+        the intra-page fill fraction — 1.0 means zero fragmentation, and
+        (1 - it) is the only padding waste the paged layout CAN have
+        (the bucketed pool pads every row to the bucket instead)."""
+        with self._lock:
+            self._gauges["kv_pages_in_use"] = pages_in_use
+            cap = pages_in_use * page_tokens
+            self._gauges["kv_page_utilization"] = \
+                (mapped_tokens / cap) if cap else 1.0
+
+    def record_copy_on_restore_saved(self, nbytes: int) -> None:
+        """A prefix restore mapped `nbytes` of committed pages into a
+        sequence's page table instead of `dynamic_update_slice`-copying
+        them — the zero-copy-restore contract, measured."""
+        with self._lock:
+            self._counters["copy_on_restore_bytes_saved"] = \
+                self._counters.get("copy_on_restore_bytes_saved", 0) + nbytes
+
     def counter(self, name: str) -> int:
         with self._lock:
             return self._counters.get(name, 0)

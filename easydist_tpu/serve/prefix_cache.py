@@ -68,13 +68,17 @@ class PrefixCache:
     """Token-trie index over committed KV chunks of `chunk` tokens each,
     LRU-evicted under `byte_budget` (0 disables committing entirely)."""
 
-    def __init__(self, chunk: int, byte_budget: int):
+    def __init__(self, chunk: int, byte_budget: int, on_evict=None):
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         if byte_budget < 0:
             raise ValueError(f"byte_budget must be >= 0, got {byte_budget}")
         self.chunk = chunk
         self.byte_budget = byte_budget
+        # called with each evicted node AFTER unlinking — the paged KV
+        # session releases the node's arena page refcount here, so trie
+        # eviction is what returns shared pages to the pool
+        self.on_evict = on_evict
         self._root = _Node(key=None, parent=None, kv=None, nbytes=0,
                            depth=-1, tick=0)
         self._tick = 0
@@ -141,12 +145,15 @@ class PrefixCache:
 
     # -------------------------------------------------------------- commit
     def commit(self, nodes: List[_Node], chunk_tokens: Sequence[int],
-               kv) -> Optional[_Node]:
+               kv, nbytes: Optional[int] = None) -> Optional[_Node]:
         """Commit one chunk's KV under the path `nodes` (which must be the
         contiguous prefix path from the root).  Returns the (existing or
         new) node, or None when the budget is 0 or the chunk is partial.
         Evicts LRU unpinned leaves to stay under the byte budget; a chunk
-        larger than the whole budget is not committed."""
+        larger than the whole budget is not committed.  `nbytes` overrides
+        the size computed from `kv`'s array leaves — the paged KV session
+        commits page REFERENCES ({"page": id}), whose cost is the arena
+        page's bytes, not the reference's."""
         if self.byte_budget == 0 or len(chunk_tokens) != self.chunk:
             return None
         parent = nodes[-1] if nodes else self._root
@@ -155,8 +162,9 @@ class PrefixCache:
         if existing is not None:
             existing.last_used = self._tick
             return existing
-        nbytes = sum(int(leaf.size) * leaf.dtype.itemsize
-                     for leaf in kv.values())
+        if nbytes is None:
+            nbytes = sum(int(leaf.size) * leaf.dtype.itemsize
+                         for leaf in kv.values())
         if nbytes > self.byte_budget:
             return None
         # the path being extended must survive this commit's eviction:
@@ -191,6 +199,18 @@ class PrefixCache:
             self.bytes_used -= victim.nbytes
             self.n_nodes -= 1
             self.evictions += 1
+            if self.on_evict is not None:
+                self.on_evict(victim)
+
+    def evict_lru(self) -> bool:
+        """Evict the least-recently-used unpinned leaf on demand — the
+        paged KV session calls this when admission needs arena room, to
+        hand trie-held pages back to the pool (via `on_evict`) ahead of
+        the byte budget forcing it.  Returns True when something was
+        evicted."""
+        before = self.n_nodes
+        self._evict_to(self.bytes_used - 1)
+        return self.n_nodes < before
 
     def _walk(self):
         stack = list(self._root.children.values())

@@ -129,7 +129,11 @@ fi
 # decode-serving gate: KV-cached generation must beat the naive full
 # re-forward greedy loop >= 5x in tokens/s at seq 512 (the O(T) vs O(T^2)
 # economics), with bitwise greedy parity and a decode signature cache that
-# stays at one compiled step per bucket across every generated token
+# stays at one compiled step per bucket across every generated token.
+# The mixed-length section additionally gates the paged KV layout: paged
+# greedy ids bitwise == bucketed, ONE compiled paged decode step for every
+# length, tokens/s at or above the bucketed pools, slot bytes/seq strictly
+# below them, and a zero-copy prefix restore (copy_on_restore_bytes_saved)
 if python -c "import jax" >/dev/null 2>&1; then
     echo "== bench.py --decode (KV-cache decode speedup + parity gate)"
     out=$(python bench.py --decode 2>/dev/null) || rc=1
@@ -146,6 +150,18 @@ try:
         print("decode signature cache grew across tokens")
     elif not r.get("value", 0) >= 5.0:
         print(f"speedup {r.get('value')} < 5.0x")
+    elif not r.get("paged_parity_greedy"):
+        print("paged greedy ids diverge from bucketed")
+    elif not r.get("paged_signature_constant"):
+        print("paged decode signature cache grew across mixed lengths")
+    elif not r.get("paged_tokens_per_s", 0) >= r.get("bucketed_tokens_per_s", 1e18):
+        print(f"paged {r.get('paged_tokens_per_s')} tok/s below bucketed "
+              f"{r.get('bucketed_tokens_per_s')}")
+    elif not r.get("paged_bytes_per_seq", 1e18) < r.get("bucketed_bytes_per_seq", 0):
+        print(f"paged bytes/seq {r.get('paged_bytes_per_seq')} not below "
+              f"bucketed {r.get('bucketed_bytes_per_seq')}")
+    elif not r.get("copy_on_restore_bytes_saved", 0) > 0:
+        print("paged prefix restore saved zero copy bytes")
     elif r.get("perf_regression"):
         print(f"committed-floor regression: {r.get('value')} is >10% below "
               f"last-good {r.get('last_good_value')}")
@@ -185,6 +201,10 @@ try:
         print("prefill signature cache grew across prompt lengths")
     elif not r.get("value", 0) >= 2.0:
         print(f"TTFT speedup {r.get('value')} < 2.0x")
+    elif not r.get("paged_parity_greedy"):
+        print("paged-layout greedy ids diverge from the bucketed cache-on run")
+    elif not r.get("copy_on_restore_bytes_saved", 0) > 0:
+        print("paged prefix restore saved zero copy bytes")
     elif r.get("perf_regression"):
         print(f"committed-floor regression: {r.get('value')} is >10% below "
               f"last-good {r.get('last_good_value')}")

@@ -62,7 +62,7 @@ def _eager_losses(params, batches, lr, n_steps=3):
     for x, y in batches:
         params, opt, loss = step(params, opt, x, y)
         losses.append(float(loss))
-    return losses
+    return losses, params
 
 
 def _hybrid_losses(mesh, pp_stages, params, batches, lr=None, M=4, **kw):
@@ -82,11 +82,19 @@ def _run_parity(mesh, pp_stages, **kw):
     params = _make_params(key)
     batches = [_batch(jax.random.PRNGKey(10 + i)) for i in range(3)]
     lr = 1e-2
-    eager = _eager_losses(params, batches, lr)
+    eager, trained = _eager_losses(params, batches, lr)
     hybrid, state = _hybrid_losses(mesh, pp_stages, params, batches, lr,
                                    **kw)
     np.testing.assert_allclose(hybrid, eager, rtol=2e-4, atol=2e-5)
-    assert eager[-1] < eager[0], "sanity: training should reduce the loss"
+    # each step draws a fresh random batch (fresh random targets), so
+    # consecutive per-step losses are not comparable: eager[-1] can sit
+    # above eager[0] from target noise alone while the model still learns
+    # (backend-dependent — exactly that flipped on the CI image's XLA).
+    # Sanity-check descent on a FIXED batch instead: batch 0's loss must
+    # drop from the init params to the trained ones.
+    x0, y0 = batches[0]
+    assert float(_loss_fn(trained, x0, y0)) < eager[0], \
+        "sanity: training should reduce the loss on a fixed batch"
     return state
 
 
@@ -286,7 +294,10 @@ def test_optax_optimizer(cpu_devices):
     mesh = Mesh(np.array(cpu_devices).reshape(4, 2), ("pp", "dp"))
     key = jax.random.PRNGKey(0)
     params = _make_params(key)
-    batches = [_batch(jax.random.PRNGKey(10 + i)) for i in range(3)]
+    # train on ONE repeated batch: per-step losses on fresh random targets
+    # are not comparable (target noise outweighs 3 Adam steps), so descent
+    # is only a meaningful assertion on a fixed batch
+    batches = [_batch(jax.random.PRNGKey(10))] * 3
     losses, _ = _hybrid_losses(mesh, 4, params, batches,
                                optimizer=optax.adam(1e-2))
     assert losses[-1] < losses[0]
@@ -340,7 +351,7 @@ def test_tp_axis_idles_when_nothing_profitable(cpu_devices):
     params = _make_params(key)
     batches = [_batch(jax.random.PRNGKey(10 + i)) for i in range(3)]
     lr = 1e-2
-    eager = _eager_losses(params, batches, lr)
+    eager, _ = _eager_losses(params, batches, lr)
     compiled = easydist_compile(_loss_fn, mesh=mesh, pp_stages=2,
                                 n_microbatches=4, lr=lr, tp_axes=("tp",))
     x0, y0 = batches[0]

@@ -1,0 +1,303 @@
+"""Paged KV bookkeeping: PagePool refcounted allocator, PageTable
+slot->page indirection, a seeded property/stress run with invariants
+checked after EVERY operation, and the eviction-during-commit regression
+(the trie-pin bug, replayed against the paged on_evict release path)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from easydist_tpu.kv import PagePool, PageTable
+from easydist_tpu.serve import PrefixCache
+
+
+class TestPagePool:
+    def test_alloc_returns_distinct_live_pages(self):
+        pool = PagePool(4, 8)
+        got = [pool.alloc() for _ in range(4)]
+        assert sorted(got) == [0, 1, 2, 3] or len(set(got)) == 4
+        assert pool.n_free == 0 and pool.in_use == 4
+        assert all(pool.refcount(p) == 1 for p in got)
+        assert pool.check_invariants() == []
+
+    def test_exhaustion_raises(self):
+        pool = PagePool(2, 8)
+        pool.alloc(), pool.alloc()
+        with pytest.raises(RuntimeError):
+            pool.alloc()
+
+    def test_share_release_refcounting(self):
+        pool = PagePool(4, 8)
+        p = pool.alloc()
+        pool.share(p)
+        assert pool.refcount(p) == 2
+        assert pool.release(p) == 1      # still live
+        assert pool.in_use == 1
+        assert pool.release(p) == 0      # reclaimed
+        assert pool.in_use == 0 and pool.n_free == 4
+        assert pool.check_invariants() == []
+
+    def test_release_freed_page_raises(self):
+        pool = PagePool(2, 8)
+        p = pool.alloc()
+        pool.release(p)
+        with pytest.raises(ValueError):
+            pool.release(p)
+
+    def test_share_freed_page_raises(self):
+        pool = PagePool(2, 8)
+        p = pool.alloc()
+        pool.release(p)
+        with pytest.raises(ValueError):
+            pool.share(p)
+
+    def test_refcount_out_of_range_raises(self):
+        pool = PagePool(2, 8)
+        with pytest.raises(ValueError):
+            pool.refcount(2)
+        with pytest.raises(ValueError):
+            pool.refcount(-1)
+
+    def test_sentinel_is_n_pages(self):
+        assert PagePool(7, 8).sentinel == 7
+
+    def test_reclaimed_page_is_reallocatable(self):
+        pool = PagePool(1, 8)
+        p = pool.alloc()
+        pool.release(p)
+        assert pool.alloc() == p
+
+    def test_stats_counters(self):
+        pool = PagePool(4, 8, page_bytes=128)
+        a, b = pool.alloc(), pool.alloc()
+        pool.share(a)
+        pool.release(a)
+        pool.release(b)
+        s = pool.stats()
+        assert s["n_pages"] == 4 and s["page_tokens"] == 8
+        assert s["page_bytes"] == 128
+        assert s["allocs"] == 2 and s["shares"] == 1 and s["frees"] == 1
+        assert s["in_use"] == 1 and s["free"] == 3
+        assert s["peak_in_use"] == 2
+
+
+class TestPageTable:
+    def test_map_unmap_row(self):
+        tbl = PageTable(2, 3, n_pages=8)
+        assert tbl.sentinel == 8
+        assert (tbl.array == 8).all()
+        tbl.map(0, 0, 5)
+        tbl.map(0, 1, 2)
+        assert tbl.mapped(0) == [5, 2] and tbl.n_mapped(0) == 2
+        assert tbl.unmap_row(0) == [5, 2]
+        assert (tbl.array == 8).all()
+        assert tbl.check_invariants() == []
+
+    def test_remap_live_entry_raises(self):
+        tbl = PageTable(1, 2, n_pages=4)
+        tbl.map(0, 0, 1)
+        with pytest.raises(ValueError):
+            tbl.map(0, 0, 2)
+
+    def test_out_of_range_page_raises(self):
+        tbl = PageTable(1, 2, n_pages=4)
+        with pytest.raises(ValueError):
+            tbl.map(0, 0, 4)
+
+    def test_hole_in_live_prefix_is_an_invariant_violation(self):
+        # entry 1 mapped with entry 0 sentinel: the gather would pull a
+        # clipped garbage page at unmasked positions
+        tbl = PageTable(1, 3, n_pages=4)
+        tbl.array[0, 1] = 2
+        problems = tbl.check_invariants()
+        assert problems and any("hole" in p or "prefix" in p
+                                for p in problems)
+
+    def test_dtype_is_int32(self):
+        assert PageTable(2, 2, n_pages=4).array.dtype == np.int32
+
+
+class TestSeededStress:
+    """Satellite: a seeded random walk over alloc/share/release/map/
+    unmap/trie-commit/evict with `check_invariants` after every single
+    operation, cross-checked against a shadow refcount model."""
+
+    N_PAGES = 16
+    N_SLOTS = 4
+    MAX_PAGES = 4
+    CHUNK = 4
+    PAGE_BYTES = 64
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_random_walk_keeps_invariants(self, seed):
+        rng = random.Random(seed)
+        pool = PagePool(self.N_PAGES, self.CHUNK,
+                        page_bytes=self.PAGE_BYTES)
+        table = PageTable(self.N_SLOTS, self.MAX_PAGES, self.N_PAGES)
+        trie = PrefixCache(self.CHUNK, 6 * self.PAGE_BYTES,
+                           on_evict=lambda n: pool.release(n.kv["page"]))
+        shadow = {}          # pid -> expected refcount
+        rows = {}            # slot -> [pid, ...]
+        trie_serial = 0      # unique token streams so commits never merge
+
+        def trie_holds():
+            # pid -> number of trie nodes holding it (the same slot
+            # page can be committed under several token streams)
+            out = {}
+            for n in trie._walk():
+                if isinstance(n.kv, dict) and "page" in n.kv:
+                    out[n.kv["page"]] = out.get(n.kv["page"], 0) + 1
+            return out
+
+        def rederive_shadow():
+            held = trie_holds()
+            for hpid in list(shadow):
+                expected = (sum(r.count(hpid) for r in rows.values())
+                            + held.get(hpid, 0))
+                if expected == 0:
+                    del shadow[hpid]
+                else:
+                    shadow[hpid] = expected
+
+        def check():
+            assert pool.check_invariants() == []
+            assert table.check_invariants() == []
+            assert trie.check_invariants() == []
+            for pid, rc in shadow.items():
+                assert pool.refcount(pid) == rc, (pid, rc)
+            assert pool.in_use == len(shadow)
+
+        for _ in range(400):
+            op = rng.choice(["admit", "retire", "share_into_trie",
+                             "evict", "noop"])
+            if op == "admit" and pool.n_free and any(
+                    s not in rows for s in range(self.N_SLOTS)):
+                slot = rng.choice([s for s in range(self.N_SLOTS)
+                                   if s not in rows])
+                n = rng.randint(1, min(self.MAX_PAGES, pool.n_free))
+                rows[slot] = []
+                for j in range(n):
+                    pid = pool.alloc()
+                    shadow[pid] = shadow.get(pid, 0) + 1
+                    table.map(slot, j, pid)
+                    rows[slot].append(pid)
+                    check()
+            elif op == "retire" and rows:
+                slot = rng.choice(list(rows))
+                got = table.unmap_row(slot)
+                assert got == rows.pop(slot)
+                for pid in got:
+                    shadow[pid] -= 1
+                    if pool.release(pid) == 0:
+                        assert shadow.pop(pid) == 0
+                    check()
+            elif op == "share_into_trie" and rows:
+                # a finishing prefill shares its first page into the trie
+                slot = rng.choice(list(rows))
+                pid = rows[slot][0]
+                pool.share(pid)
+                shadow[pid] += 1
+                toks = [trie_serial * self.CHUNK + t
+                        for t in range(self.CHUNK)]
+                trie_serial += 1
+                node = trie.commit([], toks, {"page": pid},
+                                   nbytes=self.PAGE_BYTES)
+                if node is None:      # refused (budget): undo the share
+                    shadow[pid] -= 1
+                    pool.release(pid)
+                else:
+                    # budget pressure may have evicted OTHER nodes
+                    # during commit; their on_evict already released —
+                    # re-derive shadow from the surviving holders
+                    rederive_shadow()
+                check()
+            elif op == "evict":
+                before = pool.in_use
+                if trie.evict_lru():
+                    rederive_shadow()
+                else:
+                    assert pool.in_use == before
+                check()
+
+        # drain: everything releasable releases cleanly, nothing leaks
+        for slot in list(rows):
+            for pid in table.unmap_row(slot):
+                pool.release(pid)
+            del rows[slot]
+        while trie.evict_lru():
+            pass
+        assert pool.in_use == 0 and pool.n_free == self.N_PAGES
+        assert pool.check_invariants() == []
+
+
+class TestEvictionDuringCommit:
+    """Regression twin of the trie-pin bug: committing a new chunk under
+    byte pressure must never evict the path being extended, and with the
+    paged on_evict wired, the eviction a commit DOES trigger must release
+    exactly the evicted node's page — no use-after-free, no leak."""
+
+    CHUNK = 4
+    PB = 64
+
+    def _rig(self, budget_pages):
+        pool = PagePool(8, self.CHUNK, page_bytes=self.PB)
+        trie = PrefixCache(self.CHUNK, budget_pages * self.PB,
+                           on_evict=lambda n: pool.release(n.kv["page"]))
+        return pool, trie
+
+    def test_commit_does_not_evict_its_own_path(self):
+        pool, trie = self._rig(budget_pages=2)
+        p0 = pool.alloc()
+        parent = trie.commit([], [1, 2, 3, 4], {"page": p0},
+                             nbytes=self.PB)
+        assert parent is not None
+        filler = pool.alloc()
+        assert trie.commit([], [9, 9, 9, 9], {"page": filler},
+                           nbytes=self.PB) is not None
+        # budget full; extending [parent] must evict the FILLER leaf,
+        # not the parent the new node hangs off
+        p1 = pool.alloc()
+        child = trie.commit([parent], [5, 6, 7, 8], {"page": p1},
+                            nbytes=self.PB)
+        assert child is not None
+        assert trie.lookup_node([], [1, 2, 3, 4]) is parent
+        assert trie.lookup_node([], [9, 9, 9, 9]) is None
+        # filler's page came back through on_evict, exactly once
+        assert pool.refcount(p0) == 1 and pool.refcount(p1) == 1
+        assert pool.in_use == 2
+        assert pool.check_invariants() == []
+        assert trie.check_invariants() == []
+
+    def test_evicted_page_shared_with_slot_stays_live(self):
+        # a slot still maps the page the trie drops: on_evict releases
+        # the TRIE's reference only; the slot's keeps the page alive
+        pool, trie = self._rig(budget_pages=1)
+        table = PageTable(1, 2, n_pages=8)
+        pid = pool.alloc()
+        table.map(0, 0, pid)
+        pool.share(pid)
+        assert trie.commit([], [1, 2, 3, 4], {"page": pid},
+                           nbytes=self.PB) is not None
+        filler = pool.alloc()
+        assert trie.commit([], [9, 9, 9, 9], {"page": filler},
+                           nbytes=self.PB) is not None  # evicts pid's node
+        assert trie.lookup_node([], [1, 2, 3, 4]) is None
+        assert pool.refcount(pid) == 1       # slot's reference survives
+        assert table.mapped(0) == [pid]
+        assert pool.check_invariants() == []
+
+    def test_pinned_node_survives_commit_pressure(self):
+        pool, trie = self._rig(budget_pages=1)
+        pid = pool.alloc()
+        node = trie.commit([], [1, 2, 3, 4], {"page": pid},
+                           nbytes=self.PB)
+        trie.pin([node])
+        other = pool.alloc()
+        refused = trie.commit([], [9, 9, 9, 9], {"page": other},
+                              nbytes=self.PB)
+        assert refused is None               # nothing evictable
+        assert pool.refcount(pid) == 1       # pinned page untouched
+        pool.release(other)                  # caller's refusal cleanup
+        trie.unpin([node])
+        assert pool.check_invariants() == []

@@ -1,0 +1,193 @@
+"""Paged decode attention: gather_pages reconstruction (incl. GQA),
+bitwise parity of the gather-fallback vs the contiguous reference on
+live rows, the Pallas page-chasing kernel (interpret mode) vs the
+fallback, garbage-page/dead-window masking, and backend dispatch."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from easydist_tpu.ops.flash_attention import (_decode_attention_xla,
+                                              _paged_decode_attention_xla,
+                                              decode_attention,
+                                              flash_paged_decode_attention,
+                                              gather_pages,
+                                              paged_decode_attention)
+
+PT = 8          # page_tokens
+MP = 4          # max_pages per row -> virtual cache length 32
+NP = 16         # arena pages
+
+
+def _arena(kvh=4, d=16, seed=0, n_pages=NP):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 2)
+    k = jax.random.normal(ks[0], (n_pages, kvh, PT, d), jnp.float32)
+    v = jax.random.normal(ks[1], (n_pages, kvh, PT, d), jnp.float32)
+    return k, v
+
+
+def _paged_setup(lengths, h=4, kvh=4, d=16, seed=0):
+    """Rows mapped to disjoint arena pages (row b gets pages b*MP..),
+    plus the contiguous twin cache the gather must reproduce."""
+    b = len(lengths)
+    kp, vp = _arena(kvh=kvh, d=d, seed=seed)
+    table = np.full((b, MP), NP, np.int32)
+    for bi in range(b):
+        n_live = -(-lengths[bi] // PT)
+        for j in range(n_live):
+            table[bi, j] = bi * MP + j
+    q = jax.random.normal(jax.random.PRNGKey(seed + 7), (b, h, d),
+                          jnp.float32)
+    # contiguous twin: gather each row's mapped pages back-to-back,
+    # clipped-sentinel windows land on the row's LAST live page
+    kc = np.zeros((b, kvh, MP * PT, d), np.float32)
+    vc = np.zeros((b, kvh, MP * PT, d), np.float32)
+    for bi in range(b):
+        for j in range(MP):
+            pid = min(table[bi, j], NP - 1) if table[bi, j] == NP else \
+                table[bi, j]
+            if table[bi, j] == NP:      # sentinel clips to NP-1
+                pid = NP - 1
+            kc[bi, :, j * PT:(j + 1) * PT] = np.asarray(kp[pid])
+            vc[bi, :, j * PT:(j + 1) * PT] = np.asarray(vp[pid])
+    return q, kp, vp, jnp.asarray(table), kc, vc
+
+
+class TestGatherPages:
+    def test_reconstructs_contiguous_cache(self):
+        q, kp, vp, table, kc, _ = _paged_setup([32, 17])
+        got = gather_pages(kp, table)
+        np.testing.assert_array_equal(np.asarray(got), kc)
+
+    def test_gqa_repeats_after_gather(self):
+        _, kp, _, table, kc, _ = _paged_setup([32, 17], kvh=2, h=4)
+        got = gather_pages(kp, table, n_heads=4)
+        assert got.shape == (2, 4, MP * PT, 16)
+        # repeat-then-attend order: heads 0,1 mirror kv head 0
+        np.testing.assert_array_equal(np.asarray(got[:, 0]),
+                                      np.asarray(got[:, 1]))
+        np.testing.assert_array_equal(np.asarray(got[:, 0]), kc[:, 0])
+
+    def test_sentinel_clips_to_last_page(self):
+        _, kp, _, table, _, _ = _paged_setup([8])   # 1 live page, 3 dead
+        got = gather_pages(kp, table)
+        # dead windows hold the CLIPPED page (NP-1) — finite garbage
+        np.testing.assert_array_equal(np.asarray(got[0, :, PT:2 * PT]),
+                                      np.asarray(kp[NP - 1]))
+
+
+class TestXlaFallbackParity:
+    @pytest.mark.parametrize("lengths", [[32, 17], [1, 8], [9, 25],
+                                         [32, 32]])
+    def test_bitwise_vs_contiguous_reference(self, lengths):
+        # same virtual length, same einsum shapes -> bitwise equality,
+        # the parity spine the paged serving path stands on
+        q, kp, vp, table, kc, vc = _paged_setup(lengths)
+        L = jnp.asarray(lengths, jnp.int32)
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        paged = _paged_decode_attention_xla(q, kp, vp, table, L, scale)
+        ref = _decode_attention_xla(q, jnp.asarray(kc), jnp.asarray(vc),
+                                    L, scale)
+        np.testing.assert_array_equal(np.asarray(paged), np.asarray(ref))
+
+    def test_garbage_pages_unobservable(self):
+        # poison every UNMAPPED arena page; masked rows contribute
+        # exactly zero softmax weight so outputs cannot move
+        q, kp, vp, table, _, _ = _paged_setup([17, 9])
+        L = jnp.asarray([17, 9], jnp.int32)
+        base = _paged_decode_attention_xla(q, kp, vp, table, L, 0.25)
+        mapped = {int(p) for p in np.asarray(table).ravel() if p < NP}
+        kp2, vp2 = np.asarray(kp).copy(), np.asarray(vp).copy()
+        for pid in range(NP):
+            if pid not in mapped:
+                kp2[pid] = 1e4
+                vp2[pid] = -1e4
+        noisy = _paged_decode_attention_xla(q, jnp.asarray(kp2),
+                                            jnp.asarray(vp2), table, L,
+                                            0.25)
+        np.testing.assert_array_equal(np.asarray(base),
+                                      np.asarray(noisy))
+
+    def test_gqa_matches_contiguous_gqa(self):
+        q, kp, vp, table, kc, vc = _paged_setup([25, 32], kvh=2, h=4)
+        L = jnp.asarray([25, 32], jnp.int32)
+        paged = _paged_decode_attention_xla(q, kp, vp, table, L, 0.25)
+        kf = jnp.repeat(jnp.asarray(kc), 2, axis=1)
+        vf = jnp.repeat(jnp.asarray(vc), 2, axis=1)
+        ref = _decode_attention_xla(q, kf, vf, L, 0.25)
+        np.testing.assert_array_equal(np.asarray(paged), np.asarray(ref))
+
+
+class TestFlashPagedKernelInterpret:
+    @pytest.mark.parametrize("lengths", [[32, 17], [1, 8], [9, 25]])
+    def test_matches_fallback(self, lengths):
+        q, kp, vp, table, _, _ = _paged_setup(lengths)
+        L = jnp.asarray(lengths, jnp.int32)
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        ref = _paged_decode_attention_xla(q, kp, vp, table, L, scale)
+        out = flash_paged_decode_attention(q, kp, vp, table, L,
+                                           scale=scale, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+
+    def test_gqa_matches_fallback(self):
+        q, kp, vp, table, _, _ = _paged_setup([25, 10], kvh=2, h=4)
+        L = jnp.asarray([25, 10], jnp.int32)
+        ref = _paged_decode_attention_xla(q, kp, vp, table, L, 0.25)
+        out = flash_paged_decode_attention(q, kp, vp, table, L,
+                                           scale=0.25, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+
+    def test_heads_not_multiple_of_kv_heads_raises(self):
+        q, kp, vp, table, _, _ = _paged_setup([8], kvh=4, h=4)
+        with pytest.raises(ValueError, match="kv_heads"):
+            flash_paged_decode_attention(q[:, :3], kp, vp, table,
+                                         jnp.asarray([8], jnp.int32),
+                                         interpret=True)
+
+
+class TestDispatch:
+    def test_auto_resolves_to_xla_off_tpu(self):
+        q, kp, vp, table, _, _ = _paged_setup([17, 9])
+        L = jnp.asarray([17, 9], jnp.int32)
+        out = paged_decode_attention(q, kp, vp, table, L, backend="auto")
+        ref = paged_decode_attention(q, kp, vp, table, L, backend="xla")
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_scalar_length_broadcasts(self):
+        q, kp, vp, table, _, _ = _paged_setup([9, 9])
+        out = paged_decode_attention(q, kp, vp, table, 9, backend="xla")
+        ref = paged_decode_attention(q, kp, vp, table,
+                                     jnp.asarray([9, 9], jnp.int32),
+                                     backend="xla")
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_unknown_backend_raises(self):
+        q, kp, vp, table, _, _ = _paged_setup([8])
+        with pytest.raises(ValueError, match="paged decode attention"):
+            paged_decode_attention(q, kp, vp, table, 8,
+                                   backend="tensorrt")
+
+    def test_contiguous_dispatcher_degrades_paged_to_auto(self):
+        # EASYDIST_DECODE_ATTENTION=paged on a contiguous call site:
+        # there is no table to chase, so it must fall through to auto
+        b, h, T, d = 2, 4, 32, 16
+        ks = jax.random.split(jax.random.PRNGKey(3), 3)
+        q = jax.random.normal(ks[0], (b, h, d), jnp.float32)
+        k = jax.random.normal(ks[1], (b, h, T, d), jnp.float32)
+        v = jax.random.normal(ks[2], (b, h, T, d), jnp.float32)
+        L = jnp.asarray([5, 30], jnp.int32)
+        out = decode_attention(q, k, v, L, backend="paged")
+        ref = decode_attention(q, k, v, L, backend="auto")
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_jittable(self):
+        q, kp, vp, table, _, _ = _paged_setup([17, 25])
+        L = jnp.asarray([17, 25], jnp.int32)
+        f = jax.jit(lambda *a: paged_decode_attention(*a, backend="xla"))
+        out = f(q, kp, vp, table, L)
+        ref = paged_decode_attention(q, kp, vp, table, L, backend="xla")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-6)
