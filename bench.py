@@ -1712,6 +1712,170 @@ def fleet_main():
     print(json.dumps(result), flush=True)
 
 
+def fleet_chaos_main():
+    """Chaos drill (`--fleet-chaos`): the fleet bench traffic over a
+    3-decode + 1-prefill `FleetRouter` while a seeded fault schedule
+    kills one replica mid-stream in EACH traffic wave; the dead id is
+    revived with a fresh session between waves (the `add_replica`
+    revive operation), so the drill exercises crash -> failover ->
+    rejoin under live load.
+
+    Prints ONE JSON line gated on: zero dropped requests, bitwise
+    greedy parity of every stream with the single-session run
+    (recovered requests resume token-for-token from their
+    ResumeDescriptors), at least one request actually recovered, every
+    scheduled fault firing (`faultinject.unfired()` read while armed),
+    a clean FLEET001/004 routing audit over the full decision log, and
+    chaos TTFT p99 within a bounded multiple of an identical calm arm.
+    Forced to CPU — the gate is recovery semantics, not device peak."""
+    result = {"metric": "fleet_chaos_survival", "value": 0.0,
+              "unit": "fraction"}
+    p99_bound = 10.0
+    try:
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+
+        from easydist_tpu.analyze import audit_routing
+        from easydist_tpu.fleet import (FleetConfig, FleetRouter,
+                                        InProcessTransport)
+        from easydist_tpu.models.gpt import GPTConfig, gpt_init
+        from easydist_tpu.resilience import faultinject
+        from easydist_tpu.serve import GenerationSession, ServeConfig
+        from easydist_tpu.serve.metrics import LatencyHistogram
+
+        seq, chunk, n_req, max_new = 256, 32, 16, 6
+        cfg = GPTConfig(vocab=256, seq=seq, dim=64, heads=4, layers=2,
+                        dtype="float32")
+        params = gpt_init(cfg, jax.random.PRNGKey(0))
+        rng = np.random.RandomState(0)
+        prefixes = [rng.randint(0, cfg.vocab, size=96).tolist()
+                    for _ in range(2)]
+        prompts = [prefixes[i % 2]
+                   + rng.randint(0, cfg.vocab, size=4 + i % 5).tolist()
+                   for i in range(n_req)]
+
+        def mk(rid):
+            sc = ServeConfig(decode_buckets=(seq,), max_decode_slots=4,
+                             prefill_chunk=chunk, prefill_batch=4)
+            return GenerationSession.for_gpt(params, cfg, config=sc,
+                                             replica_id=rid)
+
+        # single-session reference: the bitwise target for both arms
+        ref = mk("ref")
+        ref_futs = [ref.submit(p, max_new_tokens=max_new)
+                    for p in prompts]
+        ref.run_until_drained()
+        want = [f.result(timeout=5)["ids"] for f in ref_futs]
+
+        def merged_ttft_p99_ms(router):
+            m = LatencyHistogram()
+            for rep in router.stats()["replicas"]:
+                h = router.replica(rep).session.metrics.ttft
+                for i, c in enumerate(h.counts):
+                    m.counts[i] += c
+                m.total += h.total
+                m.sum += h.sum
+            return (m.percentile(99) or 0) * 1e3
+
+        def mk_fleet(tag):
+            return FleetRouter(
+                [mk(f"{tag}0"), mk(f"{tag}1"), mk(f"{tag}2")],
+                prefill_replicas=[mk(f"{tag}p")],
+                transport=InProcessTransport(),
+                config=FleetConfig(seed=0))
+
+        # calm arm: identical fleet + traffic, no faults — the p99
+        # baseline the chaos arm's inflation is measured against
+        calm = mk_fleet("k")
+        calm_futs = [calm.submit(p, max_new_tokens=max_new)
+                     for p in prompts[:n_req // 2]]
+        calm.run_until_drained()
+        calm_futs += [calm.submit(p, max_new_tokens=max_new)
+                      for p in prompts[n_req // 2:]]
+        calm.run_until_drained()
+        calm_ids = [f.result(timeout=5)["ids"] for f in calm_futs]
+        calm_p99 = merged_ttft_p99_ms(calm)
+
+        # chaos arm: each wave kills the replica serving the wave's
+        # first routed request in its 3rd fleet round, mid-decode
+        router = mk_fleet("c")
+        db = None
+        futs, crash_targets = [], []
+        unfired_total = 0
+        for wave in range(2):
+            lo = wave * (n_req // 2)
+            n_before = len(router.decision_log)
+            futs += [router.submit(p, max_new_tokens=max_new)
+                     for p in prompts[lo:lo + n_req // 2]]
+            target = router.decision_log[n_before]["replica_id"]
+            # one crash_point hit per live replica per router.step(),
+            # in registration order — aim at `target` in step 3, when
+            # its streams are mid-decode with tokens already emitted
+            order = list(router.stats()["replicas"])
+            occ = 2 * len(order) + order.index(target) + 1
+            with faultinject.fault_plan(f"fleet.replica.crash@{occ}"):
+                router.run_until_drained()
+                unfired_total += len(faultinject.unfired())
+                db = faultinject.export_stats(db=db)
+            crash_targets.append(target)
+            router.add_replica(mk(target))  # revive under the same id
+        out = [f.result(timeout=5) for f in futs]
+        ids = [o["ids"] for o in out]
+        dropped = sum(o["finish_reason"] not in ("length", "eos")
+                      for o in out)
+        recovered = router.metrics.counter("requests_recovered")
+        crashes = router.metrics.counter("replica_crashes")
+        routing_findings = audit_routing(router.decision_log)
+        chaos_p99 = merged_ttft_p99_ms(router)
+        inflation = chaos_p99 / calm_p99 if calm_p99 > 0 else 1.0
+
+        parity = ids == want and calm_ids == want
+        clean = sum(o["ids"] == w and o["finish_reason"] in
+                    ("length", "eos") for o, w in zip(out, want))
+        log(f"# fleet chaos: killed {crash_targets}, recovered "
+            f"{recovered} request(s), dropped {dropped}, parity="
+            f"{parity}, ttft p99 {chaos_p99:.0f}ms vs calm "
+            f"{calm_p99:.0f}ms ({inflation:.1f}x)")
+
+        ok = (parity and dropped == 0 and recovered > 0
+              and crashes == 2 and unfired_total == 0
+              and not routing_findings and inflation <= p99_bound)
+        result.update(
+            value=round(clean / n_req, 4),
+            parity_bitwise=bool(parity),
+            dropped_requests=int(dropped),
+            requests_recovered=int(recovered),
+            replica_crashes=int(crashes),
+            crashes_scheduled=2,
+            crash_targets=crash_targets,
+            fault_plan_unfired=int(unfired_total),
+            routing_findings=len(routing_findings),
+            handoff_fallbacks=int(router.metrics.counter(
+                "handoff_fallbacks")),
+            prefill_handoffs=int(router.metrics.counter(
+                "prefill_handoffs")),
+            ttft_p99_ms=round(chaos_p99, 2),
+            calm_ttft_p99_ms=round(calm_p99, 2),
+            ttft_p99_inflation=round(inflation, 2),
+            ttft_p99_bound=p99_bound,
+            device=jax.devices()[0].device_kind,
+            n_replicas=3, n_prefill_replicas=1,
+            seq=seq, prefill_chunk=chunk, n_requests=n_req,
+            verdict="ok" if ok else "regression")
+        router.export_metrics(db=db, persist=True)
+    except Exception as e:  # always land the JSON line
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        result["error"] = f"{type(e).__name__}: {e}"
+        result["verdict"] = "error"
+    _annotate_vs_last_good(result)
+    _maybe_update_last_good(result)
+    print(json.dumps(result), flush=True)
+
+
 if __name__ == "__main__":
     if "--serve" in sys.argv:
         serve_main()
@@ -1727,6 +1891,8 @@ if __name__ == "__main__":
         decode_main()
     elif "--prefill" in sys.argv:
         prefill_main()
+    elif "--fleet-chaos" in sys.argv:
+        fleet_chaos_main()
     elif "--fleet" in sys.argv:
         fleet_main()
     elif "--child" in sys.argv:

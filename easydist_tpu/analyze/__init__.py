@@ -41,7 +41,9 @@ A rule-based analyzer that runs after solving and before execution
            `audit_drained_session`) — multi-replica serving hygiene:
            FLEET001 routing into a tripped-breaker/draining replica,
            FLEET002 KV page handoffs whose payload disagrees with the
-           sha256 manifest, FLEET003 orphaned pinned trie pages left
+           sha256 manifest, FLEET004 dispatch to a DEAD replica,
+           FLEET005 resume descriptors that would break bitwise
+           recovery, FLEET003 orphaned pinned trie pages left
            behind by a drain.
 
 Surfaced via `CompiledFunction.analyze()`, `bench.py --analyze`, and the
@@ -58,7 +60,7 @@ import logging
 from .findings import (RULES, SEV_INFO, AnalysisError, AnalysisReport,
                        Finding, make_finding)
 from .fleet_rules import (audit_drained_session, audit_page_handoff,
-                          audit_routing)
+                          audit_resume, audit_routing)
 from .jaxpr_rules import lint_bucket_plan, lint_fn, lint_jaxpr
 from .kv_rules import audit_page_table
 from .memory_rules import (audit_remat_plan, check_hbm_budget,
@@ -91,7 +93,9 @@ __all__ = [
     "audit_chunked_prefill", "audit_prefix_cache",
     "check_chunked_prefill", "check_prefix_cache",
     "audit_routing", "audit_page_handoff", "audit_drained_session",
+    "audit_resume",
     "check_fleet_routing", "check_page_handoff", "check_fleet_drain",
+    "check_resume_descriptor",
     "audit_page_table", "check_page_table",
 ]
 
@@ -252,6 +256,24 @@ def check_fleet_drain(session, node: str = "drain"):
     (orphaned pinned pages / trie bookkeeping drift on a drained
     session) — warning severity, logs and returns the findings."""
     findings = audit_drained_session(session, node=node)
+    for f in findings:
+        logger.warning("[analyze] %s", f)
+    return findings
+
+
+def check_resume_descriptor(descriptor, resume_prompt=None,
+                            node: str = "resume"):
+    """Resume-time self-check hook for the fleet failover path: FLEET005
+    (descriptor disagrees with the original request — prefix mismatch,
+    budget overrun, or eos already emitted) raises under `analyze_raise`
+    BEFORE the resubmit, so a recovery that would silently change tokens
+    fails loudly instead.  Returns the findings."""
+    from easydist_tpu import config as edconfig
+
+    findings = audit_resume(descriptor, resume_prompt, node=node)
+    report = AnalysisReport(findings)
+    if report.errors() and edconfig.analyze_raise:
+        report.raise_on_errors()
     for f in findings:
         logger.warning("[analyze] %s", f)
     return findings

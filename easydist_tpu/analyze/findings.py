@@ -149,6 +149,16 @@ RULES: Dict[str, tuple] = {
                  "drained replica's trie still holds pinned pages "
                  "(pin/unpin imbalance): unevictable orphans keep device "
                  "memory from releasing"),
+    "FLEET004": (SEV_ERROR,
+                 "request dispatched to a replica the health monitor had "
+                 "marked DEAD — the router's eligibility filter must "
+                 "exclude dead replicas exactly like OPEN breakers; the "
+                 "request would strand on a corpse"),
+    "FLEET005": (SEV_ERROR,
+                 "resume descriptor inconsistent with its original "
+                 "request (resubmitted prefix != prompt + emitted ids, "
+                 "budget overrun, or eos already emitted) — recovery "
+                 "would silently change output tokens"),
 }
 
 

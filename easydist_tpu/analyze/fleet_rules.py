@@ -1,7 +1,7 @@
 """Layer 6: fleet auditor — routing health, KV handoff integrity, drain
-hygiene (`easydist_tpu.fleet`).
+hygiene, failover correctness (`easydist_tpu.fleet`).
 
-Three failure shapes a multi-replica serving fleet adds on top of the
+Failure shapes a multi-replica serving fleet adds on top of the
 single-session audits:
 
   FLEET001 (error)   a request routed to a replica whose circuit breaker
@@ -21,19 +21,33 @@ single-session audits:
                      leftover refcounts mean a pin/unpin imbalance — the
                      pages can never be evicted and the drained session's
                      device memory never fully releases.
+  FLEET004 (error)   a request dispatched to a replica the health monitor
+                     had marked DEAD.  DEAD must gate eligibility exactly
+                     like an OPEN breaker; a decision showing "dead" means
+                     load was steered onto a corpse and the request
+                     strands until some other layer times it out.
+  FLEET005 (error)   a crash/evacuate resume descriptor that disagrees
+                     with its original request: the resubmitted prefix is
+                     not exactly prompt + already-emitted ids, the emitted
+                     ids already exhaust the budget, or they already
+                     contain eos.  Any of these means the "recovered"
+                     continuation would differ from the uninterrupted
+                     run — a silent bitwise break, the one thing the
+                     failover layer exists to prevent.
 
-All three audit plain data surfaces (the router's decision log, a
-transfer manifest + payload, a drained session's tries), so goldens are
-cheap fixtures, not compiled programs.
+All of these audit plain data surfaces (the router's decision log, a
+transfer manifest + payload, a drained session's tries, a resume
+descriptor), so goldens are cheap fixtures, not compiled programs.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 from .findings import Finding, make_finding
 
-__all__ = ["audit_routing", "audit_page_handoff", "audit_drained_session"]
+__all__ = ["audit_routing", "audit_page_handoff", "audit_drained_session",
+           "audit_resume"]
 
 
 def audit_routing(decisions: Sequence[Dict[str, object]],
@@ -56,6 +70,49 @@ def audit_routing(decisions: Sequence[Dict[str, object]],
                 f"routed to replica {rid!r} that was already draining — "
                 f"its session rejects the submit and the request "
                 f"bounces"))
+        if d.get("health") == "dead":
+            findings.append(make_finding(
+                "FLEET004", where,
+                f"dispatched to replica {rid!r} the health monitor had "
+                f"marked DEAD — eligibility must exclude dead replicas "
+                f"exactly like OPEN breakers"))
+    return findings
+
+
+def audit_resume(descriptor: Dict[str, object],
+                 resume_prompt: Optional[Sequence[int]] = None,
+                 node: str = "resume") -> List[Finding]:
+    """FLEET005 over one resume descriptor (fleet/failover.py
+    `ResumeDescriptor.as_dict()` shape) and optionally the exact token
+    prefix about to be resubmitted.  The bitwise-recovery contract:
+    resubmit == prompt + already-emitted ids, with budget left and no
+    eos in the emitted stream."""
+    findings: List[Finding] = []
+    where = f"{node}.request[{descriptor.get('request_id')}]"
+    prompt = [int(t) for t in descriptor.get("prompt", [])]
+    ids = [int(t) for t in descriptor.get("ids", [])]
+    max_new = descriptor.get("max_new")
+    eos_id = descriptor.get("eos_id")
+    if resume_prompt is not None \
+            and [int(t) for t in resume_prompt] != prompt + ids:
+        findings.append(make_finding(
+            "FLEET005", where,
+            f"resubmitted prefix ({len(list(resume_prompt))} tokens) is "
+            f"not prompt + emitted ids ({len(prompt)}+{len(ids)} "
+            f"tokens) — the continuation would diverge from the "
+            f"uninterrupted run"))
+    if isinstance(max_new, int) and len(ids) >= max_new:
+        findings.append(make_finding(
+            "FLEET005", where,
+            f"descriptor resumes with no budget left ({len(ids)} emitted "
+            f">= max_new {max_new}) — the request already finished as "
+            f"'length' and must not resubmit"))
+    if eos_id is not None and eos_id in ids:
+        findings.append(make_finding(
+            "FLEET005", where,
+            f"emitted ids already contain eos {eos_id} — the request "
+            f"already finished and a resume would generate tokens past "
+            f"the stop"))
     return findings
 
 

@@ -17,13 +17,35 @@ Scales serve/ horizontally without touching the bitwise spine:
     so cold-prefix placement is sticky and a drain only remaps ~1/N of
     the keyspace.
 
+Fault tolerance (this layer's tested contract, not an aspiration):
+
+  * `HealthMonitor` — step-liveness heartbeat over the counters every
+    session already keeps; a replica holding live work whose counters
+    stop advancing goes SUSPECT then DEAD (ineligible like an OPEN
+    breaker) and the router fails it over;
+  * `ResumeDescriptor` — per-request emitted-token mirror synced after
+    every step; crash recovery resubmits prompt+ids to a survivor and
+    the bitwise spine makes the continuation token-for-token identical;
+  * hardened transport — `send_pages` adds deadline + jittered-backoff
+    retry and idempotent manifest-keyed commits over verify-then-commit
+    `transfer`; a half-arrived or bit-flipped page never enters a trie;
+  * `PoisonRequestError` — a request that crashes `quarantine_after`
+    distinct replicas is rejected structurally instead of rolling
+    through the fleet.
+
 Fleet outputs are bitwise-identical to a single session's: all replicas
 run the same params/programs, prefix restore equals recompute, and greedy
 continuation is a pure function of the token prefix.  docs/SERVING.md
-covers the design; FLEET001-003 in docs/ANALYZE.md are the audits.
+covers the design; FLEET001-005 in docs/ANALYZE.md are the audits and
+docs/RESILIENCE.md §7 the fault catalog.
 """
 
+from .failover import PoisonRequestError, ResumeDescriptor  # noqa: F401
 from .hashring import HashRing, prefix_hash_key  # noqa: F401
+from .health import (ALIVE, DEAD, SUSPECT, HealthConfig,  # noqa: F401
+                     HealthMonitor)
 from .router import FleetConfig, FleetRouter, Replica  # noqa: F401
 from .transport import (InProcessTransport, KVTransport,  # noqa: F401
-                        page_manifest, verify_manifest)
+                        PageCorruptError, TransportError,
+                        TransportStallError, manifest_key, page_manifest,
+                        verify_manifest)

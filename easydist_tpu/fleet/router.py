@@ -32,6 +32,21 @@ stall — zero downtime), migrates its hot trie pages to the survivors,
 and audits the emptied trie for orphaned pins (FLEET003).  `evacuate`
 mode retires live decodes immediately with partial ids and the router
 resubmits prompt+partial elsewhere, bitwise-seamlessly.
+
+Fault tolerance (fleet/failover.py + fleet/health.py): a replica whose
+`step()` raises — or that a `HealthMonitor` probe declares DEAD (alive
+but making no progress with live work) — is removed on the spot and its
+stranded requests resume on survivors from their `ResumeDescriptor`s:
+the router syncs each request's already-emitted ids from the live
+session after every successful step (what a streaming client has
+already received), so recovery resubmits prompt+ids with the remaining
+budget and the bitwise spine guarantees the continuation is
+token-for-token identical.  Every resume is audited first (FLEET005);
+routing a request to a DEAD replica is the FLEET004 error.  A request
+that crashes `quarantine_after` distinct replicas is poison — its
+future fails with `PoisonRequestError` instead of rolling through the
+fleet.  Re-registering a crashed replica id via `add_replica` is the
+revive operation (the chaos drill's schedule does exactly that).
 """
 
 from __future__ import annotations
@@ -46,12 +61,15 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from easydist_tpu.resilience.breaker import OPEN, CircuitBreaker
 from easydist_tpu.serve.admission import (AdmissionController,
                                           CircuitOpenError,
+                                          DeadlineExceededError,
                                           RequestTooLargeError)
 from easydist_tpu.serve.batcher import select_bucket
 from easydist_tpu.serve.metrics import ServeMetrics
 
+from .failover import PoisonRequestError, ResumeDescriptor
 from .hashring import HashRing, prefix_hash_key
-from .transport import InProcessTransport, KVTransport, page_manifest
+from .health import DEAD, HealthConfig, HealthMonitor
+from .transport import InProcessTransport, KVTransport, TransportError
 
 logger = logging.getLogger(__name__)
 
@@ -73,6 +91,14 @@ class FleetConfig:
         raise QueueFullError (the admission layer's check).
     default_deadline_ms: deadline stamped on submits that pass none.
     seed: rng seed for the "random" policy (deterministic benches).
+    probe_interval_ms / miss_budget: HealthMonitor knobs — min wall-clock
+        between liveness probe rounds (0 probes every step) and
+        consecutive missed probes before a replica is declared DEAD.
+    quarantine_after: distinct replicas one request may crash before its
+        future fails with PoisonRequestError instead of resubmitting.
+    handoff_retries / handoff_backoff_ms / handoff_deadline_ms: transport
+        send_pages retry policy for prefill handoff and drain migration
+        (deadline None = retries alone bound the attempt count).
     """
     affinity_weight: float = 2.0
     occupancy_weight: float = 1.0
@@ -81,6 +107,12 @@ class FleetConfig:
     max_queue: int = 1024
     default_deadline_ms: Optional[float] = None
     seed: int = 0
+    probe_interval_ms: float = 0.0
+    miss_budget: int = 3
+    quarantine_after: int = 3
+    handoff_retries: int = 2
+    handoff_backoff_ms: float = 5.0
+    handoff_deadline_ms: Optional[float] = None
 
     def __post_init__(self):
         if self.policy not in ("affinity", "random"):
@@ -89,6 +121,12 @@ class FleetConfig:
             raise ValueError(f"vnodes must be >= 1, got {self.vnodes}")
         if self.affinity_weight < 0 or self.occupancy_weight < 0:
             raise ValueError("routing weights must be >= 0")
+        if self.quarantine_after < 1:
+            raise ValueError(
+                f"quarantine_after must be >= 1, got "
+                f"{self.quarantine_after}")
+        if self.handoff_retries < 0:
+            raise ValueError("handoff_retries must be >= 0")
 
 
 @dataclass
@@ -106,17 +144,25 @@ class Replica:
 
 @dataclass
 class _Inflight:
-    """Router-side record of one request across replica hops."""
-    request_id: int
-    prompt: List[int]
-    max_new: int
-    eos_id: Optional[int]
+    """Router-side record of one request across replica hops.
+
+    `resume` is the live ResumeDescriptor: its `ids` mirror what the
+    serving session has emitted so far (synced after every successful
+    step), which is exactly the recovery state a crash needs.
+    `hop_base` is the `ids` snapshot at the current submission — the
+    tokens emitted by PREVIOUS hops, which the current session's partial
+    output concatenates onto."""
+    resume: ResumeDescriptor
     future: Future                       # the caller's future
-    acc_ids: List[int] = field(default_factory=list)
+    hop_base: List[int] = field(default_factory=list)
     replica_id: Optional[str] = None
     inner: Optional[Future] = None       # current session future
     deadline_t: Optional[float] = None
     t_submit: float = 0.0
+
+    @property
+    def request_id(self) -> int:
+        return self.resume.request_id
 
 
 @dataclass
@@ -135,9 +181,13 @@ class FleetRouter:
     def __init__(self, replicas: Sequence, *,
                  prefill_replicas: Sequence = (),
                  config: Optional[FleetConfig] = None,
-                 transport: Optional[KVTransport] = None):
+                 transport: Optional[KVTransport] = None,
+                 health: Optional[HealthMonitor] = None):
         self.config = config or FleetConfig()
         self.transport = transport or InProcessTransport()
+        self.health = health or HealthMonitor(HealthConfig(
+            probe_interval_ms=self.config.probe_interval_ms,
+            miss_budget=self.config.miss_budget))
         self._replicas: Dict[str, Replica] = {}
         self._ring = HashRing(vnodes=self.config.vnodes)
         self._prefill_ring = HashRing(vnodes=self.config.vnodes)
@@ -155,10 +205,11 @@ class FleetRouter:
         self._inflight: Dict[int, _Inflight] = {}
         self._handoffs: List[_Handoff] = []
         self._next_request_id = 0
-        # audit surfaces: FLEET001 reads the decision log, FLEET003 the
-        # drain log; both bounded so a long-lived router stays O(1)
+        # audit surfaces: FLEET001/004 read the decision log, FLEET003
+        # the drain log; all bounded so a long-lived router stays O(1)
         self.decision_log: List[Dict[str, object]] = []
         self.drain_log: List[Dict[str, object]] = []
+        self.crash_log: List[Dict[str, object]] = []
         self._log_cap = 1024
 
     # ------------------------------------------------------------ replicas
@@ -184,6 +235,9 @@ class FleetRouter:
                       role=role)
         self._replicas[rid] = rep
         (self._ring if role == "decode" else self._prefill_ring).add(rid)
+        # re-registering a previously-crashed id is the REVIVE operation:
+        # clear its DEAD tombstone so routing sees the fresh session
+        self.health.revive(rid)
         return rep
 
     def replica(self, replica_id: str) -> Replica:
@@ -194,6 +248,12 @@ class FleetRouter:
 
     def _prefill_replicas(self) -> List[Replica]:
         return [r for r in self._replicas.values() if r.role == "prefill"]
+
+    def _eligible(self, rep: Replica) -> bool:
+        """Replica-level eligibility (draining/breaker) AND health: a
+        DEAD replica is ineligible exactly like an OPEN breaker."""
+        return rep.eligible() \
+            and self.health.state(rep.replica_id) != DEAD
 
     # ------------------------------------------------------------- routing
     def _aligned_prefix(self, prompt: Sequence[int]) -> List[int]:
@@ -213,8 +273,9 @@ class FleetRouter:
 
     def _route(self, prompt: Sequence[int],
                request_id: int) -> Replica:
-        """Pick the decode replica; logs the decision for FLEET001."""
-        eligible = [r for r in self._decode_replicas() if r.eligible()]
+        """Pick the decode replica; logs the decision for FLEET001/004."""
+        eligible = [r for r in self._decode_replicas()
+                    if self._eligible(r)]
         if not eligible:
             waits = [r.breaker.retry_after_s()
                      for r in self._decode_replicas() if r.breaker]
@@ -252,6 +313,7 @@ class FleetRouter:
             "breaker_state": (chosen.breaker.state if chosen.breaker
                               else "closed"),
             "draining": chosen.session.is_draining,
+            "health": self.health.state(chosen.replica_id),
             "affinity_tokens": affinity,
             "prompt_tokens": len(prompt),
             "policy": self.config.policy,
@@ -285,8 +347,9 @@ class FleetRouter:
         deadline_t = self.admission.resolve_deadline(deadline_ms)
         rid = self._next_request_id
         self._next_request_id += 1
-        rec = _Inflight(request_id=rid, prompt=prompt,
-                        max_new=max_new_tokens, eos_id=eos_id,
+        rec = _Inflight(resume=ResumeDescriptor(
+                            request_id=rid, prompt=prompt,
+                            max_new=max_new_tokens, eos_id=eos_id),
                         future=Future(), deadline_t=deadline_t,
                         t_submit=time.perf_counter())
         chosen = self._route(prompt, rid)
@@ -297,6 +360,7 @@ class FleetRouter:
                 prompt, max_new_tokens=max_new_tokens, eos_id=eos_id)
         self.metrics.inc("requests_submitted")
         self.metrics.set_gauge("queue_depth", self.total_queue_depth)
+        self.metrics.set_gauge("router_inflight", len(self._inflight))
         return rec.future
 
     def _start_disaggregated(self, rec: _Inflight,
@@ -305,15 +369,17 @@ class FleetRouter:
         that saves decode-side prefill; returns False to submit directly
         (no prefill tier, prompt under one page, decode trie already
         warm, or page sizes disagree across tiers)."""
-        prefill = [r for r in self._prefill_replicas() if r.eligible()]
+        prefill = [r for r in self._prefill_replicas()
+                   if self._eligible(r)]
         if not prefill:
             return False
-        aligned = self._aligned_prefix(rec.prompt)
-        chunk = decode_rep.session.bucket_chunk(rec.prompt)
+        prompt = rec.resume.prompt
+        aligned = self._aligned_prefix(prompt)
+        chunk = decode_rep.session.bucket_chunk(prompt)
         if not chunk or len(aligned) < chunk \
-                or len(aligned) == len(rec.prompt):
+                or len(aligned) == len(prompt):
             return False
-        if decode_rep.session.prefix_affinity(rec.prompt) >= len(aligned):
+        if decode_rep.session.prefix_affinity(prompt) >= len(aligned):
             return False  # decode trie already holds everything aligned
         src = prefill[0]
         if len(prefill) > 1:
@@ -341,26 +407,164 @@ class FleetRouter:
     def step(self) -> int:
         """One fleet round: step EVERY replica (draining ones included —
         their in-flight work retires while the others keep serving; that
-        is the zero-downtime property), then harvest handoffs, replica
-        hops, completions, and finished drains.  Returns decode tokens
-        generated across the fleet this round."""
+        is the zero-downtime property), sync per-request progress into
+        resume descriptors, run the health probe, then harvest handoffs,
+        replica hops, completions, and finished drains.  A replica whose
+        step raises — or that the probe declares DEAD — fails over on
+        the spot (`_on_replica_crash`): the fleet keeps serving.
+        Returns decode tokens generated across the fleet this round."""
         tokens = 0
-        for rep in self._replicas.values():
+        for rep in list(self._replicas.values()):
             sess = rep.session
             had_work = not sess.is_drained
             try:
                 tokens += sess.step()
-            except Exception:
+            except Exception as e:
                 if rep.breaker is not None:
                     rep.breaker.record_failure()
-                raise
+                self._on_replica_crash(rep, e)
+                continue
             if had_work and rep.breaker is not None:
                 rep.breaker.record_success()
+            self._sync_progress(rep)
+        for rid in self.health.probe(list(self._replicas.values())):
+            # alive-but-wedged: no counter progress with live work for
+            # miss_budget consecutive probes — treat exactly as a crash
+            self._on_replica_crash(
+                self._replicas[rid],
+                RuntimeError(f"health probe declared {rid} dead "
+                             f"(no progress with live work)"))
         self._poll_handoffs()
         self._poll_inflight()
         self._poll_drains()
+        self._gc_inflight()
         self.metrics.set_gauge("queue_depth", self.total_queue_depth)
+        self.metrics.set_gauge("router_inflight", len(self._inflight))
         return tokens
+
+    def _sync_progress(self, rep: Replica) -> None:
+        """Mirror the session's per-request emitted ids into the router's
+        resume descriptors — the state a streaming client has already
+        received, and therefore the exact prefix recovery must continue
+        from if this replica dies before its next step."""
+        live = {id(e["future"]): e
+                for e in rep.session.snapshot_inflight()}
+        for rec in self._inflight.values():
+            if rec.replica_id != rep.replica_id or rec.inner is None:
+                continue
+            entry = live.get(id(rec.inner))
+            if entry is not None:
+                rec.resume.ids = rec.hop_base + list(entry["ids"])
+
+    # ------------------------------------------------------------- failover
+    def _on_replica_crash(self, rep: Replica, exc: Exception) -> None:
+        """Remove a dead replica and recover its stranded work: pending
+        prefill handoffs fall back to direct prefill, every in-flight
+        request resumes on a survivor from its ResumeDescriptor (or is
+        quarantined once it has crashed `quarantine_after` distinct
+        replicas).  The dead session's trie pages are NOT migrated —
+        unlike a drain, nothing it holds can be trusted."""
+        rid = rep.replica_id
+        logger.error("replica %s crashed (%s); failing over", rid, exc)
+        self.health.mark_dead(rid, reason=str(exc))
+        (self._ring if rep.role == "decode"
+         else self._prefill_ring).remove(rid)
+        self._replicas.pop(rid, None)
+        self.metrics.inc("replica_crashes")
+        self._log(self.crash_log, {
+            "replica_id": rid, "role": rep.role, "error": repr(exc)})
+        for h in list(self._handoffs):
+            if h.prefill_replica != rid:
+                continue  # dead decode targets re-route at harvest
+            self._handoffs.remove(h)
+            rec = self._inflight.get(h.request_id)
+            if rec is not None:
+                self.metrics.inc("handoff_fallbacks")
+                self._recover_request(rec, rid)
+        for rec in list(self._inflight.values()):
+            if rec.replica_id != rid:
+                continue
+            if rec.inner is not None and rec.inner.done():
+                continue  # retired before the crash; harvests normally
+            if any(h.request_id == rec.request_id
+                   for h in self._handoffs):
+                continue  # prefill still running; harvest re-routes
+            self._recover_request(rec, rid)
+
+    def _recover_request(self, rec: _Inflight, crashed_rid: str) -> None:
+        """Quarantine-or-resume one request stranded by a crash."""
+        rec.resume.crashed_on.add(crashed_rid)
+        if len(rec.resume.crashed_on) >= self.config.quarantine_after:
+            del self._inflight[rec.request_id]
+            rec.future.set_exception(PoisonRequestError(
+                rec.request_id, rec.resume.crashed_on))
+            self.metrics.inc("requests_quarantined")
+            self.metrics.inc("requests_failed")
+            logger.error("request %d quarantined after crashing "
+                         "replicas %s", rec.request_id,
+                         sorted(rec.resume.crashed_on))
+            return
+        self._resubmit(rec)
+        self.metrics.inc("requests_recovered")
+
+    def _resubmit(self, rec: _Inflight) -> None:
+        """Continue `rec` on a surviving replica from its descriptor:
+        resubmit prompt + emitted ids with the remaining budget.  Audited
+        first (FLEET005) — a descriptor that would change tokens must
+        fail loudly, never resume silently wrong."""
+        desc = rec.resume
+        resume_prompt = desc.resume_prompt()
+        self._audit_resume(desc, resume_prompt)
+        try:
+            nxt = self._route(resume_prompt, rec.request_id)
+        except CircuitOpenError as e:
+            self._inflight.pop(rec.request_id, None)
+            rec.future.set_exception(e)
+            self.metrics.inc("requests_failed")
+            return
+        rec.replica_id = nxt.replica_id
+        rec.hop_base = list(desc.ids)
+        rec.inner = nxt.session.submit(
+            resume_prompt, max_new_tokens=desc.remaining(),
+            eos_id=desc.eos_id)
+
+    def _audit_resume(self, desc: ResumeDescriptor,
+                      resume_prompt: List[int]) -> None:
+        try:
+            from easydist_tpu.analyze import check_resume_descriptor
+
+            check_resume_descriptor(
+                desc.as_dict(), resume_prompt,
+                node=f"resume[{desc.request_id}]")
+        except ImportError:
+            pass
+
+    def _gc_inflight(self) -> None:
+        """Bound `_inflight`: drop externally-cancelled entries, fail
+        deadline-expired ones, and resume orphans whose replica vanished
+        without a crash record (defense in depth — the crash path
+        normally resubmits immediately)."""
+        now = time.monotonic()
+        for rid, rec in list(self._inflight.items()):
+            if rec.future.done():
+                # only an external cancel/resolution leaves a done future
+                # tracked; the router deletes before resolving otherwise
+                del self._inflight[rid]
+                self.metrics.inc("inflight_gc")
+                continue
+            if rec.deadline_t is not None and now > rec.deadline_t:
+                del self._inflight[rid]
+                rec.future.set_exception(DeadlineExceededError(
+                    f"request {rid} exceeded its deadline in flight"))
+                self.metrics.inc("requests_timed_out")
+                self.metrics.inc("requests_failed")
+                continue
+            if rec.inner is None and rec.replica_id is not None \
+                    and rec.replica_id not in self._replicas \
+                    and not any(h.request_id == rid
+                                for h in self._handoffs):
+                self.metrics.inc("inflight_orphans_recovered")
+                self._resubmit(rec)
 
     def _poll_handoffs(self) -> None:
         for h in list(self._handoffs):
@@ -371,25 +575,43 @@ class FleetRouter:
             if rec is None:
                 continue
             result = h.inner.result()
-            dst = self._replicas[h.decode_replica]
+            prompt = rec.resume.prompt
+            dst = self._replicas.get(h.decode_replica)
+            src = self._replicas.get(h.prefill_replica)
             if result["finish_reason"] != "length":
                 # prefill replica was evacuated under us: nothing
                 # committed for sure — decode replica prefills from zero
                 logger.warning("prefill handoff %s interrupted (%s); "
                                "falling back to direct prefill",
                                h.request_id, result["finish_reason"])
-            else:
-                src = self._replicas[h.prefill_replica]
+            elif src is not None and dst is not None:
                 path = src.session.export_prefix_path(h.aligned)
-                moved = self.transport.transfer(
-                    path, dst.session, rec.prompt,
-                    src=h.prefill_replica, dst=h.decode_replica)
-                self.metrics.inc("pages_handed_off", moved)
-            if not dst.eligible():
-                # decode target started draining while prefill ran:
-                # re-route; restore == recompute keeps parity either way
+                cfg = self.config
                 try:
-                    dst = self._route(rec.prompt, rec.request_id)
+                    moved = self.transport.send_pages(
+                        path, dst.session, prompt,
+                        src=h.prefill_replica, dst=h.decode_replica,
+                        deadline_s=(cfg.handoff_deadline_ms / 1e3
+                                    if cfg.handoff_deadline_ms is not None
+                                    else None),
+                        retries=cfg.handoff_retries,
+                        backoff_s=cfg.handoff_backoff_ms / 1e3)
+                    self.metrics.inc("pages_handed_off", moved)
+                except TransportError as e:
+                    # permanent transport failure is never fatal to the
+                    # REQUEST: the decode replica prefills from zero and
+                    # parity holds (restore == recompute)
+                    logger.warning(
+                        "page handoff %s->%s failed permanently (%s); "
+                        "falling back to direct prefill",
+                        h.prefill_replica, h.decode_replica, e)
+                    self.metrics.inc("handoff_transport_failures")
+            if dst is None or not self._eligible(dst):
+                # decode target crashed or started draining while
+                # prefill ran: re-route; restore == recompute keeps
+                # parity either way
+                try:
+                    dst = self._route(prompt, rec.request_id)
                 except CircuitOpenError as e:
                     del self._inflight[rec.request_id]
                     rec.future.set_exception(e)
@@ -397,8 +619,8 @@ class FleetRouter:
                     continue
             rec.replica_id = dst.replica_id
             rec.inner = dst.session.submit(
-                rec.prompt, max_new_tokens=rec.max_new,
-                eos_id=rec.eos_id)
+                prompt, max_new_tokens=rec.resume.max_new,
+                eos_id=rec.resume.eos_id)
 
     def _poll_inflight(self) -> None:
         for rid, rec in list(self._inflight.items()):
@@ -409,25 +631,13 @@ class FleetRouter:
                 # mid-stream migration: greedy continuation is a pure
                 # function of the prefix, so prompt+partial resumed on
                 # any replica concatenates bitwise-identically
-                rec.acc_ids.extend(result["ids"])
-                remaining = rec.max_new - len(rec.acc_ids)
-                try:
-                    nxt = self._route(rec.prompt + rec.acc_ids,
-                                      rec.request_id)
-                except CircuitOpenError as e:
-                    del self._inflight[rid]
-                    rec.future.set_exception(e)
-                    self.metrics.inc("requests_failed")
-                    continue
-                rec.replica_id = nxt.replica_id
-                rec.inner = nxt.session.submit(
-                    rec.prompt + rec.acc_ids, max_new_tokens=remaining,
-                    eos_id=rec.eos_id)
+                rec.resume.ids = rec.hop_base + list(result["ids"])
+                self._resubmit(rec)
                 self.metrics.inc("migrations")
                 continue
             del self._inflight[rid]
             rec.future.set_result({
-                "ids": rec.acc_ids + result["ids"],
+                "ids": rec.hop_base + list(result["ids"]),
                 "finish_reason": result["finish_reason"],
                 "replica_id": rec.replica_id,
             })
@@ -469,17 +679,31 @@ class FleetRouter:
     def _finish_drain(self, rep: Replica) -> None:
         pages = rep.session.export_hot_pages()
         survivors = [r for r in self._decode_replicas()
-                     if r.replica_id != rep.replica_id and r.eligible()]
+                     if r.replica_id != rep.replica_id
+                     and self._eligible(r)]
+        cfg = self.config
         migrated = 0
         for bucket, paths in pages.items():
             for path in paths:
-                # manifest-verified like any other handoff (FLEET002)
-                manifest = page_manifest(path, src=rep.replica_id,
-                                         dst="survivors")
-                self._check_handoff(manifest, path, rep.replica_id)
                 for dst in survivors:
-                    migrated += dst.session.import_hot_pages(
-                        {bucket: [path]})
+                    # manifest-verified + retried like any other handoff
+                    # (FLEET002); migration is best-effort — a path that
+                    # fails permanently is dropped (survivors recompute
+                    # the prefix on demand), never half-committed
+                    try:
+                        migrated += self.transport.send_pages(
+                            path, dst.session, None, bucket=bucket,
+                            src=rep.replica_id, dst=dst.replica_id,
+                            deadline_s=(cfg.handoff_deadline_ms / 1e3
+                                        if cfg.handoff_deadline_ms
+                                        is not None else None),
+                            retries=cfg.handoff_retries,
+                            backoff_s=cfg.handoff_backoff_ms / 1e3)
+                    except TransportError as e:
+                        logger.warning(
+                            "drain migration %s->%s dropped a path: %s",
+                            rep.replica_id, dst.replica_id, e)
+                        self.metrics.inc("pages_migration_failed")
         self._audit_drain(rep)
         del self._replicas[rep.replica_id]
         self.metrics.inc("drains_completed")
@@ -489,15 +713,6 @@ class FleetRouter:
             "pages_migrated": migrated,
             "survivors": [r.replica_id for r in survivors],
         })
-
-    def _check_handoff(self, manifest, path, src: str) -> None:
-        try:
-            from easydist_tpu.analyze import check_page_handoff
-
-            check_page_handoff(manifest, path,
-                               node=f"drain[{src}]")
-        except ImportError:
-            pass
 
     def _audit_drain(self, rep: Replica) -> None:
         try:
@@ -533,6 +748,8 @@ class FleetRouter:
             "handoffs": len(self._handoffs),
             "decisions": len(self.decision_log),
             "drains": list(self.drain_log),
+            "crashes": list(self.crash_log),
+            "health": self.health.snapshot(),
             "metrics": self.metrics.snapshot(),
         }
 
@@ -546,6 +763,8 @@ class FleetRouter:
         db.append_history("serving", "fleet_routing", {
             "decisions": list(self.decision_log)[-64:],
             "drains": list(self.drain_log),
+            "crashes": list(self.crash_log),
+            "health_events": list(self.health.events)[-64:],
         })
         if persist:
             try:

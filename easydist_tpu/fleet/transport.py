@@ -18,21 +18,77 @@ prefix, bitwise-silently.
 backend) and still builds + verifies the manifest — the serialized format
 is the contract a DCN transport implements later; the in-process one
 proves it round-trips.
+
+Hardening (`send_pages`): a single `transfer()` is one verify-then-commit
+attempt; `send_pages` wraps it with a deadline and jittered exponential
+backoff (the checkpoint layer's `_retry_io` idiom), classifies only
+transport faults as retryable (`TransportStallError` — the attempt hung;
+`PageCorruptError` — the payload failed manifest verification BEFORE any
+commit), and relies on idempotent manifest-keyed commits so an attempt
+retried after a late/duplicated delivery never double-commits.  The
+abort-on-partial property is structural: verification covers page count
+and every digest, and runs before the first page touches the trie — a
+half-arrived prefix can never enter it.  Fault points
+`fleet.transport.stall` / `fleet.transport.page_corrupt` inject both
+failure shapes deterministically (the corrupt attempt flips a bit in a
+COPY of one in-flight page, so the retry resends pristine bytes).
 """
 
 from __future__ import annotations
 
+import copy
 import hashlib
 import logging
+import random
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from easydist_tpu.resilience import faultinject
 
 logger = logging.getLogger(__name__)
 
 MANIFEST_FORMAT = 1
 
 Page = Tuple[Tuple[int, ...], Dict[str, object]]  # (chunk_tokens, {"k","v"})
+
+
+class TransportError(RuntimeError):
+    """Base for transfer failures a router can act on (retry/fallback)."""
+
+
+class TransportStallError(TransportError):
+    """One transfer attempt hung past its budget — transient, retryable."""
+
+
+class PageCorruptError(TransportError):
+    """Manifest verification failed: the payload was damaged in flight.
+    Raised BEFORE anything commits (abort-on-partial); retryable because
+    the source still holds pristine pages."""
+
+
+def manifest_key(manifest: Dict[str, object]) -> str:
+    """Stable identity of one transfer's CONTENT: sha256 over the ordered
+    page digests.  Two attempts shipping the same pages share a key, so
+    the receiver can make commits idempotent under retry."""
+    h = hashlib.sha256()
+    for entry in manifest.get("pages", []):
+        h.update(str(entry.get("sha256")).encode())
+    return h.hexdigest()
+
+
+def _corrupt_in_flight(path: Sequence[Page]) -> List[Page]:
+    """Deep-copy the path and flip one value in the last page's first
+    array — the deterministic stand-in for damage on the wire.  The
+    caller's arrays are untouched (a retry resends pristine bytes)."""
+    damaged = [(tokens, {k: copy.deepcopy(np.asarray(v))
+                         for k, v in kv.items()})
+               for tokens, kv in path]
+    tokens, kv = damaged[-1]
+    arr = kv[sorted(kv)[0]]
+    arr.flat[0] += 1 if arr.dtype.kind in "iu" else 1e-3
+    return damaged
 
 
 def _page_digest(tokens: Sequence[int], kv: Dict[str, object]) -> Tuple[str, int]:
@@ -100,46 +156,126 @@ def verify_manifest(manifest: Dict[str, object],
 class KVTransport:
     """Moves one committed chunk path between replicas.  Implementations
     must build a manifest at the source and verify it at the destination
-    before committing anything."""
+    before committing anything, and keep commits idempotent under the
+    manifest key (send_pages retries on transient failures)."""
 
     def transfer(self, path: Sequence[Page], dst_session, prompt,
-                 src: str = "?", dst: str = "?") -> int:
+                 src: str = "?", dst: str = "?",
+                 bucket: Optional[int] = None) -> int:
         raise NotImplementedError
+
+    def send_pages(self, path: Sequence[Page], dst_session, prompt=None,
+                   *, bucket: Optional[int] = None,
+                   src: str = "?", dst: str = "?",
+                   deadline_s: Optional[float] = None, retries: int = 2,
+                   backoff_s: float = 0.005, jitter: float = 0.25,
+                   clock=time.monotonic, sleep=time.sleep,
+                   rng=random.random) -> int:
+        """`transfer` with a deadline and jittered-backoff retry (the
+        checkpoint `_retry_io` idiom).  Only transport faults retry —
+        a stalled attempt (`TransportStallError`, injectable via
+        `fleet.transport.stall`) or a payload that failed verification
+        (`PageCorruptError`); logic errors propagate immediately, and a
+        retry that would start past the deadline raises the last error
+        instead of sleeping through it.  Commit with `prompt` (trie path
+        for that prompt's bucket) or `bucket` (drain-migration hot
+        pages)."""
+        deadline_t = None if deadline_s is None else clock() + deadline_s
+        attempt = 0
+        while True:
+            try:
+                if faultinject.fire("fleet.transport.stall"):
+                    raise TransportStallError(
+                        f"injected transfer stall ({src}->{dst}, "
+                        f"attempt {attempt + 1})")
+                return self.transfer(path, dst_session, prompt,
+                                     src=src, dst=dst, bucket=bucket)
+            except (TransportStallError, PageCorruptError) as e:
+                attempt += 1
+                if attempt > retries:
+                    raise
+                delay = backoff_s * (2 ** (attempt - 1)) \
+                    * (1.0 + jitter * rng())
+                if deadline_t is not None and clock() + delay >= deadline_t:
+                    logger.warning(
+                        "page transfer %s->%s: deadline exhausted after "
+                        "%d attempt(s): %s", src, dst, attempt, e)
+                    raise
+                logger.warning(
+                    "page transfer %s->%s attempt %d failed (%s); "
+                    "retrying in %.1fms", src, dst, attempt, e,
+                    delay * 1e3)
+                sleep(delay)
 
 
 class InProcessTransport(KVTransport):
     """Same-process transfer: pages move by reference, the manifest still
-    round-trips (and is kept in `manifests` for audit/tests)."""
+    round-trips (and is kept in `manifests` for audit/tests).  Commits
+    are idempotent per (destination, commit target, manifest key): a
+    retried/duplicated delivery of the same pages returns the first
+    commit's result without touching the trie again."""
 
-    def __init__(self, verify: bool = True, keep: int = 32):
+    def __init__(self, verify: bool = True, keep: int = 32,
+                 keep_commits: int = 256):
         self.verify = verify
         self.keep = keep
+        self.keep_commits = keep_commits
         self.manifests: List[Dict[str, object]] = []
         self.pages_moved = 0
+        self.commits_deduped = 0
+        self._committed: Dict[tuple, int] = {}
 
     def transfer(self, path: Sequence[Page], dst_session, prompt,
-                 src: str = "?", dst: str = "?") -> int:
-        """Verify + commit `path` into `dst_session`'s trie for `prompt`'s
-        decode bucket; returns chunks present after import."""
+                 src: str = "?", dst: str = "?",
+                 bucket: Optional[int] = None) -> int:
+        """One verify-then-commit attempt: commit `path` into
+        `dst_session`'s trie for `prompt`'s decode bucket (or as hot
+        pages under `bucket` when prompt is None); returns chunks present
+        after import.  Verification failure raises `PageCorruptError`
+        BEFORE anything commits."""
         if not path:
             return 0
         manifest = page_manifest(path, src=src, dst=dst)
         self.manifests = (self.manifests + [manifest])[-self.keep:]
+        if faultinject.fire("fleet.transport.page_corrupt"):
+            # damage on the wire: manifest was built over pristine pages,
+            # the payload mutates after — verification must catch it
+            path = _corrupt_in_flight(path)
         if self.verify:
-            self._check(manifest, path)
-        n = dst_session.import_prefix_path(prompt, path)
+            try:
+                self._check(manifest, path)
+            except Exception as e:
+                raise PageCorruptError(
+                    f"KV page handoff corrupt; aborted before commit "
+                    f"({src}->{dst}): {e}") from e
+        target = (tuple(int(t) for t in prompt) if prompt is not None
+                  else ("bucket", bucket))
+        key = (id(dst_session), target, manifest_key(manifest))
+        if key in self._committed:
+            self.commits_deduped += 1
+            return self._committed[key]
+        if prompt is not None:
+            n = dst_session.import_prefix_path(prompt, path)
+        else:
+            n = dst_session.import_hot_pages({bucket: [path]})
         self.pages_moved += len(path)
+        self._committed[key] = n
+        while len(self._committed) > self.keep_commits:
+            self._committed.pop(next(iter(self._committed)))
         return n
 
     def _check(self, manifest, path) -> None:
         try:
             from easydist_tpu.analyze import check_page_handoff
+
+            # FLEET002 audit trail; raises AnalysisError under
+            # edconfig.analyze_raise
+            check_page_handoff(manifest, path,
+                               node=f"handoff[{manifest['src']}->"
+                                    f"{manifest['dst']}]")
         except ImportError:  # analyze is an optional layer at runtime
-            problems = verify_manifest(manifest, path)
-            if problems:
-                raise RuntimeError(
-                    f"KV page handoff corrupt: {problems}")
-            return
-        check_page_handoff(manifest, path,
-                           node=f"handoff[{manifest['src']}->"
-                                f"{manifest['dst']}]")
+            pass
+        # commits must abort on damage even with analyze_raise off
+        problems = verify_manifest(manifest, path)
+        if problems:
+            raise RuntimeError(f"KV page handoff corrupt: {problems}")

@@ -19,22 +19,26 @@ Schedule syntax (``EASYDIST_FAULT_PLAN`` / ``arm()``):
     "step.nan_grad@7"                 fire on the 7th hit of that point
     "ckpt.write.partial@2,data.stall@1"   multiple points, comma-separated
     "serve.exec_timeout@*"            fire on EVERY hit
+    "fleet.replica.crash@3,fleet.replica.crash@9"   fire on hits 3 AND 9
 
 Counting is per-point and 1-based: ``name@N`` fires exactly once, when the
-Nth execution of that fault point is reached.  Disarmed (the default), every
-fault point is a single attribute check + ``False`` — zero overhead and no
-behavioral difference, which is what lets the instrumented code paths stay
-in production builds.
+Nth execution of that fault point is reached; repeating a name schedules a
+SET of occurrences (the chaos drill's kill schedule).  Disarmed (the
+default), every fault point is a single attribute check + ``False`` — zero
+overhead and no behavioral difference, which is what lets the instrumented
+code paths stay in production builds.
 
 The catalog below is closed: arming an unknown point name raises
-immediately (a typo'd plan must not silently test nothing).
+immediately with a closest-match suggestion (a typo'd plan must not
+silently test nothing).
 """
 
 from __future__ import annotations
 
+import difflib
 import os
 import threading
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 # closed catalog: every instrumented fault point, with the recovery
 # contract it exercises (docs/RESILIENCE.md keeps the long-form table)
@@ -49,6 +53,11 @@ FAULT_POINTS = frozenset({
     # serving (serve/engine.py)
     "serve.exec_timeout",     # executable dispatch exceeds the watchdog
     "serve.oom_bucket",       # batch-bucket compile exhausts device memory
+    # fleet serving (fleet/router.py, fleet/transport.py, fleet/health.py)
+    "fleet.replica.crash",    # replica process dies mid-decode
+    "fleet.transport.stall",  # KV page transfer attempt hangs past budget
+    "fleet.transport.page_corrupt",  # bit flip in a page in flight
+    "fleet.probe.flap",       # health probe falsely reports no progress
 })
 
 
@@ -75,8 +84,12 @@ _fired: Dict[str, int] = {}
 
 
 def parse_plan(spec: str) -> Dict[str, object]:
-    """``"a@2,b@*"`` -> ``{"a": 2, "b": "*"}``; raises FaultPlanError on
-    unknown names / malformed entries."""
+    """``"a@2,b@*"`` -> ``{"a": 2, "b": "*"}`` and ``"a@2,a@5"`` ->
+    ``{"a": frozenset({2, 5})}``; raises
+    FaultPlanError on unknown names / malformed entries, with a
+    closest-match suggestion for typos.  Repeated entries for one point
+    accumulate into a set of occurrences (a kill SCHEDULE); ``@*``
+    anywhere for a point means every hit and absorbs numeric entries."""
     out: Dict[str, object] = {}
     for entry in filter(None, (e.strip() for e in spec.split(","))):
         name, sep, occ = entry.partition("@")
@@ -85,23 +98,33 @@ def parse_plan(spec: str) -> Dict[str, object]:
                 f"fault plan entry {entry!r} missing '@occurrence' "
                 f"(use 'name@N' or 'name@*')")
         if name not in FAULT_POINTS:
+            close = difflib.get_close_matches(name, sorted(FAULT_POINTS),
+                                              n=1, cutoff=0.4)
+            hint = f" — did you mean {close[0]!r}?" if close else ""
             raise FaultPlanError(
-                f"unknown fault point {name!r}; catalogued points: "
+                f"unknown fault point {name!r}{hint}; catalogued points: "
                 f"{sorted(FAULT_POINTS)}")
         if occ == "*":
             out[name] = "*"
-        else:
-            try:
-                n = int(occ)
-            except ValueError:
-                raise FaultPlanError(
-                    f"fault plan occurrence {occ!r} for {name!r} is not an "
-                    f"integer or '*'") from None
-            if n < 1:
-                raise FaultPlanError(
-                    f"fault occurrence must be >= 1 (1-based), got {n} "
-                    f"for {name!r}")
+            continue
+        try:
+            n = int(occ)
+        except ValueError:
+            raise FaultPlanError(
+                f"fault plan occurrence {occ!r} for {name!r} is not an "
+                f"integer or '*'") from None
+        if n < 1:
+            raise FaultPlanError(
+                f"fault occurrence must be >= 1 (1-based), got {n} "
+                f"for {name!r}")
+        prev = out.get(name)
+        if prev == "*":
+            continue  # every-hit already covers n
+        if prev is None:
             out[name] = n
+        else:
+            prevs = {prev} if isinstance(prev, int) else set(prev)
+            out[name] = frozenset(prevs | {n})
     return out
 
 
@@ -135,7 +158,8 @@ def fire(point: str) -> bool:
             return False
         _hits[point] = _hits.get(point, 0) + 1
         occ = _plan.get(point)
-        hit = occ == "*" or (occ is not None and _hits[point] == occ)
+        hit = (occ == "*" or _hits[point] == occ
+               or (isinstance(occ, frozenset) and _hits[point] in occ))
         if hit:
             _fired[point] = _fired.get(point, 0) + 1
         return hit
@@ -151,6 +175,51 @@ def stats() -> Dict[str, Dict[str, int]]:
     """{"hits": {...}, "fired": {...}} snapshot (bench/test reporting)."""
     with _lock:
         return {"hits": dict(_hits), "fired": dict(_fired)}
+
+
+def unfired() -> List[Tuple[str, object]]:
+    """Scheduled (point, occurrence) pairs the run never reached — a drill
+    that "passed" without firing its faults tested nothing, so drills gate
+    on this being empty.  ``@*`` entries count as unfired until the point
+    fired at least once."""
+    out: List[Tuple[str, object]] = []
+    with _lock:
+        if _plan is None:
+            return out
+        for point, occ in sorted(_plan.items()):
+            hits = _hits.get(point, 0)
+            if occ == "*":
+                if _fired.get(point, 0) == 0:
+                    out.append((point, "*"))
+            elif isinstance(occ, frozenset):
+                out.extend((point, n) for n in sorted(occ) if hits < n)
+            elif hits < occ:  # single int occurrence
+                out.append((point, occ))
+    return out
+
+
+def export_stats(db=None, key: str = "resilience",
+                 sub_key: str = "fault_plan", persist: bool = False):
+    """Append the armed plan + hit/fired/unfired counters to the PerfDB
+    (the store serving metrics already land in), so a chaos drill's
+    record proves every scheduled fault actually fired."""
+    if db is None:
+        from easydist_tpu.runtime.perfdb import PerfDB
+
+        db = PerfDB()
+    with _lock:
+        plan = {p: (occ if occ == "*" else sorted(occ)
+                    if isinstance(occ, frozenset) else occ)
+                for p, occ in (_plan or {}).items()}
+    db.append_history(key, sub_key, {
+        "plan": plan, **stats(),
+        "unfired": [[p, occ] for p, occ in unfired()]})
+    if persist:
+        try:
+            db.persist()
+        except Exception:  # stats export must never fail a drill
+            pass
+    return db
 
 
 class fault_plan:

@@ -62,6 +62,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from easydist_tpu.kv import PagePool, PageTable
+from easydist_tpu.resilience import faultinject
 
 from .admission import ReplicaDrainingError, RequestTooLargeError
 from .batcher import select_bucket
@@ -968,6 +969,10 @@ class GenerationSession:
         one decode step per bucket with live slots, harvesting
         retirements.  Returns the number of tokens generated this round
         (decode tokens; prefill first-tokens count via `prefills`)."""
+        # the replica-death fault point sits at the step boundary: tokens
+        # from completed steps were already streamed/synced, this step's
+        # are lost — exactly the state a real mid-decode crash leaves
+        faultinject.crash_point("fleet.replica.crash")
         while self._admit_one():
             pass
         if self._chunked or self._paged:
@@ -1141,6 +1146,33 @@ class GenerationSession:
                 total += (self._import_path_paged(pool, path)
                           if self._paged else pool.trie.import_path(path))
         return total
+
+    def snapshot_inflight(self) -> List[Dict[str, object]]:
+        """Progress of every live request, keyed by its future (identity
+        — the only handle a router shares with this session).  `ids` is
+        the tokens already emitted, i.e. what a streaming client has
+        already received; a router syncs these into per-request
+        `ResumeDescriptor`s after each step so a crash of THIS session
+        can be recovered bitwise by resubmitting prompt+ids elsewhere.
+        Read-only: no session state changes."""
+        out: List[Dict[str, object]] = []
+        for prompt, max_new, eos, fut, _t in self._pending:
+            out.append({"future": fut, "prompt": list(prompt), "ids": [],
+                        "max_new": max_new, "eos_id": eos,
+                        "stage": "queued"})
+        for pool in self._pools.values():
+            for job in pool.jobs.values():
+                out.append({"future": job.future,
+                            "prompt": list(job.prompt), "ids": [],
+                            "max_new": job.max_new, "eos_id": job.eos_id,
+                            "stage": "prefill"})
+            for slot in pool.slots.values():
+                out.append({"future": slot.future,
+                            "prompt": list(slot.prompt),
+                            "ids": list(slot.generated),
+                            "max_new": slot.max_new,
+                            "eos_id": slot.eos_id, "stage": "decode"})
+        return out
 
     def evacuate(self) -> List[Dict[str, object]]:
         """Preemptive drain (SIGTERM grace too short to retire decodes):

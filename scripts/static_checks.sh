@@ -263,5 +263,58 @@ else
     echo "static_checks: jax not importable; skipping bench.py --fleet"
 fi
 
+# fleet-chaos gate: a seeded fault schedule kills one replica per
+# traffic wave mid-decode (revived between waves); every stream must
+# still finish bitwise-identical to the single-session run with zero
+# dropped requests, at least one request actually recovered from its
+# ResumeDescriptor, every scheduled fault fired (a drill whose faults
+# never fired tested nothing), a clean FLEET001/004 routing audit, and
+# TTFT p99 within the bounded multiple of the calm arm
+if python -c "import jax" >/dev/null 2>&1; then
+    echo "== bench.py --fleet-chaos (crash/revive recovery drill gate)"
+    out=$(python bench.py --fleet-chaos 2>/dev/null) || rc=1
+    echo "$out"
+    verdict=$(python - "$out" <<'PYEOF'
+import json, sys
+try:
+    r = json.loads(sys.argv[1].strip().splitlines()[-1])
+    if "error" in r:
+        print("error: " + r["error"])
+    elif not r.get("parity_bitwise"):
+        print("chaos-arm greedy ids diverge from the single-session run")
+    elif r.get("dropped_requests", 1) != 0:
+        print(f"chaos drill dropped {r.get('dropped_requests')} request(s)")
+    elif not r.get("requests_recovered", 0) > 0:
+        print("no request was ever recovered (drill tested nothing)")
+    elif r.get("replica_crashes") != r.get("crashes_scheduled"):
+        print(f"observed {r.get('replica_crashes')} crash(es), scheduled "
+              f"{r.get('crashes_scheduled')}")
+    elif r.get("fault_plan_unfired", 1) != 0:
+        print(f"{r.get('fault_plan_unfired')} scheduled fault(s) never fired")
+    elif r.get("routing_findings", 1) != 0:
+        print(f"routing audit raised {r.get('routing_findings')} "
+              f"FLEET001/004 finding(s)")
+    elif not r.get("ttft_p99_inflation", 1e18) <= r.get("ttft_p99_bound", 0):
+        print(f"ttft p99 inflated {r.get('ttft_p99_inflation')}x under "
+              f"chaos (bound {r.get('ttft_p99_bound')}x)")
+    elif r.get("value") != 1.0:
+        print(f"only {r.get('value')} of requests finished clean")
+    elif r.get("perf_regression"):
+        print(f"committed-floor regression: {r.get('value')} is >10% below "
+              f"last-good {r.get('last_good_value')}")
+    else:
+        print("ok")
+except Exception as e:
+    print(f"unparseable: {e}")
+PYEOF
+)
+    if [ "$verdict" != "ok" ]; then
+        echo "static_checks: fleet-chaos gate failed ($verdict)"
+        rc=1
+    fi
+else
+    echo "static_checks: jax not importable; skipping bench.py --fleet-chaos"
+fi
+
 [ "$ran" = 0 ] && echo "static_checks: no external linters ran (configs still validated by CI tests)"
 exit $rc

@@ -15,6 +15,21 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compile cache: the suite is compile-dominated on small
+# hosts (one ~14-min tier-1 run is mostly XLA:CPU compiles of the same
+# tiny-model programs every run), and the executables are keyed by HLO
+# hash + jax version + flags, so reuse across runs is exact.  First run
+# pays a small serialization overhead; every run after starts warm.
+# EASYDIST_TEST_NO_COMPILE_CACHE=1 disables (e.g. to time cold compiles).
+if os.environ.get("EASYDIST_TEST_NO_COMPILE_CACHE") != "1":
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(__file__), os.pardir, ".jax_cache"))
+    # the suite's compile load is thousands of TINY programs (the
+    # solver's per-equation discovery probes compile in ~30ms each),
+    # all below the default 1s write threshold — cache everything
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
 import pytest  # noqa: E402
 
 
@@ -34,3 +49,34 @@ def _hermetic_perfdb(tmp_path, monkeypatch):
 
     monkeypatch.setattr(edconfig, "prof_db_path",
                         str(tmp_path / "perf.db"))
+
+
+_EXIT_STATUS = [None]
+
+
+def pytest_sessionfinish(session, exitstatus):
+    _EXIT_STATUS[0] = int(exitstatus)
+
+
+def pytest_unconfigure(config):
+    """Skip interpreter finalization once the summary has printed.
+
+    A full run leaves thousands of compiled XLA executables and device
+    buffers behind; tearing them down in atexit takes ~20s on a 1-core
+    host — dead time between pytest's summary line and the process
+    actually exiting, which a CI wall-clock timeout still bills for.
+    unconfigure runs after every sessionfinish hook (the terminal
+    reporter prints its summary in one), so nothing left matters to any
+    consumer of this suite: flush and exit hard.
+    EASYDIST_TEST_FULL_EXIT=1 restores the normal interpreter shutdown
+    (e.g. to profile atexit hooks themselves).
+    """
+    if os.environ.get("EASYDIST_TEST_FULL_EXIT") == "1":
+        return
+    if _EXIT_STATUS[0] is None:  # collection-only / early abort paths
+        return
+    import sys
+
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(_EXIT_STATUS[0])

@@ -1,14 +1,18 @@
 """Layer 6 fleet auditor goldens: FLEET001 (routed to tripped/draining
 replica), FLEET002 (KV handoff manifest mismatch), FLEET003 (orphaned
-pinned pages after drain).  Each known-bad fixture fires its rule exactly
-once; each clean fixture yields zero findings."""
+pinned pages after drain), FLEET004 (dispatched to a DEAD replica),
+FLEET005 (resume descriptor that would break bitwise recovery).  Each
+known-bad fixture fires its rule exactly once; each clean fixture yields
+zero findings."""
 
 import numpy as np
 import pytest
 
 from easydist_tpu.analyze import (audit_drained_session, audit_page_handoff,
-                                  audit_routing, check_fleet_drain,
-                                  check_fleet_routing, check_page_handoff)
+                                  audit_resume, audit_routing,
+                                  check_fleet_drain, check_fleet_routing,
+                                  check_page_handoff,
+                                  check_resume_descriptor)
 from easydist_tpu.analyze.findings import AnalysisError
 from easydist_tpu.fleet import page_manifest
 from easydist_tpu.serve import PrefixCache
@@ -68,6 +72,67 @@ class TestRouting:
     def test_hook_raises_under_analyze_raise(self):
         with pytest.raises(AnalysisError, match="FLEET001"):
             check_fleet_routing([_decision(breaker_state="open")])
+
+    def test_dead_replica_dispatch_fires_fleet004_once(self):
+        decisions = [_decision(health="alive"),
+                     _decision(request_id=1, health="dead")]
+        findings = audit_routing(decisions)
+        assert len(findings) == 1
+        assert findings[0].rule_id == "FLEET004"
+        assert findings[0].severity == "error"
+        assert "request[1]" in findings[0].node
+        assert "DEAD" in findings[0].message
+
+    def test_suspect_replica_is_not_a_finding(self):
+        # SUSPECT still serves (the budget exists to absorb flaps);
+        # only DEAD dispatch is the FLEET004 error
+        assert audit_routing([_decision(health="suspect")]) == []
+
+    def test_fleet004_hook_raises_under_analyze_raise(self):
+        with pytest.raises(AnalysisError, match="FLEET004"):
+            check_fleet_routing([_decision(health="dead")])
+
+
+def _descriptor(**kw):
+    d = {"request_id": 7, "prompt": [1, 2, 3], "ids": [4, 5],
+         "max_new": 6, "eos_id": 9, "crashed_on": ["d0"]}
+    d.update(kw)
+    return d
+
+
+class TestResumeDescriptor:
+    def test_clean_resume_zero_findings(self):
+        d = _descriptor()
+        assert audit_resume(d, [1, 2, 3, 4, 5]) == []
+        assert check_resume_descriptor(d, [1, 2, 3, 4, 5]) == []
+
+    def test_clean_without_resume_prompt(self):
+        # the prefix cross-check is optional; budget/eos still audit
+        assert audit_resume(_descriptor()) == []
+
+    def test_prefix_mismatch_fires_fleet005_once(self):
+        findings = audit_resume(_descriptor(), [1, 2, 3, 4, 99])
+        assert len(findings) == 1
+        assert findings[0].rule_id == "FLEET005"
+        assert findings[0].severity == "error"
+        assert "request[7]" in findings[0].node
+        assert "prompt + emitted ids" in findings[0].message
+
+    def test_budget_exhausted_fires_fleet005_once(self):
+        findings = audit_resume(_descriptor(ids=[4, 5, 6], max_new=3))
+        assert len(findings) == 1
+        assert findings[0].rule_id == "FLEET005"
+        assert "no budget left" in findings[0].message
+
+    def test_eos_already_emitted_fires_fleet005_once(self):
+        findings = audit_resume(_descriptor(ids=[4, 9]))
+        assert len(findings) == 1
+        assert findings[0].rule_id == "FLEET005"
+        assert "eos" in findings[0].message
+
+    def test_hook_raises_under_analyze_raise(self):
+        with pytest.raises(AnalysisError, match="FLEET005"):
+            check_resume_descriptor(_descriptor(), [1, 2, 3])
 
 
 class TestPageHandoff:

@@ -1,0 +1,150 @@
+"""HealthMonitor unit semantics over fake replicas: the first probe is a
+baseline, stalls burn the miss budget through SUSPECT to DEAD, progress
+or idleness resets, probe rounds are clock-gated, DEAD is sticky until
+revive, and mark_dead short-circuits for raised crashes."""
+
+import pytest
+
+from easydist_tpu.fleet.health import (ALIVE, DEAD, SUSPECT, HealthConfig,
+                                       HealthMonitor)
+
+
+class _Metrics:
+    def __init__(self):
+        self.counters = {}
+
+    def counter(self, name):
+        return self.counters.get(name, 0)
+
+
+class _Session:
+    def __init__(self, queue_depth=1):
+        self.metrics = _Metrics()
+        self.queue_depth = queue_depth
+
+    def advance(self, n=1):
+        self.metrics.counters["decode_steps"] = \
+            self.metrics.counter("decode_steps") + n
+
+
+class _Rep:
+    def __init__(self, rid, queue_depth=1):
+        self.replica_id = rid
+        self.session = _Session(queue_depth)
+
+
+def _monitor(miss_budget=3, interval_ms=0.0, clock=None):
+    return HealthMonitor(HealthConfig(probe_interval_ms=interval_ms,
+                                      miss_budget=miss_budget),
+                         clock=clock)
+
+
+class TestConfig:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="miss_budget"):
+            HealthConfig(miss_budget=0)
+        with pytest.raises(ValueError, match="probe_interval_ms"):
+            HealthConfig(probe_interval_ms=-1.0)
+
+
+class TestProbe:
+    def test_first_probe_is_baseline_never_a_miss(self):
+        hm = _monitor(miss_budget=1)
+        rep = _Rep("d0")  # zero counters, work queued
+        assert hm.probe([rep]) == []
+        assert hm.state("d0") == ALIVE
+
+    def test_stall_burns_budget_to_dead(self):
+        hm = _monitor(miss_budget=3)
+        rep = _Rep("d0")
+        assert hm.probe([rep]) == []              # baseline
+        assert hm.probe([rep]) == []              # miss 1
+        assert hm.state("d0") == SUSPECT
+        assert hm.probe([rep]) == []              # miss 2
+        assert hm.probe([rep]) == ["d0"]          # miss 3 -> DEAD
+        assert hm.state("d0") == DEAD
+        assert any(e["state"] == DEAD for e in hm.events)
+
+    def test_dead_reported_once_and_skipped_after(self):
+        hm = _monitor(miss_budget=1)
+        rep = _Rep("d0")
+        hm.probe([rep])
+        assert hm.probe([rep]) == ["d0"]
+        assert hm.probe([rep]) == []   # sticky, not re-reported
+
+    def test_progress_resets_misses(self):
+        hm = _monitor(miss_budget=2)
+        rep = _Rep("d0")
+        hm.probe([rep])                       # baseline
+        hm.probe([rep])                       # miss 1 -> SUSPECT
+        assert hm.state("d0") == SUSPECT
+        rep.session.advance()
+        assert hm.probe([rep]) == []
+        assert hm.state("d0") == ALIVE
+        assert hm.snapshot()["d0"]["misses"] == 0
+        assert any(e["reason"] == "progress resumed" for e in hm.events)
+
+    def test_idle_replica_never_misses(self):
+        hm = _monitor(miss_budget=1)
+        rep = _Rep("d0", queue_depth=0)  # nothing to do: SHOULD not move
+        for _ in range(5):
+            assert hm.probe([rep]) == []
+        assert hm.state("d0") == ALIVE
+
+    def test_only_the_stalled_replica_dies(self):
+        hm = _monitor(miss_budget=2)
+        stuck, busy = _Rep("a"), _Rep("b")
+        for _ in range(4):
+            busy.session.advance()
+            dead = hm.probe([stuck, busy])
+        assert dead == []
+        assert hm.state("a") == DEAD and hm.state("b") == ALIVE
+
+    def test_probe_interval_gates_rounds(self):
+        t = [0.0]
+        hm = _monitor(miss_budget=1, interval_ms=100.0,
+                      clock=lambda: t[0])
+        rep = _Rep("d0")
+        hm.probe([rep])                 # baseline at t=0
+        t[0] = 0.05
+        assert hm.probe([rep]) == []    # inside the interval: skipped
+        assert hm.state("d0") == ALIVE
+        t[0] = 0.15
+        assert hm.probe([rep]) == ["d0"]   # real round: miss -> DEAD
+
+    def test_interval_zero_probes_every_call(self):
+        hm = _monitor(miss_budget=1, interval_ms=0.0,
+                      clock=lambda: 0.0)  # frozen clock still probes
+        rep = _Rep("d0")
+        hm.probe([rep])
+        assert hm.probe([rep]) == ["d0"]
+
+
+class TestLifecycle:
+    def test_mark_dead_sticky_until_revive(self):
+        hm = _monitor()
+        hm.mark_dead("d0", reason="step raised")
+        assert hm.state("d0") == DEAD
+        rep = _Rep("d0")
+        rep.session.advance()
+        hm.probe([rep])
+        assert hm.state("d0") == DEAD   # probes never resurrect
+        hm.revive("d0")
+        assert hm.state("d0") == ALIVE
+        assert any(e["reason"] == "revived" for e in hm.events)
+
+    def test_untracked_replica_reads_alive(self):
+        assert _monitor().state("never-seen") == ALIVE
+
+    def test_drop_forgets_state(self):
+        hm = _monitor()
+        hm.mark_dead("d0")
+        hm.drop("d0")
+        assert "d0" not in hm.snapshot()
+        assert hm.state("d0") == ALIVE
+
+    def test_events_bounded(self):
+        hm = _monitor()
+        for i in range(600):
+            hm.mark_dead(f"r{i}")
+        assert len(hm.events) <= 256

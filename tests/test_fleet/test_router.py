@@ -14,7 +14,10 @@ from easydist_tpu.serve import (CircuitOpenError, GenerationSession,
                                 QueueFullError, ReplicaDrainingError,
                                 RequestTooLargeError, ServeConfig)
 
-CHUNK = 4
+# chunk/batch shapes match test_serve/test_generation.py's sessions so the
+# bucketed programs come out of the process-wide memo instead of a private
+# signature family compiled just for test_fleet
+CHUNK = 8
 
 
 @pytest.fixture(scope="module")
@@ -26,6 +29,7 @@ def model():
 
 def _mk(model, rid, **kw):
     cfg, params = model
+    kw.setdefault("prefill_batch", 2)
     sc = ServeConfig(decode_buckets=(cfg.seq,), max_decode_slots=2,
                      prefill_chunk=CHUNK, breaker_failure_threshold=3,
                      **kw)
@@ -160,24 +164,31 @@ class TestDrain:
         resolves with the single-session ids, the drained replica leaves
         the fleet, and its hot pages land on the survivor."""
         cfg, _ = model
-        prompts = _prompts(cfg, n=6, seed=6)
+        # two chunks of shared prefix: the drained trie then holds pages
+        # the survivor hasn't committed, so the migration is observable
+        prompts = _prompts(cfg, n=6, seed=6, shared_len=2 * CHUNK + 1)
         want = _reference(model, prompts, 5)
         router = FleetRouter([_mk(model, "d0"), _mk(model, "d1")])
         futs = [router.submit(p, max_new_tokens=5) for p in prompts]
-        router.step()  # work in flight on both replicas
-        router.drain("d0", mode="graceful")
+        router.step()  # work in flight
+        # drain the replica the prefix family landed on: its trie holds
+        # committed pages the survivor doesn't, so the hot-page migration
+        # is observable regardless of where cold placement hashed to
+        drained = router.decision_log[0]["replica_id"]
+        survivor = "d1" if drained == "d0" else "d0"
+        router.drain(drained, mode="graceful")
         router.run_until_drained()
         out = [f.result(timeout=5) for f in futs]
         assert [o["ids"] for o in out] == want
         assert all(o["finish_reason"] == "length" for o in out)
-        assert "d0" not in router.stats()["replicas"]
+        assert drained not in router.stats()["replicas"]
         assert router.drain_log and \
-            router.drain_log[0]["replica_id"] == "d0"
+            router.drain_log[0]["replica_id"] == drained
         assert router.drain_log[0]["pages_migrated"] > 0
         # new submits after the drain only ever see the survivor
         f = router.submit(prompts[0], max_new_tokens=3)
         router.run_until_drained()
-        assert f.result(timeout=5)["replica_id"] == "d1"
+        assert f.result(timeout=5)["replica_id"] == survivor
 
     def test_evacuate_resumes_bitwise_midstream(self, model):
         """Evacuate retires live decodes with partial ids; the router

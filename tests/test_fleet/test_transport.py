@@ -1,11 +1,16 @@
 """KV page transport: sha256 manifest round-trip, corruption detection
-down to single-bit payload flips, and the in-process transfer contract."""
+down to single-bit payload flips, the in-process transfer contract, and
+the hardened `send_pages` wrapper (jittered-backoff retry, deadline
+exhaustion, abort-on-corrupt-before-commit, idempotent manifest-keyed
+commits under injected `fleet.transport.*` faults)."""
 
 import numpy as np
 import pytest
 
-from easydist_tpu.fleet import (InProcessTransport, page_manifest,
-                                verify_manifest)
+from easydist_tpu.fleet import (InProcessTransport, PageCorruptError,
+                                TransportStallError, manifest_key,
+                                page_manifest, verify_manifest)
+from easydist_tpu.resilience import faultinject
 
 CHUNK = 4
 
@@ -111,3 +116,121 @@ class TestInProcessTransport:
         with pytest.raises(Exception, match="FLEET002|corrupt"):
             tp.transfer(_path(), dst, [0, 1, 2, 3, 4])
         assert dst.imported == []  # nothing committed
+
+    def test_idempotent_commit_under_duplicate_delivery(self):
+        tp = InProcessTransport()
+        dst = _FakeSession()
+        path = _path()
+        prompt = [0, 1, 2, 3, 4, 5, 6, 7, 9]
+        n1 = tp.transfer(path, dst, prompt)
+        n2 = tp.transfer(path, dst, prompt)   # retried/late duplicate
+        assert n1 == n2 == 2
+        assert len(dst.imported) == 1         # trie touched exactly once
+        assert tp.commits_deduped == 1
+        # different prompt = different commit target, never deduped
+        tp.transfer(path, dst, prompt + [10])
+        assert len(dst.imported) == 2
+
+    def test_commit_memory_bounded(self):
+        tp = InProcessTransport(keep_commits=3)
+        dst = _FakeSession()
+        for i in range(6):
+            tp.transfer(_path(1), dst, [i])
+        assert len(tp._committed) == 3
+
+
+class TestSendPages:
+    """The retry/deadline wrapper, with injectable clock/sleep/rng so the
+    backoff schedule is asserted without wall-clock sleeping."""
+
+    def test_clean_path_no_retries(self):
+        tp = InProcessTransport()
+        dst = _FakeSession()
+        sleeps = []
+        n = tp.send_pages(_path(), dst, [0, 1, 2, 3, 4, 5, 6, 7, 9],
+                          sleep=sleeps.append)
+        assert n == 2 and sleeps == []
+        assert len(dst.imported) == 1
+
+    def test_stall_retries_with_backoff_then_succeeds(self):
+        tp = InProcessTransport()
+        dst = _FakeSession()
+        sleeps = []
+        with faultinject.fault_plan("fleet.transport.stall@1,"
+                                    "fleet.transport.stall@2"):
+            n = tp.send_pages(_path(), dst, [0, 1, 2, 3, 4],
+                              retries=2, backoff_s=0.01, jitter=0.0,
+                              sleep=sleeps.append)
+        assert n == 2
+        assert len(dst.imported) == 1
+        # exponential schedule: base, then doubled
+        assert sleeps == [0.01, 0.02]
+
+    def test_jitter_spreads_backoff(self):
+        tp = InProcessTransport()
+        dst = _FakeSession()
+        sleeps = []
+        with faultinject.fault_plan("fleet.transport.stall@1"):
+            tp.send_pages(_path(), dst, [0, 1, 2, 3, 4],
+                          retries=1, backoff_s=0.01, jitter=0.5,
+                          rng=lambda: 1.0, sleep=sleeps.append)
+        assert sleeps == [pytest.approx(0.015)]
+
+    def test_retries_exhausted_raises_stall(self):
+        tp = InProcessTransport()
+        dst = _FakeSession()
+        with faultinject.fault_plan("fleet.transport.stall@*"):
+            with pytest.raises(TransportStallError):
+                tp.send_pages(_path(), dst, [0, 1, 2, 3, 4],
+                              retries=2, sleep=lambda s: None)
+        assert dst.imported == []
+
+    def test_deadline_refuses_to_sleep_past_it(self):
+        tp = InProcessTransport()
+        dst = _FakeSession()
+        t = [0.0]
+        sleeps = []
+        with faultinject.fault_plan("fleet.transport.stall@*"):
+            with pytest.raises(TransportStallError):
+                tp.send_pages(_path(), dst, [0, 1, 2, 3, 4],
+                              deadline_s=0.005, retries=10,
+                              backoff_s=0.01, jitter=0.0,
+                              clock=lambda: t[0], sleep=sleeps.append)
+        # the first retry's backoff would already cross the deadline:
+        # raise the real error immediately instead of burning the wait
+        assert sleeps == []
+
+    def test_corrupt_attempt_retries_and_commits_pristine(self):
+        tp = InProcessTransport()
+        dst = _FakeSession()
+        path = _path()
+        want = {k: v.copy() for k, v in path[-1][1].items()}
+        with faultinject.fault_plan("fleet.transport.page_corrupt@1"):
+            n = tp.send_pages(path, dst, [0, 1, 2, 3, 4],
+                              retries=2, sleep=lambda s: None)
+        assert n == 2
+        assert len(dst.imported) == 1
+        # the retry resent pristine bytes: committed payload unmodified
+        _, committed = dst.imported[0][1][-1]
+        for name in want:
+            np.testing.assert_array_equal(committed[name], want[name])
+        # and the caller's arrays were never damaged either
+        for name in want:
+            np.testing.assert_array_equal(path[-1][1][name], want[name])
+
+    def test_corrupt_no_retries_aborts_before_commit(self):
+        tp = InProcessTransport()
+        dst = _FakeSession()
+        with faultinject.fault_plan("fleet.transport.page_corrupt@*"):
+            with pytest.raises(PageCorruptError, match="corrupt"):
+                tp.send_pages(_path(), dst, [0, 1, 2, 3, 4], retries=0)
+        assert dst.imported == []
+        assert tp.pages_moved == 0
+
+    def test_manifest_key_stable_across_attempts(self):
+        path = _path()
+        m1 = page_manifest(path, src="a", dst="b")
+        m2 = page_manifest(path, src="c", dst="d")  # endpoints differ
+        assert manifest_key(m1) == manifest_key(m2)
+        other = page_manifest(_path(1))
+        assert manifest_key(m1) != manifest_key(other)

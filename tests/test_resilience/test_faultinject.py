@@ -25,6 +25,25 @@ def test_parse_plan():
     assert plan == {"step.nan_grad": 7, "data.stall": "*"}
 
 
+def test_parse_plan_repeated_point_accumulates_schedule():
+    plan = faultinject.parse_plan(
+        "fleet.replica.crash@3,fleet.replica.crash@9")
+    assert plan == {"fleet.replica.crash": frozenset({3, 9})}
+
+
+def test_parse_plan_star_absorbs_numeric_entries():
+    assert faultinject.parse_plan("data.stall@2,data.stall@*") == \
+        {"data.stall": "*"}
+    assert faultinject.parse_plan("data.stall@*,data.stall@2") == \
+        {"data.stall": "*"}
+
+
+def test_typo_gets_did_you_mean():
+    with pytest.raises(FaultPlanError,
+                       match="did you mean 'fleet.replica.crash'"):
+        faultinject.parse_plan("fleet.replica.crsh@1")
+
+
 @pytest.mark.parametrize("bad", [
     "nope.unknown@1",          # uncatalogued name
     "step.nan_grad",           # missing @occurrence
@@ -48,6 +67,56 @@ def test_star_fires_every_hit():
     with faultinject.fault_plan("serve.exec_timeout@*"):
         assert all(faultinject.fire("serve.exec_timeout")
                    for _ in range(4))
+
+
+def test_occurrence_set_fires_each_scheduled_hit():
+    with faultinject.fault_plan(
+            "fleet.replica.crash@2,fleet.replica.crash@4"):
+        hits = [faultinject.fire("fleet.replica.crash")
+                for _ in range(6)]
+        assert hits == [False, True, False, True, False, False]
+        assert faultinject.stats()["fired"]["fleet.replica.crash"] == 2
+
+
+def test_unfired_reports_unreached_schedule():
+    with faultinject.fault_plan("data.stall@2,serve.exec_timeout@*"):
+        assert faultinject.unfired() == [("data.stall", 2),
+                                         ("serve.exec_timeout", "*")]
+        faultinject.fire("data.stall")          # hit 1: not yet
+        assert ("data.stall", 2) in faultinject.unfired()
+        faultinject.fire("data.stall")          # hit 2: fired
+        faultinject.fire("serve.exec_timeout")
+        assert faultinject.unfired() == []
+
+
+def test_unfired_tracks_occurrence_sets_individually():
+    with faultinject.fault_plan(
+            "fleet.replica.crash@1,fleet.replica.crash@5"):
+        faultinject.fire("fleet.replica.crash")
+        assert faultinject.unfired() == [("fleet.replica.crash", 5)]
+
+
+def test_export_stats_records_plan_and_counters():
+    class _DB:
+        def __init__(self):
+            self.history = []
+
+        def append_history(self, key, sub_key, entry):
+            self.history.append((key, sub_key, entry))
+
+    with faultinject.fault_plan(
+            "fleet.replica.crash@1,fleet.replica.crash@3,data.stall@*"):
+        faultinject.fire("fleet.replica.crash")
+        db = _DB()
+        faultinject.export_stats(db=db)
+    ((key, sub_key, entry),) = db.history
+    assert (key, sub_key) == ("resilience", "fault_plan")
+    # frozenset schedules serialize as sorted lists (JSON-safe)
+    assert entry["plan"] == {"fleet.replica.crash": [1, 3],
+                             "data.stall": "*"}
+    assert entry["fired"] == {"fleet.replica.crash": 1}
+    assert ["fleet.replica.crash", 3] in entry["unfired"]
+    assert ["data.stall", "*"] in entry["unfired"]
 
 
 def test_crash_point_raises_with_point():
