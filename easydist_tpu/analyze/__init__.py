@@ -29,7 +29,11 @@ A rule-based analyzer that runs after solving and before execution
            SERVE002 chunked-prefill contract lint (staging donation,
            length-masked attention over the full bucket window so stale
            cache rows cannot leak into live logits, prefix-trie
-           refcount/byte-accounting integrity);
+           refcount/byte-accounting integrity) and the SERVE003
+           speculative-rewind contract lint
+           (`audit_speculative_rewind`: verify-step length masking,
+           accept-walk bookkeeping never past the first mismatch,
+           rollback leaves no table row on a released page);
   layer 7  paged-KV auditor (`audit_page_table`) — KV001 cross-checks
            the paged decode cache's host bookkeeping (kv/pool.py page
            refcounts, kv/table.py slot->page tables, prefix-trie page
@@ -73,7 +77,7 @@ from .resilience_rules import (audit_checkpoint_root, audit_guard_parity,
 from .schedule_rules import (gpipe_schedule_tables, schedule_stats,
                              verify_schedule_tables)
 from .serve_rules import (audit_chunked_prefill, audit_decode_donation,
-                          audit_prefix_cache)
+                          audit_prefix_cache, audit_speculative_rewind)
 from .strategy_rules import audit_solver_objective, verify_axis
 
 logger = logging.getLogger(__name__)
@@ -92,6 +96,7 @@ __all__ = [
     "audit_decode_donation", "check_decode_donation",
     "audit_chunked_prefill", "audit_prefix_cache",
     "check_chunked_prefill", "check_prefix_cache",
+    "audit_speculative_rewind", "check_speculative_rewind",
     "audit_routing", "audit_page_handoff", "audit_drained_session",
     "audit_resume",
     "check_fleet_routing", "check_page_handoff", "check_fleet_drain",
@@ -178,6 +183,33 @@ def check_chunked_prefill(result, cache_arg: int = 0,
 
     findings = audit_chunked_prefill(result, cache_arg=cache_arg,
                                      node=node)
+    report = AnalysisReport(findings)
+    if report.errors() and edconfig.analyze_raise:
+        report.raise_on_errors()
+    for f in findings:
+        logger.warning("[analyze] %s", f)
+    return findings
+
+
+def check_speculative_rewind(result=None, *, cache_arg: int = 0,
+                             node: str = "verify", draft=None,
+                             target=None, n_accepted=None, pool=None,
+                             table=None, trie=None):
+    """Self-check hook for speculative decoding (SERVE003), called by
+    `serve.generation` at each artifact's natural checkpoint: the
+    compiled verify step once per signature (`result` — donation warns,
+    a missing length mask errors), the accept-walk bookkeeping every
+    commit (`draft`/`target`/`n_accepted` — advancing past the first
+    mismatch errors), and the paged page table after every rollback that
+    released pages (`pool`/`table` — a dangling released page errors).
+    Error findings raise under `analyze_raise`; warnings log.  Returns
+    the findings so callers/tests can assert on them."""
+    from easydist_tpu import config as edconfig
+
+    findings = audit_speculative_rewind(
+        result, cache_arg=cache_arg, node=node, draft=draft,
+        target=target, n_accepted=n_accepted, pool=pool, table=table,
+        trie=trie)
     report = AnalysisReport(findings)
     if report.errors() and edconfig.analyze_raise:
         report.raise_on_errors()

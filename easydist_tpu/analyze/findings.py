@@ -124,6 +124,13 @@ RULES: Dict[str, tuple] = {
                  "(stale-row leakage — restored/garbage cache rows could "
                  "leak into live logits), or prefix-trie refcount/byte "
                  "accounting drift"),
+    "SERVE003": (SEV_ERROR,
+                 "speculative rewind contract broken: verify step not "
+                 "length-masked past the committed positions (warning "
+                 "for non-donated cache), accepted-prefix bookkeeping "
+                 "advanced past the first draft/target mismatch (output "
+                 "would diverge from plain greedy), or a paged rollback "
+                 "left a table row pointing at a released page"),
     # ---- layer 7: paged-KV auditor (page-table/refcount consistency,
     #      analyze/kv_rules.py)
     "KV001": (SEV_ERROR,

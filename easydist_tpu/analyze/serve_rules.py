@@ -10,6 +10,18 @@ checks the compiled decode step's donation vector covers every leaf of
 the cache argument, so the regression is caught at compile time rather
 than in a latency dashboard.
 
+SERVE003 — the speculative-rewind contract lint.  Speculative decoding
+(serve/speculate.py + the verify steps in models/*.py) is only a pure
+speed knob while three properties hold: (a) the verify program's
+attention is LENGTH-MASKED to `key_pos <= query_pos` — the k+1 verify
+rows it writes sit above the committed positions, and position i's
+logits must not see rows > i, or a rejected draft would contaminate the
+very logits that judge it; (b) the host-side accept walk never commits
+past the first draft/target mismatch — one token past it and the stream
+silently diverges from plain greedy; (c) a paged rollback leaves no
+table row pointing at a released spill page (delegated to KV001's
+page-table audit, re-tagged so speculative findings are attributable).
+
 SERVE002 — the chunked-prefill contract lint.  The prefix-reuse scheduler
 (serve/generation.py + serve/prefix_cache.py) leans on three properties:
 (a) the multi-row staging cache is donated to every chunk call (same
@@ -158,6 +170,79 @@ def audit_chunked_prefill(result, cache_arg: int = 0,
             "masked to `key_pos <= query_pos`, so stale rows (restored "
             "prefix tails, recycled staging rows, idle-row garbage) can "
             "leak into live logits"))
+    return findings
+
+
+def audit_speculative_rewind(result=None, *, cache_arg: int = 0,
+                             node: str = "verify",
+                             draft=None, target=None,
+                             n_accepted: int = None,
+                             pool=None, table=None,
+                             trie=None) -> List[Finding]:
+    """SERVE003 over whichever speculative artifact is supplied (the
+    three arms compose — pass any subset):
+
+    * `result` (a compiled verify step): the cache/arena (positional arg
+      `cache_arg`) must be donated (warning — slow, not wrong) and the
+      program must carry a length-masked select over an iota-derived
+      predicate (error — without `key_pos <= query_pos`, the speculative
+      rows the step itself writes above the committed positions leak
+      into the logits that decide acceptance, and rejected drafts
+      contaminate their own verdict).
+    * `draft`/`target`/`n_accepted` (one slot's accept-walk bookkeeping,
+      token id sequences + the accepted-draft count): `n_accepted` must
+      not exceed the longest matching prefix of draft and target — one
+      committed token past the first mismatch silently diverges the
+      stream from plain greedy.
+    * `pool`/`table` (a paged layout after rollback): the full KV001
+      page-table/refcount audit, re-tagged SERVE003 — a rollback that
+      released a spill page while a table row still points at it hands
+      another sequence's K/V to this one's attention.
+    """
+    findings: List[Finding] = []
+    if result is not None:
+        findings.extend(_donation_findings(
+            result, cache_arg, node, "SERVE003",
+            "every verify step pays a full KV-cache HBM copy instead of "
+            "an in-place XLA update", severity=SEV_WARNING))
+        traced = None
+        try:
+            import jax
+
+            traced = jax.make_jaxpr(result.jitted)(*result.in_avals)
+        except Exception:
+            pass
+        if traced is not None and not _has_masked_select(traced.jaxpr):
+            findings.append(make_finding(
+                "SERVE003", node,
+                "no length-masked select found in the verify program: "
+                "attention is not masked to `key_pos <= query_pos`, so "
+                "the speculative rows the step writes above the "
+                "committed positions (including rejected drafts) leak "
+                "into the logits that decide acceptance"))
+    if draft is not None and target is not None and n_accepted is not None:
+        match = 0
+        for d, t in zip(draft, target):
+            if int(d) != int(t):
+                break
+            match += 1
+        if n_accepted > match:
+            findings.append(make_finding(
+                "SERVE003", node,
+                f"accepted-prefix bookkeeping advanced past the first "
+                f"draft/target mismatch: n_accepted={n_accepted} but "
+                f"draft {list(map(int, draft))} matches target "
+                f"{list(map(int, target))[:len(list(draft))]} only "
+                f"through index {match} — the committed stream diverges "
+                f"from plain greedy"))
+    if pool is not None and table is not None:
+        from .kv_rules import audit_page_table
+
+        findings.extend(
+            make_finding("SERVE003", node,
+                         f"paged rollback left inconsistent "
+                         f"page-table/refcount state: {f.message}")
+            for f in audit_page_table(pool, table, trie=trie, node=node))
     return findings
 
 

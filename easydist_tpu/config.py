@@ -242,6 +242,16 @@ decode_block_k = _env_int("EASYDIST_DECODE_BLOCK_K", 256)
 # TRACE-AFFECTING: part of the strategy-cache salt like the decode backend.
 prefill_attention_backend = os.environ.get("EASYDIST_PREFILL_ATTENTION",
                                            "auto")
+# speculative decoding defaults (`ServeConfig.speculate_k` /
+# `.speculate_drafter` read these when not set explicitly): k = draft
+# tokens proposed per verify round (0 disables speculation entirely —
+# the session never compiles a verify program), drafter = "ngram"
+# (zero-cost prompt lookup) or "draft_model" (a second small model's
+# cached greedy decode; the session needs a drafter/draft_model wired).
+# NOT trace-affecting by themselves: the verify program's shape is
+# (slots, k+1), which reaches the signature cache as an input shape.
+speculate_k = _env_int("EASYDIST_SPECULATE_K", 0)
+speculate_drafter = os.environ.get("EASYDIST_SPECULATE_DRAFTER", "ngram")
 
 # ---------------- resilience (easydist_tpu.resilience) ----------------
 # deterministic fault schedule, e.g. "step.nan_grad@7,ckpt.write.partial@2"

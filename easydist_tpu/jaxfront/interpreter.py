@@ -276,18 +276,26 @@ class ShardingAnalyzer:
         return inner, sub, rules, shape_info
 
     def _discover_composite(self, eqn):
-        """Analytic rule for a call-like eqn (jax.checkpoint body): analyze
-        the inner jaxpr recursively, then propagate each candidate input
-        sharding through the inner nodes' strategy pools.  A seed survives
-        only if a SYNC-FREE assignment exists (every consumer takes the
-        sharded operand as-is; partial sums may only surface at composite
-        outputs).  Surviving seeds become the composite's shard groups.
-        """
-        import functools
+        """Priced whole-region strategies for a call-like eqn
+        (jax.checkpoint body): analyze the inner jaxpr recursively, then
+        solve the body graph once per seed input-dim with collectives
+        PRICED, not forbidden (the scan/cond/while treatment) — each
+        surviving assignment becomes one explicit strategy of the
+        composite eqn carrying honest per-strategy compute seconds.
 
-        from easydist_tpu.metashard.combination import Recombine, Reduction
+        The earlier dim-group table with free boundaries mispriced remat
+        regions two ways: the outer solver's any-shard discount cut the
+        WHOLE region's FLOPs 1/n for a strategy that sharded one residual
+        chain and replicated everything else, and sync-free-only
+        propagation dropped assignments whose optimum includes a priced
+        mid-body psum.  Policy checkpoints (remat="dots") exposed both —
+        their backward regions take saved dot residuals as extra
+        operands, a degenerate seq-dim group over one residual won on
+        boundary bytes, and the plan shipped mostly-replicated compute
+        plus boundary all-to-alls the un-remat'd twin never emits
+        (test_remat_gpt_plan_matches_unremat_twin[dots]).
+        """
         from easydist_tpu.metashard.metair import Placement
-        from .bridge import jaxpr_to_metagraph
 
         got = self._analyze_inner(eqn.params.get("jaxpr"))
         if got is None:
@@ -303,162 +311,66 @@ class ShardingAnalyzer:
         out_names = [None if isinstance(v, jex_core.Literal)
                      else sub.names.name(v) for v in inner.jaxpr.outvars]
 
-        from easydist_tpu.autoflow import MeshAxisSpec, SpmdSolver
-
-        axis = MeshAxisSpec("_composite", self.world_size)
-
-        def propagate(seed_name, seed_dim):
-            """Sync-free assignment containing the seed, found by an exact
-            solve of the inner graph with the seed placeholder pinned and a
-            pure-communication objective.  -> ({invar: dim}, {out:
-            Placement}) or None when the optimum still needs a collective.
-            """
-            target = Placement.shard(seed_dim)
-            g = jaxpr_to_metagraph(inner, rules, shape_info,
-                                   world_size=self.world_size,
-                                   names=sub.names)
-            _inject_partial_propagation(g, self.world_size)
-
-            def excl(node):
-                if node.name != seed_name:
-                    return []
-                return [s for s in node.strategy_pool(self.world_size)
-                        if repr(s.out_placements[0]) != repr(target)]
-
-            g.coarsen(self.world_size, level=0, exclude_map=excl)
-            # exact untied solve: cluster tying trades a sliver of
-            # optimality for speed, but sync-free detection needs the true
-            # zero-comm optimum (the graph is one block, small)
-            saved_dedup = edconfig.solver_cluster_dedup
-            edconfig.solver_cluster_dedup = False
-            try:
-                solver = SpmdSolver(g, axis)
-                # composite boundaries are free: partial/sharded outputs are
-                # legal (they become the composite's recombines), and there
-                # is no compute-redundancy choice to price inside one group
-                solver.output_y_cost.clear()
-                chosen = solver.solve()
-            except Exception:
-                return None
-            finally:
-                edconfig.solver_cluster_dedup = saved_dedup
-            if repr(chosen.get(seed_name).out_placements[0]) != repr(target):
-                return None  # divisibility removed the pin
-            if solver.assignment_comm_cost(chosen) > 0.0:
-                return None
-
-            # the zero-comm optimum may ALSO shard chains unrelated to the
-            # seed (the memory tie-break likes sharding): keep only what is
-            # CONNECTED to the seed through non-replicated placements, so
-            # independent chains stay available for their own groups
-            var_p: Dict[str, object] = {}
-            for node in list(g.ops) + list(g.inputs):
-                s = chosen.get(node.name)
-                if s is None:
-                    continue
-                for v, p in zip(node.outvars, s.out_placements):
-                    if v is not None and p is not None \
-                            and not p.is_replicate():
-                        var_p[v.name] = p
-            adj: Dict[str, set] = {}
-            for node in g.ops:
-                touched = [v.name for v in list(node.invars)
-                           + list(node.outvars)
-                           if v is not None and v.name in var_p]
-                for a in touched:
-                    adj.setdefault(a, set()).update(touched)
-            reach = {seed_name}
-            frontier = [seed_name]
-            while frontier:
-                cur = frontier.pop()
-                for nxt in adj.get(cur, ()):
-                    if nxt not in reach:
-                        reach.add(nxt)
-                        frontier.append(nxt)
-
-            ins = {}
-            for name in in_names:
-                p = var_p.get(name)
-                if name in reach and p is not None and p.is_shard():
-                    ins[name] = p.dim
-            outs = {n: var_p[n] for n in filter(None, out_names)
-                    if n in reach and n in var_p}
-            return (ins, outs)
-
-        numel_of = {}
-        for v, name in zip(inner_invars, in_names):
-            numel_of[name] = int(np.prod(v.aval.shape))
-        out_numel = {}
-        for v in inner.jaxpr.outvars:
-            if not isinstance(v, jex_core.Literal):
-                out_numel[sub.names.name(v)] = int(np.prod(v.aval.shape))
-
-        groups = []
-        seen = set()
+        strategies = []  # (in_placements, out_placements, comm, compute)
+        seen_keys = set()
+        covered = set()  # (invar row, dim) already sharded by a strategy
+        full_compute = 0.0
+        n_solves = 0
         for row, (v, name) in enumerate(zip(inner_invars, in_names)):
             shape = tuple(v.aval.shape)
-            # don't SEED from bias-sized inputs (their "groups" shard odd
-            # broadcast chains); they may still join groups seeded from
-            # substantive tensors.  64 elems/device keeps small-but-real
-            # data inputs seedable.
-            if numel_of[name] < self.world_size * 64:
+            numel = int(np.prod(shape)) if shape else 1
+            # bias-sized inputs may ride along in a solve, but never seed
+            if numel < self.world_size * 64:
                 continue
             for d, size in enumerate(shape):
                 if size % self.world_size != 0 or size < self.world_size:
                     continue
-                res = propagate(name, d)
+                if (row, d) in covered:
+                    continue
+                if n_solves >= edconfig.scan_max_seed_solves:
+                    break
+                n_solves += 1
+                res = self._solve_body_pinned(
+                    inner, sub, rules, shape_info,
+                    pins={name: Placement.shard(d)})
                 if res is None:
                     continue
-                ins, outs = res
-                # drop degenerate groups (a lone sharded bias): the value
-                # of a group scales with everything it shards, so judge by
-                # the TOTAL sharded footprint, not the seed's size
-                sharded_numel = sum(numel_of.get(n, 0) for n in ins) + \
-                    sum(out_numel.get(n, 0) for n in outs)
-                if sharded_numel < max(4096, self.world_size ** 2):
+                var_p, comm, compute, full = res
+                full_compute = full
+                ins = []
+                for nm in in_names:
+                    p = var_p.get(nm)
+                    ins.append(Placement.shard(p.dim)
+                               if p is not None and p.is_shard()
+                               else Placement.replicate())
+                if all(p.is_replicate() for p in ins):
                     continue
-                key = (tuple(sorted(ins.items())),
-                       tuple(sorted((k, repr(p)) for k, p in outs.items())))
-                if key in seen:
+                outs = []
+                for nm in out_names:
+                    p = var_p.get(nm) if nm is not None else None
+                    if p is not None and p.is_shard():
+                        outs.append(Placement.shard(p.dim))
+                    elif p is not None and p.is_partial():
+                        outs.append(Placement.partial())
+                    else:
+                        outs.append(Placement.replicate())
+                key = (tuple(repr(p) for p in ins),
+                       tuple(repr(p) for p in outs))
+                if key in seen_keys:
                     continue
-                seen.add(key)
-                groups.append((ins, outs))
+                seen_keys.add(key)
+                strategies.append((ins, outs, comm, compute))
+                for r2, p in enumerate(ins):
+                    if p.is_shard():
+                        covered.add((r2, p.dim))
 
-        if not groups:
+        if not strategies:
             return None
-
-        from easydist_tpu.metashard.annotation import DimSharding, ShardSpace
-
-        table = [[DimSharding() for _ in v.aval.shape] for v in inner_invars]
-        recombines = {}
-        kept = []
-        for ins, outs in groups:
-            g = len(kept) + 1
-            cells = [(row, ins[name]) for row, name in enumerate(in_names)
-                     if name in ins]
-            if any(table[r][d].group != 0 for r, d in cells):
-                continue  # a dim can carry one group id; first group wins
-            for r, d in cells:
-                table[r][d] = DimSharding(group=g)
-            kept.append((ins, outs))
-        groups = kept
-        if not groups:
-            return None
-        for g, (ins, outs) in enumerate(groups, start=1):
-            fns = []
-            for name in out_names:
-                p = outs.get(name) if name is not None else None
-                if p is None:
-                    fns.append(functools.partial(Recombine.identity))
-                elif p.is_shard():
-                    fns.append(functools.partial(Recombine.concat, dim=p.dim))
-                else:
-                    fns.append(functools.partial(Recombine.reduce,
-                                                 op=Reduction.SUM))
-            recombines[g] = fns
-        logger.info("composite rule for %s: %d shard groups",
-                    eqn.primitive.name, len(groups))
-        return {"space": ShardSpace(table), "recombines": recombines}
+        logger.info("composite rule for %s: %d priced strategies",
+                    eqn.primitive.name, len(strategies))
+        # same-basis replicate price (see _solve_body_pinned)
+        return {"space": None, "recombines": {},
+                "strategies": strategies, "compute": full_compute}
 
     def _solve_body_pinned(self, inner, sub, rules, shape_info, pins,
                            state_io=None, replicate_names=()):

@@ -61,6 +61,22 @@ class PageTable:
         row[:] = self.sentinel
         return pages
 
+    def unmap_tail(self, slot: int, from_idx: int) -> List[int]:
+        """Clear `slot`'s windows >= `from_idx` back to sentinel,
+        returning the pages they mapped (the caller releases each
+        against the pool).  This is the speculative-decoding rollback
+        primitive: windows mapped beyond a sequence's up-front
+        reservation only ever hold rejected draft rows, so truncating
+        the table tail releases them without touching the committed
+        prefix — the hole-free-prefix invariant holds trivially (a
+        suffix clear cannot create a hole)."""
+        if from_idx < 0:
+            raise ValueError(f"from_idx must be >= 0, got {from_idx}")
+        tail = self.array[slot, from_idx:]
+        pages = [int(p) for p in tail[tail != self.sentinel]]
+        tail[:] = self.sentinel
+        return pages
+
     def mapped(self, slot: int) -> List[int]:
         """Pages `slot` currently maps, in window order."""
         row = self.array[slot]

@@ -300,8 +300,10 @@ class MetaNode:
         return f"MetaNode({self.name}: {self.op_key})"
 
 
-# control-flow composites solved as their own cluster (see coarsen)
-_SOLO_CLUSTER_OPS = {"scan", "while", "cond"}
+# composites solved as their own cluster (see coarsen): control flow and
+# jax.checkpoint regions — both carry explicit priced strategies whose
+# many-input boundaries a cone back-build would sync-free-match away
+_SOLO_CLUSTER_OPS = {"scan", "while", "cond", "remat2", "remat", "checkpoint"}
 
 
 # ---------------------------------------------------------------- clusters

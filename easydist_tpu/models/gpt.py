@@ -332,6 +332,62 @@ def gpt_prefill_chunk(params, cfg: GPTConfig, cache, tokens, start_pos,
     return cache, last.astype(jnp.float32) @ params["wte"].T
 
 
+def gpt_verify_step(params, cfg: GPTConfig, cache, tokens, pos):
+    """Speculative-decoding verify step: score `tokens` (int32
+    [batch, s] — each row is [last committed token, draft_0, ...,
+    draft_{s-2}]) at absolute positions `pos + [0..s)` in ONE forward,
+    returning (cache, logits [batch, s, vocab]) for ALL s positions, so
+    the host can accept the longest greedily-matching draft prefix.
+
+    The trunk is `gpt_prefill_chunk` with s as the chunk length: K/V for
+    all s positions is written at the traced start `pos` (one compiled
+    signature per (bucket, s)) and attention over the full cache window
+    is masked to `key_pos <= query_pos`, so position i's logits equal
+    what `gpt_decode_step` would produce after sequentially feeding the
+    first i tokens — rejected-draft rows written past the accept
+    boundary are exactly the stale rows the mask keeps out of every
+    later step (analyze rule SERVE003 audits this mask).  Callers must
+    guarantee pos + s <= T (the write would otherwise be clamped onto
+    committed rows)."""
+    from easydist_tpu.ops import chunk_attention
+
+    dtype = jnp.dtype(cfg.dtype)
+    heads = cfg.heads
+    b, s = tokens.shape
+    hd = cfg.dim // heads
+    start = pos.astype(jnp.int32)
+    abs_pos = start[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+    x = params["wte"][tokens].astype(dtype) \
+        + params["wpe"][abs_pos].astype(dtype)
+    new_k, new_v = [], []
+    for li, blk in enumerate(_block_list(params, cfg)):
+        p_at = blk["attn"]
+        h_in = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"]).astype(dtype)
+        qkv = h_in @ p_at["qkv"]["w"].astype(dtype) \
+            + p_at["qkv"]["b"].astype(dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, heads, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(b, s, heads, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(b, s, heads, hd).transpose(0, 2, 1, 3)
+        ck = _cache_write_chunk(cache["k"][li], k, start)
+        cv = _cache_write_chunk(cache["v"][li], v, start)
+        new_k.append(ck)
+        new_v.append(cv)
+        att = chunk_attention(q, ck.astype(dtype), cv.astype(dtype),
+                              abs_pos)
+        att = att.transpose(0, 2, 1, 3).reshape(b, s, cfg.dim)
+        x = x + (att @ p_at["proj"]["w"].astype(dtype)
+                 + p_at["proj"]["b"].astype(dtype))
+        h = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"]).astype(dtype)
+        h = jax.nn.gelu(h @ blk["mlp"]["fc"]["w"].astype(dtype)
+                        + blk["mlp"]["fc"]["b"].astype(dtype))
+        x = x + (h @ blk["mlp"]["proj"]["w"].astype(dtype)
+                 + blk["mlp"]["proj"]["b"].astype(dtype))
+    cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
+    return cache, x.astype(jnp.float32) @ params["wte"].T
+
+
 def gpt_decode_step(params, cfg: GPTConfig, cache, token, pos):
     """One cached decode step: feed `token` (int32 [batch]) at position
     `pos` (int32 [batch], == current sequence length per row) and return
@@ -489,6 +545,76 @@ def gpt_prefill_chunk_paged(params, cfg: GPTConfig, pages, table, tokens,
     rel_last = jnp.clip(lengths.astype(jnp.int32) - 1 - start, 0, c_len - 1)
     last = jnp.take_along_axis(x, rel_last[:, None, None], axis=1)[:, 0]
     return pages, last.astype(jnp.float32) @ params["wte"].T
+
+
+def _pages_write_rows(pages_layer, new, write_page, offset):
+    """Write `s` consecutive K or V rows per sequence through the page
+    table: pages_layer [n_pages, h, pt, hd], new [b, h, s, hd],
+    write_page/offset int32 [b, s] (per position — a run of s positions
+    may straddle a page boundary, so each resolves its own page).  The
+    advanced indices broadcast to [b, s] in front of the update, and
+    mode="drop" discards sentinel pages — dead rows touch nothing."""
+    return pages_layer.at[write_page, :, offset, :].set(
+        new.transpose(0, 2, 1, 3).astype(pages_layer.dtype), mode="drop")
+
+
+def gpt_verify_step_paged(params, cfg: GPTConfig, pages, table, tokens,
+                          pos):
+    """`gpt_verify_step` against the page arena: the s positions'
+    K/V rows land through the table per position (windows
+    `(pos + i) // page_tokens`, offsets `(pos + i) % page_tokens` — a
+    verify window may straddle a page boundary, unlike page-aligned
+    prefill chunks), and attention gathers the virtual contiguous cache
+    through the table as the paged prefill chunk does.  Returns
+    (pages, logits [batch, s, vocab]) for all s positions.  Callers must
+    have every touched window mapped (or the whole row sentinel — dead
+    rows drop); rejected positions live in mapped pages until the host
+    truncates the table tail past the reservation."""
+    from easydist_tpu.ops import chunk_attention, gather_pages
+
+    dtype = jnp.dtype(cfg.dtype)
+    heads = cfg.heads
+    b, s = tokens.shape
+    pt = pages["k"].shape[3]
+    hd = cfg.dim // heads
+    start = pos.astype(jnp.int32)
+    tbl = table.astype(jnp.int32)
+    abs_pos = start[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+    # per-position page + offset: [b, s] each (sentinel rows stay
+    # sentinel through the take -> every write drops)
+    wp = jnp.take_along_axis(tbl, abs_pos // pt, axis=1)
+    off = abs_pos % pt
+    x = params["wte"][tokens].astype(dtype) \
+        + params["wpe"][abs_pos].astype(dtype)
+    new_k, new_v = [], []
+    for li, blk in enumerate(_block_list(params, cfg)):
+        p_at = blk["attn"]
+        h_in = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"]).astype(dtype)
+        qkv = h_in @ p_at["qkv"]["w"].astype(dtype) \
+            + p_at["qkv"]["b"].astype(dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, heads, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(b, s, heads, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(b, s, heads, hd).transpose(0, 2, 1, 3)
+        pk = _pages_write_rows(pages["k"][li], k, wp, off)
+        pv = _pages_write_rows(pages["v"][li], v, wp, off)
+        new_k.append(pk)
+        new_v.append(pv)
+        ck = gather_pages(pk, tbl)
+        cv = gather_pages(pv, tbl)
+        att = chunk_attention(q, ck.astype(dtype), cv.astype(dtype),
+                              abs_pos)
+        att = att.transpose(0, 2, 1, 3).reshape(b, s, cfg.dim)
+        x = x + (att @ p_at["proj"]["w"].astype(dtype)
+                 + p_at["proj"]["b"].astype(dtype))
+        h = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"]).astype(dtype)
+        h = jax.nn.gelu(h @ blk["mlp"]["fc"]["w"].astype(dtype)
+                        + blk["mlp"]["fc"]["b"].astype(dtype))
+        x = x + (h @ blk["mlp"]["proj"]["w"].astype(dtype)
+                 + blk["mlp"]["proj"]["b"].astype(dtype))
+    pages = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
+    return pages, x.astype(jnp.float32) @ params["wte"].T
 
 
 def gpt_decode_step_paged(params, cfg: GPTConfig, pages, table, token, pos):

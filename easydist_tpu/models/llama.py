@@ -289,6 +289,58 @@ def llama_prefill_chunk(params, cfg: LlamaConfig, cache, tokens, start_pos,
     return cache, last.astype(jnp.float32) @ params["wte"].T
 
 
+def llama_verify_step(params, cfg: LlamaConfig, cache, tokens, pos):
+    """Speculative-decoding verify step (the llama mirror of
+    `gpt.gpt_verify_step`): score `tokens` (int32 [batch, s] — last
+    committed token + s-1 drafts) at absolute positions `pos + [0..s)`
+    in one forward, K roped at those absolute positions and written at
+    kv_heads granularity, attention over the full cache window masked to
+    `key_pos <= query_pos`, GQA-repeated before attention exactly like
+    the bucketed chunk path.  Returns (cache, logits [batch, s, vocab])
+    for all s positions.  Callers must guarantee pos + s <= T."""
+    from easydist_tpu.ops import chunk_attention
+
+    dtype = jnp.dtype(cfg.dtype)
+    b, s = tokens.shape
+    hd = cfg.dim // cfg.heads
+    rep = cfg.heads // cfg.kv_heads
+    start = pos.astype(jnp.int32)
+    abs_pos = start[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+    x = params["wte"][tokens].astype(dtype)
+    new_k, new_v = [], []
+    for li, blk in enumerate(params["blocks"]):
+        hx = _rmsnorm(x, blk["attn_norm"]).astype(dtype)
+
+        def heads(y, n):
+            return y.reshape(b, s, n, hd).transpose(0, 2, 1, 3)
+
+        q = heads(hx @ blk["wq"].astype(dtype), cfg.heads)
+        k = heads(hx @ blk["wk"].astype(dtype), cfg.kv_heads)
+        v = heads(hx @ blk["wv"].astype(dtype), cfg.kv_heads)
+        q = _rope_abs(q.astype(jnp.float32), abs_pos,
+                      cfg.rope_theta).astype(dtype)
+        k = _rope_abs(k.astype(jnp.float32), abs_pos,
+                      cfg.rope_theta).astype(dtype)
+        ck = _cache_write_chunk(cache["k"][li], k, start)
+        cv = _cache_write_chunk(cache["v"][li], v, start)
+        new_k.append(ck)
+        new_v.append(cv)
+        kf, vf = ck.astype(dtype), cv.astype(dtype)
+        if rep > 1:
+            kf = jnp.repeat(kf, rep, axis=1)
+            vf = jnp.repeat(vf, rep, axis=1)
+        att = chunk_attention(q, kf, vf, abs_pos)
+        out = att.transpose(0, 2, 1, 3).reshape(b, s, cfg.heads * hd)
+        x = x + out @ blk["wo"].astype(dtype)
+        hx = _rmsnorm(x, blk["ffn_norm"]).astype(dtype)
+        gated = jax.nn.silu(hx @ blk["w_gate"].astype(dtype)) \
+            * (hx @ blk["w_up"].astype(dtype))
+        x = x + gated @ blk["w_down"].astype(dtype)
+    cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    x = _rmsnorm(x, params["norm_f"])
+    return cache, x.astype(jnp.float32) @ params["wte"].T
+
+
 def llama_decode_step(params, cfg: LlamaConfig, cache, token, pos):
     """One cached decode step: (cache, logits [batch, vocab]) for `token`
     (int32 [batch]) at absolute position `pos` (int32 [batch]).  Q and the
@@ -420,6 +472,66 @@ def llama_prefill_chunk_paged(params, cfg: LlamaConfig, pages, table,
     rel_last = jnp.clip(lengths.astype(jnp.int32) - 1 - start, 0, c_len - 1)
     last = jnp.take_along_axis(x, rel_last[:, None, None], axis=1)[:, 0]
     return pages, last.astype(jnp.float32) @ params["wte"].T
+
+
+def _pages_write_rows(pages_layer, new, write_page, offset):
+    """pages_layer [n_pages, n, pt, hd], new [b, n, s, hd], write_page/
+    offset int32 [b, s] — per-position page writes (a verify window may
+    straddle a page boundary); sentinel pages drop (dead rows)."""
+    return pages_layer.at[write_page, :, offset, :].set(
+        new.transpose(0, 2, 1, 3).astype(pages_layer.dtype), mode="drop")
+
+
+def llama_verify_step_paged(params, cfg: LlamaConfig, pages, table, tokens,
+                            pos):
+    """`llama_verify_step` against the page arena (the llama mirror of
+    `gpt.gpt_verify_step_paged`): roped K/V rows for the s positions land
+    through the table per position, attention gathers the virtual
+    contiguous cache with the GQA repeat applied after the gather —
+    matching the bucketed repeat-then-attend order bitwise.  Returns
+    (pages, logits [batch, s, vocab]) for all s positions."""
+    from easydist_tpu.ops import chunk_attention, gather_pages
+
+    dtype = jnp.dtype(cfg.dtype)
+    b, s = tokens.shape
+    pt = pages["k"].shape[3]
+    hd = cfg.dim // cfg.heads
+    start = pos.astype(jnp.int32)
+    tbl = table.astype(jnp.int32)
+    abs_pos = start[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+    wp = jnp.take_along_axis(tbl, abs_pos // pt, axis=1)
+    off = abs_pos % pt
+    x = params["wte"][tokens].astype(dtype)
+    new_k, new_v = [], []
+    for li, blk in enumerate(params["blocks"]):
+        hx = _rmsnorm(x, blk["attn_norm"]).astype(dtype)
+
+        def heads(y, n):
+            return y.reshape(b, s, n, hd).transpose(0, 2, 1, 3)
+
+        q = heads(hx @ blk["wq"].astype(dtype), cfg.heads)
+        k = heads(hx @ blk["wk"].astype(dtype), cfg.kv_heads)
+        v = heads(hx @ blk["wv"].astype(dtype), cfg.kv_heads)
+        q = _rope_abs(q.astype(jnp.float32), abs_pos,
+                      cfg.rope_theta).astype(dtype)
+        k = _rope_abs(k.astype(jnp.float32), abs_pos,
+                      cfg.rope_theta).astype(dtype)
+        pk = _pages_write_rows(pages["k"][li], k, wp, off)
+        pv = _pages_write_rows(pages["v"][li], v, wp, off)
+        new_k.append(pk)
+        new_v.append(pv)
+        kf = gather_pages(pk, tbl, n_heads=cfg.heads).astype(dtype)
+        vf = gather_pages(pv, tbl, n_heads=cfg.heads).astype(dtype)
+        att = chunk_attention(q, kf, vf, abs_pos)
+        out = att.transpose(0, 2, 1, 3).reshape(b, s, cfg.heads * hd)
+        x = x + out @ blk["wo"].astype(dtype)
+        hx = _rmsnorm(x, blk["ffn_norm"]).astype(dtype)
+        gated = jax.nn.silu(hx @ blk["w_gate"].astype(dtype)) \
+            * (hx @ blk["w_up"].astype(dtype))
+        x = x + gated @ blk["w_down"].astype(dtype)
+    pages = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    x = _rmsnorm(x, params["norm_f"])
+    return pages, x.astype(jnp.float32) @ params["wte"].T
 
 
 def llama_decode_step_paged(params, cfg: LlamaConfig, pages, table, token,

@@ -53,21 +53,30 @@ class PipelineConfig:
     n_virtual: int = 1
 
 
-def _stage_param_specs(stage_params, config: PipelineConfig, axis: str):
+def _stage_param_specs(stage_params, config: PipelineConfig, axis: str,
+                       replicate_stage: bool = False):
     """PartitionSpecs for stage-stacked params: leading dim over `pp`,
-    optionally a tensor-parallel tail spec (per-leaf or uniform)."""
+    optionally a tensor-parallel tail spec (per-leaf or uniform).
+
+    replicate_stage=True leaves the leading (stage) dim unsharded — used
+    on hybrid pp x data meshes where resharding an inside-jit-produced
+    stage stack into a pp-sharded shard_map input is miscompiled (see
+    the data_axis note in spmd_pipeline); the pipeline bodies then slice
+    their stage by `axis_index` instead of receiving a pre-sliced shard.
+    The tensor-parallel tail specs are preserved either way."""
+    lead = None if replicate_stage else axis
     if config.param_spec is None:
-        return jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+        return jax.tree_util.tree_map(lambda _: P(lead), stage_params)
     is_spec = lambda x: isinstance(x, (tuple, P))  # noqa: E731
     p_leaves, p_td = jax.tree_util.tree_flatten(stage_params)
     s_leaves, s_td = jax.tree_util.tree_flatten(config.param_spec,
                                                 is_leaf=is_spec)
     if s_td == p_td:
         # per-leaf spec tails (pytree matching stage_params)
-        specs = [P(axis, *tuple(t)) for t in s_leaves]
+        specs = [P(lead, *tuple(t)) for t in s_leaves]
         return jax.tree_util.tree_unflatten(p_td, specs)
     tail = tuple(config.param_spec)
-    return jax.tree_util.tree_map(lambda _: P(axis, *tail), stage_params)
+    return jax.tree_util.tree_map(lambda _: P(lead, *tail), stage_params)
 
 
 def spmd_pipeline(stage_fn: Callable, mesh, config: PipelineConfig):
@@ -104,8 +113,22 @@ def spmd_pipeline(stage_fn: Callable, mesh, config: PipelineConfig):
     def pipelined(stage_params, microbatches):
         # stage-stacked params shard their leading dim over pp (optionally
         # with a tensor-parallel tail spec); microbatches shard their batch
-        # dim over the data axis when configured
-        param_specs = _stage_param_specs(stage_params, config, axis)
+        # dim over the data axis when configured.
+        #
+        # data_axis caveat: on a multi-axis (pp x data) mesh, feeding a
+        # stage stack PRODUCED INSIDE the surrounding jit into a
+        # pp-sharded in_spec is miscompiled by GSPMD — the reshard into
+        # the manual region inserts a spurious all-reduce over the data
+        # axis, scaling every stage's params by the data-axis size
+        # (repro: jit(lambda ps, x: pipe(jnp.stack(ps), x)) on a (4, 2)
+        # mesh applies each stage bias twice; pre-stacked args are
+        # unaffected).  Work around it by passing the stage dim
+        # REPLICATED and slicing each device's stage by axis_index
+        # inside the manual region — an all-gather resolves that
+        # resharding correctly.
+        rep_stage = config.data_axis is not None
+        param_specs = _stage_param_specs(stage_params, config, axis,
+                                         replicate_stage=rep_stage)
         data_spec = P(None, config.data_axis) if config.data_axis else P()
 
         @functools.partial(shard_map, mesh=mesh,
@@ -114,7 +137,12 @@ def spmd_pipeline(stage_fn: Callable, mesh, config: PipelineConfig):
                            check_vma=False)
         def run(params, x_mb):
             stage_id = jax.lax.axis_index(axis)
-            local = jax.tree_util.tree_map(lambda p: p[0], params)
+            if rep_stage:
+                local = jax.tree_util.tree_map(
+                    lambda p: jax.lax.dynamic_index_in_dim(
+                        p, stage_id, 0, keepdims=False), params)
+            else:
+                local = jax.tree_util.tree_map(lambda p: p[0], params)
             T = M + S - 1
             mb_shape = x_mb.shape[1:]
             state0 = jnp.zeros(mb_shape, x_mb.dtype)
@@ -154,12 +182,16 @@ def stack_stage_params(per_stage_params):
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_stage_params)
 
 
-def _virtual_params_and_specs(stage_params, config, axis, V, S):
+def _virtual_params_and_specs(stage_params, config, axis, V, S,
+                              replicate_stage: bool = False):
     """[V*S, ...] stage params regrouped to [V, S, ...] with specs sharding
-    the S dim over pp (shared by the interleaved forward and 1F1B paths)."""
+    the S dim over pp (shared by the interleaved forward and 1F1B paths).
+    replicate_stage leaves the S dim unsharded (the data_axis reshard
+    workaround — see spmd_pipeline)."""
     vparams = jax.tree_util.tree_map(
         lambda p: p.reshape((V, S) + p.shape[1:]), stage_params)
-    base_specs = _stage_param_specs(stage_params, config, axis)
+    base_specs = _stage_param_specs(stage_params, config, axis,
+                                    replicate_stage=replicate_stage)
     vspecs = jax.tree_util.tree_map(
         lambda sp: P(None, *tuple(sp)), base_specs,
         is_leaf=lambda x: isinstance(x, P))
@@ -177,8 +209,10 @@ def _interleaved_forward(body, mesh, config: PipelineConfig):
     U = tables["n_superticks"]
 
     def pipelined(stage_params, microbatches):
+        # rep_stage: the data_axis reshard workaround (see spmd_pipeline)
+        rep_stage = config.data_axis is not None
         vparams, vspecs, data_spec = _virtual_params_and_specs(
-            stage_params, config, axis, V, S)
+            stage_params, config, axis, V, S, replicate_stage=rep_stage)
 
         @functools.partial(shard_map, mesh=mesh,
                            in_specs=(vspecs, data_spec),
@@ -186,7 +220,12 @@ def _interleaved_forward(body, mesh, config: PipelineConfig):
         def run(params, x_mb):
             tree = jax.tree_util
             s = jax.lax.axis_index(axis)
-            local = tree.tree_map(lambda p: p[:, 0], params)  # [V, ...]
+            if rep_stage:
+                local = tree.tree_map(
+                    lambda p: jax.lax.dynamic_index_in_dim(
+                        p, s, 1, keepdims=False), params)  # [V, ...]
+            else:
+                local = tree.tree_map(lambda p: p[:, 0], params)  # [V, ...]
             MF, KF, FOK = (jnp.asarray(tables[k]) for k in
                            ("m_f", "k_f", "f_ok"))
             out0 = jnp.zeros_like(x_mb)
@@ -294,18 +333,32 @@ def spmd_pipeline_grad(stage_fn: Callable, loss_fn: Callable, mesh,
     def pipelined(stage_params, microbatches, targets, loss_params=None):
         lp_in = loss_params if aux else ()
         # stage-stacked params [V*S, ...] regrouped to [V, S, ...]: chunk k
-        # of device s is global stage k*S + s
+        # of device s is global stage k*S + s.  With a data axis the
+        # params enter REPLICATED over the stage dim and each device
+        # slices its stage by axis_index (the data_axis reshard
+        # workaround — see spmd_pipeline); the grads still leave
+        # stage-SHARDED, so the output spec keeps the pp-sharded form.
+        rep_stage = config.data_axis is not None
         vparams, vspecs, data_spec = _virtual_params_and_specs(
             stage_params, config, axis, V, S)
+        vspecs_in = vspecs
+        if rep_stage:
+            _, vspecs_in, _ = _virtual_params_and_specs(
+                stage_params, config, axis, V, S, replicate_stage=True)
 
         @functools.partial(shard_map, mesh=mesh,
-                           in_specs=(vspecs, data_spec, data_spec, P()),
+                           in_specs=(vspecs_in, data_spec, data_spec, P()),
                            out_specs=(P(), vspecs, data_spec, P()),
                            check_vma=False)
         def run(params, x_mb, tgt_mb, lp):
             tree = jax.tree_util
             s = jax.lax.axis_index(axis)
-            local = tree.tree_map(lambda p: p[:, 0], params)  # [V, ...]
+            if rep_stage:
+                local = tree.tree_map(
+                    lambda p: jax.lax.dynamic_index_in_dim(
+                        p, s, 1, keepdims=False), params)  # [V, ...]
+            else:
+                local = tree.tree_map(lambda p: p[:, 0], params)  # [V, ...]
             mb_shape = x_mb.shape[1:]
 
             MF, KF, FOK = (jnp.asarray(tables[k]) for k in

@@ -53,6 +53,20 @@ from .metrics import ServeMetrics
 logger = logging.getLogger(__name__)
 
 
+def _default_speculate_k() -> int:
+    # read at ServeConfig construction (not import) so monkeypatching
+    # edconfig.speculate_k takes effect without rebuilding the dataclass
+    from easydist_tpu import config as edconfig
+
+    return int(getattr(edconfig, "speculate_k", 0))
+
+
+def _default_speculate_drafter() -> str:
+    from easydist_tpu import config as edconfig
+
+    return str(getattr(edconfig, "speculate_drafter", "ngram"))
+
+
 @dataclass(frozen=True)
 class ServeConfig:
     """Bucketing + batching + admission policy for one engine.
@@ -105,6 +119,14 @@ class ServeConfig:
     kv_arena_pages: arena size in pages; 0 = auto
         (max_decode_slots * pages-per-sequence + one sequence's worth of
         headroom for trie-held pages).
+    speculate_k: draft tokens proposed per speculative-decoding verify
+        round (serve/speculate.py); 0 disables speculation.  The verify
+        program scores [slots, k+1] positions in one fixed-shape call —
+        k is a shape, so changing it means one new compiled signature.
+        Output is bitwise-identical to speculate_k=0 (greedy parity).
+    speculate_drafter: "ngram" (zero-cost self-speculative prompt
+        lookup) or "draft_model" (a second small model's cached greedy
+        decode; the session must be given a drafter or draft_model).
     """
     batch_buckets: Tuple[int, ...] = (1, 2, 4, 8)
     seq_buckets: Optional[Tuple[int, ...]] = None
@@ -132,6 +154,10 @@ class ServeConfig:
     kv_layout: str = "bucketed"
     kv_page_tokens: int = 0
     kv_arena_pages: int = 0
+    speculate_k: int = field(
+        default_factory=lambda: _default_speculate_k())
+    speculate_drafter: str = field(
+        default_factory=lambda: _default_speculate_drafter())
 
     def __post_init__(self):
         if not self.batch_buckets:
@@ -218,6 +244,24 @@ class ServeConfig:
                     f"max decode bucket {cap} is not a multiple of "
                     f"kv_page_tokens {pt}; pages must tile the sequence "
                     f"capacity exactly")
+        if self.speculate_k < 0:
+            raise ValueError(f"speculate_k must be >= 0 (0 disables "
+                             f"speculation), got {self.speculate_k}")
+        if self.speculate_k:
+            if self.speculate_drafter not in ("ngram", "draft_model"):
+                raise ValueError(
+                    f"speculate_drafter must be 'ngram' or 'draft_model', "
+                    f"got {self.speculate_drafter!r}")
+            # the verify step writes a k+1-row window at a traced start:
+            # a window wider than the smallest bucket could NEVER be
+            # placed without dynamic_update_slice clamping it onto
+            # committed rows, so k+1 must leave headroom in every bucket
+            if self.speculate_k + 1 >= min(self.decode_buckets):
+                raise ValueError(
+                    f"speculate_k {self.speculate_k} leaves no bucket "
+                    f"headroom: k+1 ({self.speculate_k + 1}) must be < "
+                    f"the smallest decode bucket "
+                    f"({min(self.decode_buckets)})")
 
 
 class ServeEngine:

@@ -69,6 +69,7 @@ from .batcher import select_bucket
 from .engine import ServeConfig
 from .metrics import ServeMetrics
 from .prefix_cache import PrefixCache
+from .speculate import NGramDrafter, accept_length
 
 logger = logging.getLogger(__name__)
 
@@ -80,6 +81,24 @@ logger = logging.getLogger(__name__)
 # the compile.  This is the fleet case: N in-process replicas differ only
 # in the state they carry, never in the program they run.
 _COMPILED_MEMO: Dict[tuple, tuple] = {}
+
+# adaptive-speculation throttle: a verify row costs ~1.5x a decode row,
+# so drafting pays off only while the stream's recent accepted-tokens-
+# per-round stays above the break-even (~0.5).  Each request carries an
+# EWMA of its accept counts; below the floor it stops drafting and only
+# PROBES every _SPEC_PROBE_EVERY scheduling rounds, so a stream the
+# drafter cannot predict decays to plain decode (one speculative probe
+# per 12 rounds ~ the whole adversarial overhead) while a stream that
+# turns predictable again is rediscovered within one probe interval.
+_SPEC_EWMA_ALPHA = 0.3
+_SPEC_EWMA_FLOOR = 0.5
+_SPEC_PROBE_EVERY = 12
+# full-batch verify economics: the verify program is k+1 positions wide
+# for EVERY row, drafted or not, so a round beats a decode round only
+# when the drafting rows' expected accepts cover the whole batch's share
+# of the wider program: sum(ewma) > (cost - 1) * rows.  Rounds that
+# close below that line pause speculation for a probe interval.
+_SPEC_VERIFY_COST = 2.0
 
 
 def kv_cache_specs(axis: str = "tp"):
@@ -263,7 +282,10 @@ class GenerationSession:
                  model_prefill_chunk: Optional[Callable] = None,
                  model_prefill_chunk_paged: Optional[Callable] = None,
                  model_decode_paged: Optional[Callable] = None,
+                 model_verify: Optional[Callable] = None,
+                 model_verify_paged: Optional[Callable] = None,
                  init_pages: Optional[Callable] = None,
+                 drafter: Optional[object] = None,
                  config: Optional[ServeConfig] = None, mesh=None,
                  eos_id: Optional[int] = None,
                  max_prompt_len: Optional[int] = None,
@@ -304,6 +326,41 @@ class GenerationSession:
         self._next_request_id = 0
         self._audited: set = set()
         self._audited_prefill: set = set()
+        self._audited_verify: set = set()
+
+        # speculative decoding (serve/speculate.py): a drafter proposes k
+        # tokens, one verify step scores all k+1 positions, the session
+        # commits the longest self-validating prefix.  Pure speed knob —
+        # committed tokens are exactly the plain-greedy stream.
+        self._spec_k = int(self.config.speculate_k or 0)
+        self._drafter = None
+        if self._spec_k:
+            if self._paged and model_verify_paged is None:
+                raise ValueError(
+                    "speculate_k with kv_layout='paged' requires "
+                    "model_verify_paged (the for_gpt/for_llama "
+                    "constructors wire it)")
+            if not self._paged and model_verify is None:
+                raise ValueError(
+                    "speculate_k requires model_verify (the for_gpt/"
+                    "for_llama constructors wire it)")
+            if drafter is not None:
+                self._drafter = drafter
+            elif self.config.speculate_drafter == "ngram":
+                self._drafter = NGramDrafter()
+            else:
+                raise ValueError(
+                    "speculate_drafter='draft_model' needs an explicit "
+                    "drafter: pass drafter=..., or draft_model="
+                    "(params, cfg) to for_gpt/for_llama")
+        # adaptive speculation (module constants above): per-request
+        # accept-rate EWMA + probe counter.  Purely a scheduling knob —
+        # which rounds verify never changes the committed tokens (the
+        # accept rule is self-validating), so parity and crash-resume
+        # stay bitwise.
+        self._spec_ewma: Dict[int, float] = {}
+        self._spec_idle: Dict[int, int] = {}
+        self._spec_gate_idle = 0
 
         def _prefill(cache, params, tokens, lengths):
             import jax.numpy as jnp
@@ -348,6 +405,18 @@ class GenerationSession:
             pool, logits = model_decode(params, pool, token, pos)
             return pool, jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
+        # speculative verify: tokens is [slots, k+1] (committed token then
+        # k drafts), the program writes K/V at all k+1 positions and
+        # returns the greedy pick at EVERY position — the commit walk
+        # happens on the host over int32 ids only
+        def _verify(pool, params, tokens, pos):
+            import jax.numpy as jnp
+
+            pool, logits = model_verify(params, pool, tokens, pos)
+            return pool, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        self._verify_def = _verify if model_verify is not None else None
+
         # paged-layout programs: arena first for donation pairing, the
         # int32 page table crosses as data every call (fixed shape — the
         # signature stays closed over arbitrary per-row lengths).
@@ -383,10 +452,19 @@ class GenerationSession:
                         page, axis=1)
                     for k in ("k", "v")}
 
+        def _verify_paged(arena, params, table, tokens, pos):
+            import jax.numpy as jnp
+
+            arena, logits = model_verify_paged(params, arena, table,
+                                               tokens, pos)
+            return arena, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
         self._paged_defs = (
             {"chunk": _prefill_chunk_paged, "decode": _decode_paged,
              "export": _page_export, "import": _page_import}
             if model_prefill_chunk_paged is not None else {})
+        if model_verify_paged is not None:
+            self._paged_defs["verify"] = _verify_paged
 
         # pool/staging is arg 0 and output 0 of every mutating compiled
         # callable, so state_io="auto" pairs it and XLA gets the buffer
@@ -413,14 +491,14 @@ class GenerationSession:
                       easydist_compile(_restore, mesh=mesh),
                       easydist_compile(_migrate, mesh=mesh),
                       easydist_compile(_decode, mesh=mesh),
-                      {}, {})
+                      {}, {}, {})
             if memo_key:
                 while len(_COMPILED_MEMO) >= 32:  # live sessions keep refs
                     _COMPILED_MEMO.pop(next(iter(_COMPILED_MEMO)))
                 _COMPILED_MEMO[memo_key] = shared
         (self._prefill_c, self._prefill_chunk_c, self._restore_c,
          self._migrate_c, self._decode_c, self._extract_cs,
-         self._paged_cs) = shared
+         self._paged_cs, self._verify_cs) = shared
 
     def _extract_for(self, chunk_len: int) -> Callable:
         """Compiled chunk extractor for one chunk size (the slice size
@@ -455,6 +533,18 @@ class GenerationSession:
 
             fn = easydist_compile(self._paged_defs[name], mesh=self.mesh)
             self._paged_cs[name] = fn
+        return fn
+
+    def _verify_c(self) -> Callable:
+        """Compiled bucketed verify step, built on first use and shared
+        through the process memo exactly like `_paged_c` (the paged
+        layout's verify program lives in `_paged_defs`/`_paged_cs`)."""
+        fn = self._verify_cs.get("verify")
+        if fn is None:
+            from easydist_tpu.jaxfront import easydist_compile
+
+            fn = easydist_compile(self._verify_def, mesh=self.mesh)
+            self._verify_cs["verify"] = fn
         return fn
 
     # ------------------------------------------------------------ admission
@@ -848,6 +938,10 @@ class GenerationSession:
     def _retire(self, pool, slot_idx: int, reason: str) -> None:
         slot = pool.slots.pop(slot_idx)
         pool.free.append(slot_idx)
+        if self._drafter is not None:
+            self._drafter.forget(slot.request_id)
+            self._spec_ewma.pop(slot.request_id, None)
+            self._spec_idle.pop(slot.request_id, None)
         if self._paged:
             for pid in pool.table.unmap_row(slot_idx):
                 pool.pool.release(pid)
@@ -871,19 +965,27 @@ class GenerationSession:
             return False
         return True
 
-    def _decode_round(self, pool) -> None:
+    def _decode_round(self, pool, only: Optional[set] = None) -> None:
         """One compiled decode step over ALL slots of `pool` (fixed
         shapes: the signature cache stays at one entry per bucket — and
         at ONE entry total for the paged layout, whose only per-step
-        variation is page-table DATA)."""
+        variation is page-table DATA).
+
+        `only` restricts the round to the given slot indices — PAGED
+        layout only (excluded rows keep a sentinel table row so their
+        dead-row write drops; the bucketed cache has no sentinel, so an
+        excluded bucketed slot would take a garbage write at row 0).
+        The speculative scheduler uses it to plain-decode the slots a
+        verify round could not carry."""
         import jax
         import jax.numpy as jnp
 
+        live = [i for i in pool.slots if only is None or i in only]
         token = np.zeros((pool.n_slots,), np.int32)
         pos = np.zeros((pool.n_slots,), np.int32)
-        for idx, slot in pool.slots.items():
-            token[idx] = slot.token
-            pos[idx] = slot.pos
+        for idx in live:
+            token[idx] = pool.slots[idx].token
+            pos[idx] = pool.slots[idx].pos
         if self._paged:
             # only actively-decoding rows expose their table row: a
             # reserved-but-still-prefilling slot's pages (possibly
@@ -891,7 +993,7 @@ class GenerationSession:
             # step lands at pos 0 — sentinel rows drop it instead
             tbl = np.full((pool.n_slots, pool.max_pages),
                           pool.pool.sentinel, np.int32)
-            for idx in pool.slots:
+            for idx in live:
                 tbl[idx] = pool.table.array[idx]
             args = (pool.arena, self.params, jnp.asarray(tbl),
                     jnp.asarray(token), jnp.asarray(pos))
@@ -913,17 +1015,255 @@ class GenerationSession:
             pool.cache, nxt = result.tree_jitted(*args)
         nxt = np.asarray(jax.block_until_ready(nxt))
         dt = time.perf_counter() - t0
-        n_active = pool.n_active
-        for idx in list(pool.slots):
+        for idx in live:
             slot = pool.slots[idx]
             slot.token = int(nxt[idx])
             slot.pos += 1
             slot.generated.append(slot.token)
             self._maybe_retire(pool, idx)
-        self.metrics.record_decode_step(n_active, pool.n_slots, dt)
+        self.metrics.record_decode_step(len(live), pool.n_slots, dt)
         if self._paged:
-            in_use, tokens = pool.occupancy()
-            self.metrics.record_kv_pool(in_use, tokens, pool.chunk)
+            in_use, held = pool.occupancy()
+            self.metrics.record_kv_pool(in_use, held, pool.chunk)
+
+    # ------------------------------------------------ speculative decoding
+    def _spec_round(self, pool) -> bool:
+        """One speculative draft/verify round over `pool`
+        (serve/speculate.py describes the accept rule).  Returns False
+        when no slot can ride a verify step this round — the caller
+        falls back to a plain decode round, so speculation never stalls
+        decode.
+
+        Bucketed pools are all-or-nothing: the verify program writes
+        k+1 cache rows for EVERY row, so every live slot needs headroom
+        (pos + k + 1 <= bucket) — near the wall the pool rides plain
+        decode for its last few tokens.  Slots without a draft ride
+        anyway with pad drafts (position 0 of the verify output is the
+        plain-greedy token, so they commit at least one token, exactly
+        like a decode step).
+
+        Paged pools are per-slot: sentinel table rows drop excluded
+        rows' writes, so eligible slots (draft + headroom + speculative
+        spill windows mappable) verify while the rest take a plain
+        decode call (`_decode_round(only=...)`)."""
+        k = self._spec_k
+        if self._spec_gate_idle > 0:
+            # pacing after a round that closed below the full-batch
+            # break-even (_commit_verify) — plain decode rounds until
+            # the next attempt, which doubles as the refresh probe
+            self._spec_gate_idle -= 1
+            return False
+        drafts: Dict[int, List[int]] = {}
+        for idx, slot in pool.slots.items():
+            rid = slot.request_id
+            ewma = self._spec_ewma.get(rid)
+            if ewma is not None and ewma < _SPEC_EWMA_FLOOR:
+                # throttled: recent acceptance below break-even; only
+                # probe once per interval to re-detect predictability
+                idle = self._spec_idle.get(rid, 0) + 1
+                if idle < _SPEC_PROBE_EVERY:
+                    self._spec_idle[rid] = idle
+                    continue
+            self._spec_idle[rid] = 0
+            d = self._drafter.propose(
+                slot.request_id, slot.prompt + slot.generated, k)
+            if d:
+                drafts[idx] = (list(int(t) for t in d) + [0] * k)[:k]
+        if not drafts:
+            return False
+        if self._paged:
+            return self._verify_round_paged(pool, drafts)
+        if any(s.pos + k + 1 > pool.bucket for s in pool.slots.values()):
+            return False
+        return self._verify_round_bucketed(pool, drafts)
+
+    def _verify_round_bucketed(self, pool: _BucketPool, drafts) -> bool:
+        import jax
+        import jax.numpy as jnp
+
+        k = self._spec_k
+        tokens = np.zeros((pool.n_slots, k + 1), np.int32)
+        pos = np.zeros((pool.n_slots,), np.int32)
+        for idx, slot in pool.slots.items():
+            tokens[idx, 0] = slot.token
+            tokens[idx, 1:] = drafts.get(idx, [0] * k)
+            pos[idx] = slot.pos
+        args = (pool.cache, self.params, jnp.asarray(tokens),
+                jnp.asarray(pos))
+        result = self._verify_c().get_compiled(*args)
+        if ("bucketed", pool.bucket) not in self._audited_verify:
+            self._audited_verify.add(("bucketed", pool.bucket))
+            self._audit_verify(result, f"verify[bucket={pool.bucket}]")
+        t0 = time.perf_counter()
+        pool.cache, nxt = result.tree_jitted(*args)
+        nxt = np.asarray(jax.block_until_ready(nxt))
+        dt = time.perf_counter() - t0
+        # rejected rows need no explicit cleanup in the bucketed layout:
+        # the pos cursor simply does not advance past the accepted
+        # prefix, the next write at pos overwrites the stale row, and
+        # the length mask hides everything past the query position
+        proposed, accepted, committed = self._commit_verify(
+            pool, drafts, tokens, nxt, list(pool.slots))
+        self.metrics.record_speculation(
+            proposed, accepted, committed, len(drafts), pool.n_slots, dt)
+        return True
+
+    def _verify_round_paged(self, pool: _PagedPool, drafts) -> bool:
+        import jax
+        import jax.numpy as jnp
+
+        k = self._spec_k
+        eligible: Dict[int, List[int]] = {}
+        for idx, d in drafts.items():
+            slot = pool.slots[idx]
+            if slot.pos + k + 1 > pool.bucket:
+                continue
+            # speculative rows may spill past the slot's up-front page
+            # reservation; map the spill windows now (the rollback below
+            # unconditionally truncates the row back to the reservation,
+            # so outside a verify round the invariant "live slots map
+            # exactly their reservation" always holds)
+            n_need = (slot.pos + k) // pool.chunk + 1
+            n_have = pool.table.n_mapped(idx)
+            if n_need > n_have:
+                if not pool.make_room(n_need - n_have):
+                    continue
+                for j in range(n_have, n_need):
+                    pool.table.map(idx, j, pool.pool.alloc())
+            eligible[idx] = d
+        if not eligible:
+            return False
+        tokens = np.zeros((pool.n_slots, k + 1), np.int32)
+        pos = np.zeros((pool.n_slots,), np.int32)
+        tbl = np.full((pool.n_slots, pool.max_pages),
+                      pool.pool.sentinel, np.int32)
+        for idx, d in eligible.items():
+            slot = pool.slots[idx]
+            tokens[idx, 0] = slot.token
+            tokens[idx, 1:] = d
+            pos[idx] = slot.pos
+            tbl[idx] = pool.table.array[idx]
+        args = (pool.arena, self.params, jnp.asarray(tbl),
+                jnp.asarray(tokens), jnp.asarray(pos))
+        result = self._paged_c("verify").get_compiled(*args)
+        if ("paged", pool.bucket) not in self._audited_verify:
+            self._audited_verify.add(("paged", pool.bucket))
+            self._audit_verify(result, f"verify[paged cap={pool.bucket}]")
+        t0 = time.perf_counter()
+        pool.arena, nxt = result.tree_jitted(*args)
+        nxt = np.asarray(jax.block_until_ready(nxt))
+        dt = time.perf_counter() - t0
+        # reservation sizes BEFORE the commit walk can retire the slots
+        reserved = {idx: pool.pages_needed(len(pool.slots[idx].prompt),
+                                           pool.slots[idx].max_new)
+                    for idx in eligible}
+        rest = [i for i in pool.slots if i not in eligible]
+        proposed, accepted, committed = self._commit_verify(
+            pool, drafts, tokens, nxt, list(eligible))
+        # rollback: spill windows past the reservation only ever hold
+        # rejected/uncommitted draft rows (committed positions provably
+        # fit the reservation — pages_needed covers prompt + max_new),
+        # so truncating the table tail releases them.  Retired slots
+        # were already fully unmapped by _retire.
+        released = 0
+        for idx in eligible:
+            if idx not in pool.slots:
+                continue
+            for pid in pool.table.unmap_tail(idx, reserved[idx]):
+                pool.pool.release(pid)
+                released += 1
+        if released:
+            self._audit_spec_rollback(pool)
+        self.metrics.record_speculation(
+            proposed, accepted, committed, len(eligible), pool.n_slots,
+            dt, pages_released=released)
+        in_use, held = pool.occupancy()
+        self.metrics.record_kv_pool(in_use, held, pool.chunk)
+        if rest:
+            self._decode_round(pool, only=set(rest))
+        return True
+
+    def _commit_verify(self, pool, drafts, tokens, nxt, idxs):
+        """Commit walk for the slots that rode a verify step: accept the
+        longest draft prefix the target's own greedy picks ratify, plus
+        the target's correction/bonus token.  Every committed token is
+        the exact plain-greedy token (the draft row only decides how
+        many commit per round), so retire semantics (eos/length/
+        bucket_full) are checked token-by-token exactly as a sequence
+        of plain decode rounds would.  Returns (proposed, accepted,
+        committed) counts for the speculation metrics."""
+        k = self._spec_k
+        proposed = accepted = committed = 0
+        expect = 0.0
+        for idx in idxs:
+            d_row = tokens[idx, 1:]
+            g_row = nxt[idx]
+            # the accept rule is self-validating, so pad drafts on
+            # draftless rows are safe — an accidental pad match is a
+            # genuine accept; only REAL proposals count toward the rate
+            n_acc = accept_length(d_row, g_row[:k])
+            self._audit_spec_bookkeeping(d_row, g_row, n_acc,
+                                         f"slot={idx}")
+            if idx in drafts:
+                proposed += k
+                accepted += n_acc
+                rid = pool.slots[idx].request_id
+                prev = self._spec_ewma.get(rid, float(n_acc))
+                self._spec_ewma[rid] = ((1 - _SPEC_EWMA_ALPHA) * prev
+                                        + _SPEC_EWMA_ALPHA * n_acc)
+                expect += self._spec_ewma[rid]
+            for i in range(n_acc + 1):
+                slot = pool.slots[idx]
+                slot.token = int(g_row[i])
+                slot.pos += 1
+                slot.generated.append(slot.token)
+                committed += 1
+                if self._maybe_retire(pool, idx):
+                    break
+        # full-batch economics (see _SPEC_VERIFY_COST): expected accepts
+        # from the drafting rows' refreshed EWMAs must cover the pad
+        # rows' share of the k+1-wide program, else pace speculation
+        if drafts and expect < (_SPEC_VERIFY_COST - 1.0) * max(
+                1, len(idxs)):
+            self._spec_gate_idle = _SPEC_PROBE_EVERY - 1
+        return proposed, accepted, committed
+
+    def _audit_verify(self, result, node: str) -> None:
+        """SERVE003 (program arm): the verify step must donate its cache
+        and length-mask attention past the committed positions —
+        audited once per compiled verify signature."""
+        try:
+            from easydist_tpu.analyze import check_speculative_rewind
+
+            check_speculative_rewind(result=result, node=node)
+        except ImportError:  # analyze is an optional layer at runtime
+            pass
+
+    def _audit_spec_bookkeeping(self, draft, target, n_accepted: int,
+                                node: str) -> None:
+        """SERVE003 (bookkeeping arm): the accepted prefix must never
+        advance past the first draft/target mismatch."""
+        try:
+            from easydist_tpu.analyze import check_speculative_rewind
+
+            check_speculative_rewind(
+                draft=[int(t) for t in draft],
+                target=[int(t) for t in target],
+                n_accepted=n_accepted, node=f"verify[{node}]")
+        except ImportError:
+            pass
+
+    def _audit_spec_rollback(self, pool: _PagedPool) -> None:
+        """SERVE003 (paged arm): after a rollback released spill pages,
+        no table row may still point at a released page."""
+        try:
+            from easydist_tpu.analyze import check_speculative_rewind
+
+            check_speculative_rewind(pool=pool.pool, table=pool.table,
+                                     trie=pool.trie,
+                                     node="verify[rollback]")
+        except ImportError:
+            pass
 
     def _audit_donation(self, result, bucket: int) -> None:
         try:
@@ -985,6 +1325,8 @@ class GenerationSession:
         before = self.metrics.counter("tokens_generated")
         for pool in self._pools.values():
             if pool.slots:
+                if self._drafter is not None and self._spec_round(pool):
+                    continue
                 self._decode_round(pool)
         self.metrics.set_gauge("queue_depth", self.queue_depth)
         return self.metrics.counter("tokens_generated") - before
@@ -1251,20 +1593,53 @@ class GenerationSession:
                 if self._paged and "chunk" in self._paged_cs
                 else (self._prefill_chunk_c if self._chunked
                       else self._prefill_c).cache_stats()),
+            "verify_signatures": (
+                self._paged_cs["verify"].cache_stats()
+                if self._paged and "verify" in self._paged_cs
+                else (self._verify_cs["verify"].cache_stats()
+                      if "verify" in self._verify_cs else None)),
             "migrate_signatures": self._migrate_c.cache_stats(),
             "metrics": self.metrics.snapshot(),
         }
 
     # --------------------------------------------------------- constructors
     @classmethod
-    def for_gpt(cls, params, cfg, **kw):
+    def _wire_draft_model(cls, kw, draft_model, decode_step, init_cache,
+                          seq_bound: Optional[int]) -> None:
+        """Turn a `draft_model=(params, cfg)` pair into a
+        `SmallModelDrafter` over the family's own decode step (in `kw`
+        as `drafter`, unless the caller passed one explicitly)."""
+        if draft_model is None or kw.get("drafter") is not None:
+            return
+        from .speculate import SmallModelDrafter
+
+        dparams, dcfg = draft_model
+        scfg = kw.get("config") or ServeConfig()
+        max_len = max(scfg.decode_buckets)
+        if seq_bound is not None:
+            max_len = min(max_len, seq_bound)
+        kw["drafter"] = SmallModelDrafter(
+            dparams,
+            model_decode=lambda p, c, t, pos: decode_step(
+                p, dcfg, c, t, pos),
+            init_cache=lambda b, L: init_cache(dcfg, b, L),
+            max_len=max_len, mesh=kw.get("mesh"))
+
+    @classmethod
+    def for_gpt(cls, params, cfg, *, draft_model=None, **kw):
         """Session over models/gpt.py; decode_buckets must fit cfg.seq
-        (the learned-position-table bound)."""
+        (the learned-position-table bound).  `draft_model=(params, cfg)`
+        wires a `SmallModelDrafter` over a second (smaller) gpt for
+        `speculate_drafter="draft_model"`."""
         import dataclasses
 
         from easydist_tpu.models import gpt
 
         kw.setdefault("compile_key", ("gpt", dataclasses.astuple(cfg)))
+        if draft_model is not None:
+            cls._wire_draft_model(kw, draft_model, gpt.gpt_decode_step,
+                                  gpt.init_kv_cache,
+                                  seq_bound=draft_model[1].seq)
         return cls(
             params,
             model_prefill=lambda p, c, t, l: gpt.gpt_prefill(p, cfg, c, t, l),
@@ -1280,17 +1655,27 @@ class GenerationSession:
                 gpt.gpt_decode_step_paged(p, cfg, pg, tb, t, pos),
             init_pages=lambda n, t, dt=None: gpt.init_kv_pages(
                 cfg, n, t, dtype=dt),
+            model_verify=lambda p, c, t, pos: gpt.gpt_verify_step(
+                p, cfg, c, t, pos),
+            model_verify_paged=lambda p, pg, tb, t, pos:
+                gpt.gpt_verify_step_paged(p, cfg, pg, tb, t, pos),
             max_prompt_len=cfg.seq, **kw)
 
     @classmethod
-    def for_llama(cls, params, cfg, **kw):
+    def for_llama(cls, params, cfg, *, draft_model=None, **kw):
         """Session over models/llama.py (RoPE: buckets are not bound by
-        cfg.seq)."""
+        cfg.seq).  `draft_model=(params, cfg)` wires a
+        `SmallModelDrafter` over a second (smaller) llama for
+        `speculate_drafter="draft_model"`."""
         import dataclasses
 
         from easydist_tpu.models import llama
 
         kw.setdefault("compile_key", ("llama", dataclasses.astuple(cfg)))
+        if draft_model is not None:
+            cls._wire_draft_model(kw, draft_model,
+                                  llama.llama_decode_step,
+                                  llama.init_kv_cache, seq_bound=None)
         return cls(
             params,
             model_prefill=lambda p, c, t, l: llama.llama_prefill(
@@ -1307,4 +1692,8 @@ class GenerationSession:
                 llama.llama_decode_step_paged(p, cfg, pg, tb, t, pos),
             init_pages=lambda n, t, dt=None: llama.init_kv_pages(
                 cfg, n, t, dtype=dt),
+            model_verify=lambda p, c, t, pos: llama.llama_verify_step(
+                p, cfg, c, t, pos),
+            model_verify_paged=lambda p, pg, tb, t, pos:
+                llama.llama_verify_step_paged(p, cfg, pg, tb, t, pos),
             **kw)

@@ -78,6 +78,10 @@ class ServeMetrics:
       prefill_tokens_padded (executed token slots = rows x chunk per
       call), prefix_tokens_reused / prefix_tokens_total,
       prefix_cache_hits / misses / evictions (trie chunk events).
+    Speculative-decoding counters (serve/speculate.py): verify_steps,
+      draft_tokens_proposed / draft_tokens_accepted,
+      speculative_rollback_pages_released (paged rollback returns);
+      gauge acceptance_rate (lifetime accepted / proposed).
     Gauges: decode_slot_occupancy (active slots / total slots at the last
       decode step), prefill_padding_ratio (executed token slots per real
       prefill token, 1.0 = zero waste), prefix_cache_hit_rate (fraction
@@ -134,6 +138,42 @@ class ServeMetrics:
                 self._counters.get("decode_steps", 0) + 1
             self._gauges["decode_slot_occupancy"] = \
                 (n_active / n_slots) if n_slots else 0.0
+            self.per_token.observe(step_s)
+
+    def record_speculation(self, proposed: int, accepted: int,
+                           committed: int, n_ran: int, n_slots: int,
+                           step_s: float,
+                           pages_released: int = 0) -> None:
+        """One speculative verify round (serve/speculate.py): `proposed`
+        draft tokens entered the verify step, `accepted` of them were
+        ratified, `committed` tokens were emitted in total (accepted
+        drafts + one correction/bonus per slot — these count toward
+        `tokens_generated` exactly like decode-step tokens, since they
+        ARE the plain-greedy tokens).  `n_ran` slots rode the verify
+        program out of `n_slots` rows; `pages_released` arena pages were
+        returned by the paged rollback.  `acceptance_rate` is the
+        lifetime accepted/proposed ratio — the drafter-quality signal
+        (speedup ~ committed tokens per verify step)."""
+        with self._lock:
+            self._counters["verify_steps"] = \
+                self._counters.get("verify_steps", 0) + 1
+            self._counters["draft_tokens_proposed"] = \
+                self._counters.get("draft_tokens_proposed", 0) + proposed
+            self._counters["draft_tokens_accepted"] = \
+                self._counters.get("draft_tokens_accepted", 0) + accepted
+            self._counters["tokens_generated"] = \
+                self._counters.get("tokens_generated", 0) + committed
+            if pages_released:
+                self._counters["speculative_rollback_pages_released"] = \
+                    self._counters.get(
+                        "speculative_rollback_pages_released", 0) \
+                    + pages_released
+            total = self._counters["draft_tokens_proposed"]
+            if total:
+                self._gauges["acceptance_rate"] = \
+                    self._counters["draft_tokens_accepted"] / total
+            self._gauges["decode_slot_occupancy"] = \
+                (n_ran / n_slots) if n_slots else 0.0
             self.per_token.observe(step_s)
 
     def record_admission(self, prompt_len: int, prefix_len: int) -> None:

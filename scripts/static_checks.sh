@@ -297,6 +297,8 @@ try:
     elif not r.get("ttft_p99_inflation", 1e18) <= r.get("ttft_p99_bound", 0):
         print(f"ttft p99 inflated {r.get('ttft_p99_inflation')}x under "
               f"chaos (bound {r.get('ttft_p99_bound')}x)")
+    elif not r.get("verify_steps", 0) > 0:
+        print("no speculative verify round was in flight during the drill")
     elif r.get("value") != 1.0:
         print(f"only {r.get('value')} of requests finished clean")
     elif r.get("perf_regression"):
@@ -314,6 +316,53 @@ PYEOF
     fi
 else
     echo "static_checks: jax not importable; skipping bench.py --fleet-chaos"
+fi
+
+# speculative-decoding gate: draft/verify greedy decode must beat plain
+# decode >= 1.4x tokens/s on the repetitive (hot-prompt) workload and
+# slow the adversarial (always-rejected-drafts) workload by <= 1.15x,
+# with bitwise greedy parity on BOTH workloads (the accept rule is
+# self-validating), ONE compiled verify signature, and the paged
+# mini-arm's spill-page rollback actually releasing pages
+if python -c "import jax" >/dev/null 2>&1; then
+    echo "== bench.py --speculate (draft/verify speedup + parity gate)"
+    out=$(python bench.py --speculate 2>/dev/null) || rc=1
+    echo "$out"
+    verdict=$(python - "$out" <<'PYEOF'
+import json, sys
+try:
+    r = json.loads(sys.argv[1].strip().splitlines()[-1])
+    if "error" in r:
+        print("error: " + r["error"])
+    elif not r.get("parity_greedy"):
+        print("speculative greedy ids diverge from plain decode")
+    elif not r.get("paged_parity_greedy"):
+        print("paged speculative greedy ids diverge from plain decode")
+    elif not r.get("verify_signature_constant"):
+        print("verify signature cache grew past one compiled step")
+    elif not r.get("value", 0) >= 1.4:
+        print(f"repetitive speedup {r.get('value')} < 1.4x")
+    elif not r.get("adversarial_slowdown", 1e18) <= r.get(
+            "adversarial_slowdown_bound", 0):
+        print(f"adversarial slowdown {r.get('adversarial_slowdown')}x over "
+              f"bound {r.get('adversarial_slowdown_bound')}x")
+    elif not r.get("speculative_rollback_pages_released", 0) > 0:
+        print("paged rollback released zero spill pages (arm tested nothing)")
+    elif r.get("perf_regression"):
+        print(f"committed-floor regression: {r.get('value')} is >10% below "
+              f"last-good {r.get('last_good_value')}")
+    else:
+        print("ok")
+except Exception as e:
+    print(f"unparseable: {e}")
+PYEOF
+)
+    if [ "$verdict" != "ok" ]; then
+        echo "static_checks: speculate gate failed ($verdict)"
+        rc=1
+    fi
+else
+    echo "static_checks: jax not importable; skipping bench.py --speculate"
 fi
 
 [ "$ran" = 0 ] && echo "static_checks: no external linters ran (configs still validated by CI tests)"

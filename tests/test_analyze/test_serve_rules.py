@@ -1,7 +1,9 @@
 """Layer 5 serving auditor goldens: SERVE002 over compiled chunked-
 prefill programs (staging donation + length-mask presence) and over live
-prefix tries (refcount/byte invariants).  SERVE001 goldens live with the
-session tests in tests/test_serve/test_generation.py."""
+prefix tries (refcount/byte invariants); SERVE003 over compiled verify
+steps, accept-walk bookkeeping, and post-rollback page tables.  SERVE001
+goldens live with the session tests in
+tests/test_serve/test_generation.py."""
 
 import jax
 import jax.numpy as jnp
@@ -9,10 +11,13 @@ import numpy as np
 import pytest
 
 from easydist_tpu.analyze import (audit_chunked_prefill, audit_prefix_cache,
-                                  check_chunked_prefill, check_prefix_cache)
+                                  audit_speculative_rewind,
+                                  check_chunked_prefill, check_prefix_cache,
+                                  check_speculative_rewind)
 from easydist_tpu.analyze.findings import AnalysisError
 from easydist_tpu.analyze.serve_rules import _has_masked_select
 from easydist_tpu.jaxfront import easydist_compile
+from easydist_tpu.kv import PagePool, PageTable
 from easydist_tpu.models import gpt
 from easydist_tpu.serve import PrefixCache
 
@@ -137,3 +142,116 @@ class TestPrefixCacheAudit:
                    for f in findings)
         with pytest.raises(AnalysisError):
             check_prefix_cache(trie)
+
+
+K = 3
+
+
+def _compile_verify(cfg, params, donate=True):
+    def _vf(cache, prm, tokens, pos):
+        cache, logits = gpt.gpt_verify_step(prm, cfg, cache, tokens, pos)
+        return cache, jnp.argmax(logits, -1).astype(jnp.int32)
+
+    c = easydist_compile(_vf, donate_state=donate)
+    cache = gpt.init_kv_cache(cfg, 2, cfg.seq)
+    tokens = jnp.zeros((2, K + 1), jnp.int32)
+    pos = jnp.zeros((2,), jnp.int32)
+    return c.get_compiled(cache, params, tokens, pos)
+
+
+class TestSpeculativeRewindProgramAudit:
+    def test_clean_verify_step_zero_findings(self, model):
+        cfg, params = model
+        res = _compile_verify(cfg, params, donate=True)
+        assert audit_speculative_rewind(res) == []
+        assert check_speculative_rewind(result=res) == []
+
+    def test_missing_donation_fires_warning_once(self, model):
+        cfg, params = model
+        res = _compile_verify(cfg, params, donate=False)
+        findings = audit_speculative_rewind(res)
+        assert len(findings) == 1
+        assert findings[0].rule_id == "SERVE003"
+        assert findings[0].severity == "warning"
+        # warning-only: the hook logs, never raises
+        assert len(check_speculative_rewind(result=res)) == 1
+
+    def test_unmasked_verify_fires_error_once(self, model):
+        """A verify trunk whose attention sees the whole window —
+        including the speculative rows it just wrote — must trip the
+        mask error: rejected drafts would contaminate the logits that
+        judge them."""
+        cfg, params = model
+
+        def _unmasked(cache, prm, tokens, pos):
+            q = prm["emb"][tokens]           # [b, s, hd]
+            k = cache["k"][0, :, 0]          # [b, max_len, hd]
+            s = jnp.einsum("bcd,btd->bct", q, k)
+            att = jax.nn.softmax(s, axis=-1)  # NO length mask
+            out = jnp.einsum("bct,btd->bcd", att, cache["v"][0, :, 0])
+            cache = {kk: cache[kk] + 0.0 for kk in cache}
+            return cache, out.sum(-1).astype(jnp.int32)
+
+        c = easydist_compile(_unmasked, donate_state=True)
+        cache = gpt.init_kv_cache(cfg, 2, cfg.seq)
+        head_dim = cache["k"].shape[-1]
+        prm = {"emb": jnp.ones((cfg.vocab, head_dim), jnp.float32)}
+        res = c.get_compiled(cache, prm, jnp.zeros((2, K + 1), jnp.int32),
+                             jnp.zeros((2,), jnp.int32))
+        findings = audit_speculative_rewind(res)
+        errors = [f for f in findings if f.severity == "error"]
+        assert len(errors) == 1
+        assert errors[0].rule_id == "SERVE003"
+        assert "length-masked" in errors[0].message
+        with pytest.raises(AnalysisError):
+            check_speculative_rewind(result=res)
+
+
+class TestSpeculativeRewindBookkeepingAudit:
+    def test_correct_accept_counts_zero_findings(self):
+        # accept up to AND NOT past the first mismatch
+        for n in range(3):
+            assert audit_speculative_rewind(
+                draft=[1, 2, 9], target=[1, 2, 3, 4], n_accepted=n) == []
+
+    def test_advancing_past_first_mismatch_fires_once(self):
+        findings = audit_speculative_rewind(
+            draft=[1, 2, 9], target=[1, 2, 3, 4], n_accepted=3)
+        assert len(findings) == 1
+        assert findings[0].rule_id == "SERVE003"
+        assert findings[0].severity == "error"
+        assert "first" in findings[0].message
+        with pytest.raises(AnalysisError):
+            check_speculative_rewind(draft=[1, 2, 9], target=[1, 2, 3, 4],
+                                     n_accepted=3)
+
+
+class TestSpeculativeRewindRollbackAudit:
+    def _paged_state(self):
+        pool = PagePool(4, 8, page_bytes=256)
+        table = PageTable(2, 2, 4)
+        table.map(0, 0, pool.alloc())
+        table.map(0, 1, pool.alloc())
+        return pool, table
+
+    def test_clean_rollback_zero_findings(self):
+        pool, table = self._paged_state()
+        # a correct rollback: release exactly the pages unmap_tail drops
+        for pid in table.unmap_tail(0, 1):
+            pool.release(pid)
+        assert audit_speculative_rewind(pool=pool, table=table) == []
+        assert check_speculative_rewind(pool=pool, table=table) == []
+
+    def test_dangling_released_page_fires(self):
+        """The golden known-bad rollback: the spill page was released
+        back to the pool but the table row still points at it — the
+        allocator can hand the page to another sequence while this one's
+        attention still gathers it."""
+        pool, table = self._paged_state()
+        pool.release(int(table.array[0, 1]))    # released, NOT unmapped
+        findings = audit_speculative_rewind(pool=pool, table=table)
+        assert len(findings) >= 1
+        assert all(f.rule_id == "SERVE003" for f in findings)
+        assert any("refcount" in f.message for f in findings)
+        with pytest.raises(AnalysisError):
+            check_speculative_rewind(pool=pool, table=table)

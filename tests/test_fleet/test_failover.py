@@ -199,6 +199,42 @@ class TestCrashRecovery:
         assert router.metrics.counter("requests_recovered") > 0
 
 
+class TestSpeculativeCrashRecovery:
+    """Crash a replica mid-speculation: the resumed request re-drafts
+    from prompt + committed ids on the survivor (NGramDrafter proposals
+    are a pure function of the sequence) and the accept rule is
+    self-validating, so the recovered stream matches BOTH the
+    uninterrupted speculative run and plain greedy bitwise — losing the
+    drafter's in-flight state can only cost speed, never tokens."""
+
+    def test_mid_speculation_crash_bitwise(self, model):
+        cfg, _ = model
+        # cyclic prompts so the drafter proposes and verify rounds are
+        # live (not backed off) when the crash lands
+        prompts = [[5, 6, 5, 6, 5, 6, 5], [9, 3, 9, 3, 9, 3, 9],
+                   [4, 4, 4, 4, 4], [2, 7, 2, 7, 2, 7]]
+        plain = _reference(model, prompts, 10)
+        want = _reference(model, prompts, 10, speculate_k=3)
+        assert want == plain  # speculation parity, before any fault
+        d0 = _mk(model, "d0", speculate_k=3)
+        d1 = _mk(model, "d1", speculate_k=3)
+        router = FleetRouter([d0, d1])
+        futs = [router.submit(p, max_new_tokens=10) for p in prompts]
+        occ, target = _crash_occurrence(router, ["d0", "d1"], step_no=2)
+        with faultinject.fault_plan(f"fleet.replica.crash@{occ}"):
+            router.run_until_drained()
+            assert faultinject.stats()["fired"]["fleet.replica.crash"] == 1
+            assert faultinject.unfired() == []
+        out = [f.result(timeout=5) for f in futs]
+        assert [o["ids"] for o in out] == want
+        assert target not in router.stats()["replicas"]
+        assert router.metrics.counter("requests_recovered") >= 1
+        # the survivor really speculated while finishing the recovered
+        # streams — the drill exercised draft/verify, not plain decode
+        survivor = d1 if target == "d0" else d0
+        assert survivor.metrics.snapshot()["counters"]["verify_steps"] > 0
+
+
 class TestWedgedReplica:
     def test_probe_detects_stall_and_fails_over(self, model):
         """A replica that is alive but makes no progress (step() returns,

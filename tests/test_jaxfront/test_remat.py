@@ -17,7 +17,8 @@ from easydist_tpu.utils.hlo import collective_summary
 @pytest.mark.world_8
 def test_undifferentiated_checkpoint_composite_rule(cpu_devices):
     """A forward checkpoint region gets an analytic composite rule with
-    batch AND tensor-parallel groups (no eager body execution)."""
+    batch AND tensor-parallel strategies, each carrying an honest
+    priced compute cost (no eager body execution)."""
     from easydist_tpu.jaxfront.api import ShardingAnalyzer
     from easydist_tpu.jaxfront.inline import inline_calls
 
@@ -35,7 +36,12 @@ def test_undifferentiated_checkpoint_composite_rule(cpu_devices):
     t0 = time.perf_counter()
     rule = analyzer._discover_composite(eqn)
     assert time.perf_counter() - t0 < 5.0
-    assert rule is not None and rule["space"].max_group() >= 2
+    assert rule is not None and len(rule["strategies"]) >= 2
+    # every strategy prices its body: compute seconds must be positive
+    # and below the replicate-basis total
+    assert rule["compute"] > 0.0
+    for _ins, _outs, _comm, compute in rule["strategies"]:
+        assert 0.0 < compute <= rule["compute"]
 
 
 @pytest.mark.world_8
