@@ -1141,6 +1141,23 @@ def resilience_main():
             got = np.asarray(jax.device_get(run(faulted))).tobytes()
             resume_bitwise = got == ref
 
+        # drill 3b: elastic mesh-shrink notice — same SIGTERM grace path
+        # as a preemption (the cross-mesh restart itself is gated by
+        # bench --elastic-chaos); every scheduled fault must fire and the
+        # record lands in the PerfDB
+        with tempfile.TemporaryDirectory() as d:
+            with faultinject.fault_plan("elastic.mesh.shrink@2"):
+                try:
+                    run(d)
+                    shrink_preempted = False
+                except PreemptedError:
+                    shrink_preempted = True
+                elastic_unfired = len(faultinject.unfired())
+                faultinject.export_stats(sub_key="elastic_drill",
+                                         persist=True)
+            got2 = np.asarray(jax.device_get(run(d))).tobytes()
+            shrink_resume_bitwise = got2 == ref
+
         # ---- drill 4: serve degradation
         from easydist_tpu.serve import (ExecTimeoutError, ServeConfig,
                                         ServeEngine)
@@ -1160,10 +1177,14 @@ def resilience_main():
             health = engine.health()
 
         ok = bool(resume_bitwise and torn_invisible and verify_clean
-                  and watchdog_ok and not parity)
+                  and watchdog_ok and not parity and shrink_preempted
+                  and shrink_resume_bitwise and elastic_unfired == 0)
         result.update({
             "value": float(resume_bitwise),
             "recovery_drill_pass": ok,
+            "shrink_notice_preempted": shrink_preempted,
+            "shrink_resume_bitwise": shrink_resume_bitwise,
+            "elastic_fault_plan_unfired": int(elastic_unfired),
             "guard_step_ms_off": round(ms_off, 3),
             "guard_step_ms_on": round(ms_on, 3),
             "guard_overhead_frac": round(ms_on / ms_off - 1.0, 4),
@@ -1185,6 +1206,213 @@ def resilience_main():
         import traceback
         traceback.print_exc(file=sys.stderr)
         result["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(result), flush=True)
+
+
+def elastic_chaos_main():
+    """Elastic topology-shift drill (`--elastic-chaos`): train on a
+    forced 8-device virtual CPU mesh, take a mesh-shrink SIGTERM
+    mid-run, restart the SAME job on a 4-device sub-mesh (with the
+    newest checkpoint's data corrupted, forcing the one-step fallback),
+    then grow back to 8 devices (with the first restore chunk budget
+    "OOMing", forcing the halve-and-replan path) — and gate the whole
+    cycle on BITWISE loss-stream parity with an uninterrupted 8-device
+    run.
+
+    Why cross-mesh bitwise parity is even possible: state is STORED
+    sharded over whatever mesh is alive, but each step gathers it and
+    runs ONE fixed single-device program — the op schedule and reduction
+    order never depend on the mesh size (GSPMD re-partitions "replicated"
+    compute differently per device count, so constraining inside one
+    jitted program is NOT enough); the manifest data cursor +
+    deterministic loader pin the batch stream.  Restores route
+    through the reshard substrate (easydist_tpu/reshard/): each leaf
+    moves saved-sharding -> template-sharding as a chunked plan whose
+    peak live bytes stay under the RESHARD001 bound — never the global
+    array — and the landed shardings are audited by RESHARD002.
+    Every scheduled fault must fire (faultinject.unfired() empty), and
+    the fault-plan records land in the PerfDB.
+    """
+    result = {"metric": "elastic_shift_bitwise", "value": 0.0,
+              "unit": "bool"}
+    try:
+        os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+            " --xla_force_host_platform_device_count=8"
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import tempfile
+
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from easydist_tpu.resilience import faultinject
+        from easydist_tpu.resilience.preempt import PreemptedError
+        from easydist_tpu.runtime import run_training
+        from easydist_tpu.runtime.checkpoint import last_restore_report
+
+        devices = jax.devices()
+        if len(devices) < 8:
+            raise RuntimeError(
+                f"need 8 virtual devices, got {len(devices)}")
+
+        # ONE compiled single-device program shared by every mesh size:
+        # its op schedule (and so its rounding) is fixed, which is what
+        # makes the cross-mesh loss stream bitwise-comparable
+        @jax.jit
+        def _math(w, xb, yb):
+            loss, g = jax.value_and_grad(
+                lambda v: jnp.mean((xb @ v - yb) ** 2))(w)
+            return w - 0.1 * g, loss
+
+        def setup(devs):
+            mesh = Mesh(np.asarray(devs), ("dp",))
+            store = NamedSharding(mesh, P(None, "dp"))
+
+            def init_w():
+                return jax.device_put(jnp.zeros((16, 8), jnp.float32),
+                                      store)
+
+            def step(w, xb, yb):
+                # sharded STORE, fixed single-device COMPUTE: gather,
+                # run the shared program, scatter back onto the mesh
+                w1, loss = _math(jnp.asarray(jax.device_get(w)), xb, yb)
+                return jax.device_put(w1, store), loss
+
+            return init_w, step
+
+        class Loader:
+            def __init__(self):
+                self.batches_consumed = 0
+
+            def skip(self, n):
+                self.batches_consumed += n
+
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                i = self.batches_consumed
+                self.batches_consumed += 1
+                kx, ky = jax.random.split(jax.random.PRNGKey(i))
+                return (jax.random.normal(kx, (32, 16)),
+                        jax.random.normal(ky, (32, 8)))
+
+        TOTAL = 8
+
+        def run(ckpt_dir, devs, total_steps, losses):
+            init_w, step = setup(devs)
+
+            def on_step(s, loss):
+                losses[s] = np.asarray(jax.device_get(loss)).tobytes()
+
+            return run_training(step, init_w, Loader(), ckpt_dir,
+                                total_steps=total_steps,
+                                checkpoint_every=2, on_step=on_step)
+
+        # the uninterrupted 8-device reference: loss stream + final bits
+        base_losses = {}
+        with tempfile.TemporaryDirectory() as d:
+            ref = np.asarray(jax.device_get(
+                run(d, devices, TOTAL, base_losses))).tobytes()
+
+        db = None
+        unfired_total = 0
+        reports = {}
+        a_losses, b_losses, c_losses = {}, {}, {}
+        with tempfile.TemporaryDirectory() as d:
+            # leg A (8 devices): the slice shrinks at step 3 — grace
+            # checkpoint, PreemptedError out of the loop
+            with faultinject.fault_plan("elastic.mesh.shrink@4"):
+                preempted = False
+                try:
+                    run(d, devices, TOTAL, a_losses)
+                except PreemptedError as e:
+                    preempted = True
+                    log(f"# leg A: shrink notice at step {e.step}, grace "
+                        f"checkpoint {e.checkpoint_s * 1e3:.0f}ms")
+                unfired_total += len(faultinject.unfired())
+                db = faultinject.export_stats(db=db,
+                                              sub_key="elastic_chaos")
+
+            # leg B (restart on a 4-device sub-mesh): the newest
+            # checkpoint's data is corrupt — restore falls back one
+            # committed step, then reshards every leaf 8-dev -> 4-dev
+            # through the chunk planner (steps it replays must reproduce
+            # the reference losses bitwise)
+            with faultinject.fault_plan("elastic.restore.chunk_corrupt@1"):
+                run(d, devices[:4], 5, b_losses)
+                unfired_total += len(faultinject.unfired())
+                db = faultinject.export_stats(db=db,
+                                              sub_key="elastic_chaos")
+            reports["shrink_8_to_4"] = dict(last_restore_report() or {})
+
+            # leg C (grow back to 8 devices): the first restore chunk
+            # budget "OOMs" — halve chunk_bytes, replan, land
+            with faultinject.fault_plan("elastic.restore.oom@1"):
+                final = run(d, devices, TOTAL, c_losses)
+                unfired_total += len(faultinject.unfired())
+                db = faultinject.export_stats(db=db,
+                                              sub_key="elastic_chaos")
+            reports["grow_4_to_8"] = dict(last_restore_report() or {})
+            if db is not None:
+                try:
+                    db.persist()
+                except Exception:
+                    pass
+            final_bitwise = np.asarray(
+                jax.device_get(final)).tobytes() == ref
+
+        # every loss any leg computed — including the steps leg B
+        # REPLAYED after the corrupt-checkpoint fallback — must match
+        # the uninterrupted reference bitwise
+        mismatches = [
+            (leg, s) for leg, losses in
+            (("A", a_losses), ("B", b_losses), ("C", c_losses))
+            for s, bits in losses.items() if bits != base_losses.get(s)]
+        replayed = sorted(s for s in b_losses if s in a_losses)
+        loss_bitwise = not mismatches
+
+        shifts_seen = sum(bool(r.get("topology_shift"))
+                          for r in reports.values())
+        peak_ok = all(
+            0 < r.get("peak_live_bytes", 0) <= r.get("chunked_bound", 0)
+            for r in reports.values())
+        findings = sum(int(r.get("reshard_findings", 0))
+                       for r in reports.values())
+
+        ok = bool(final_bitwise and loss_bitwise and preempted
+                  and unfired_total == 0 and shifts_seen == 2
+                  and peak_ok and findings == 0 and replayed)
+        result.update({
+            "value": float(ok),
+            "final_state_bitwise": final_bitwise,
+            "loss_stream_bitwise": loss_bitwise,
+            "loss_mismatches": [[leg, int(s)] for leg, s in mismatches],
+            "steps_replayed_after_fallback": [int(s) for s in replayed],
+            "shrink_notice_preempted": preempted,
+            "fault_plan_unfired": int(unfired_total),
+            "topology_shifts_detected": int(shifts_seen),
+            "restore_peak_within_bound": peak_ok,
+            "reshard_findings": int(findings),
+            "restores": reports,
+            "mesh_cycle": [8, 4, 8],
+            "n_chips": 8,
+            "device": "host cpu (virtual 8-device mesh)",
+        })
+        log(f"# elastic chaos pass={ok}: final_bitwise={final_bitwise} "
+            f"loss_bitwise={loss_bitwise} shifts={shifts_seen} "
+            f"replayed={replayed} unfired={unfired_total} "
+            f"findings={findings}")
+    except Exception as e:  # always land the JSON line
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        result["error"] = f"{type(e).__name__}: {e}"
+    _annotate_vs_last_good(result)
+    _maybe_update_last_good(result)
     print(json.dumps(result), flush=True)
 
 
@@ -2161,6 +2389,8 @@ if __name__ == "__main__":
         prefill_main()
     elif "--fleet-chaos" in sys.argv:
         fleet_chaos_main()
+    elif "--elastic-chaos" in sys.argv:
+        elastic_chaos_main()
     elif "--speculate" in sys.argv:
         speculate_main()
     elif "--fleet" in sys.argv:

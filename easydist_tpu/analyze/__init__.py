@@ -48,7 +48,14 @@ A rule-based analyzer that runs after solving and before execution
            sha256 manifest, FLEET004 dispatch to a DEAD replica,
            FLEET005 resume descriptors that would break bitwise
            recovery, FLEET003 orphaned pinned trie pages left
-           behind by a drain.
+           behind by a drain;
+  layer 8  redistribution auditor (`audit_reshard_plan`,
+           `audit_restored_state`) — RESHARD001 a chunked
+           redistribution plan whose peak live bytes exceed the
+           O(max(src_shard, dst_shard) + chunk) bound (silent
+           degeneration to global materialization — the elastic-restore
+           OOM), RESHARD002 a restored leaf whose sharding disagrees
+           with the restore template's spec.
 
 Surfaced via `CompiledFunction.analyze()`, `bench.py --analyze`, and the
 dryrun gate; findings export through the runtime PerfDB under
@@ -72,6 +79,7 @@ from .memory_rules import (audit_remat_plan, check_hbm_budget,
                            resolve_hbm_budget, verify_memory_plan)
 from .overlap_rules import (lint_overlap_fn, lint_overlap_jaxpr,
                             lint_overlap_plan)
+from .reshard_rules import audit_reshard_plan, audit_restored_state
 from .resilience_rules import (audit_checkpoint_root, audit_guard_parity,
                                guard_off_jaxpr)
 from .schedule_rules import (gpipe_schedule_tables, schedule_stats,
@@ -102,6 +110,8 @@ __all__ = [
     "check_fleet_routing", "check_page_handoff", "check_fleet_drain",
     "check_resume_descriptor",
     "audit_page_table", "check_page_table",
+    "audit_reshard_plan", "audit_restored_state",
+    "check_reshard_plan", "check_restored_state",
 ]
 
 
@@ -288,6 +298,40 @@ def check_fleet_drain(session, node: str = "drain"):
     (orphaned pinned pages / trie bookkeeping drift on a drained
     session) — warning severity, logs and returns the findings."""
     findings = audit_drained_session(session, node=node)
+    for f in findings:
+        logger.warning("[analyze] %s", f)
+    return findings
+
+
+def check_reshard_plan(plan, node: str = "reshard"):
+    """Plan-time self-check hook for `easydist_tpu.reshard`: RESHARD001
+    (peak live bytes over the chunked bound) raises under
+    `analyze_raise` BEFORE any byte moves — a degenerate plan at model
+    scale is the restore OOM, so it must fail at planning, not on the
+    device.  Returns the findings."""
+    from easydist_tpu import config as edconfig
+
+    findings = audit_reshard_plan(plan, node=node)
+    report = AnalysisReport(findings)
+    if report.errors() and edconfig.analyze_raise:
+        report.raise_on_errors()
+    for f in findings:
+        logger.warning("[analyze] %s", f)
+    return findings
+
+
+def check_restored_state(restored, template, node: str = "restore"):
+    """Post-restore self-check hook for `runtime.checkpoint`: RESHARD002
+    (a restored leaf's sharding disagrees with the template spec) raises
+    under `analyze_raise` — training on a silently re-laid-out state
+    works but pays n_devices x memory and a re-shard collective every
+    step.  Returns the findings."""
+    from easydist_tpu import config as edconfig
+
+    findings = audit_restored_state(restored, template, node=node)
+    report = AnalysisReport(findings)
+    if report.errors() and edconfig.analyze_raise:
+        report.raise_on_errors()
     for f in findings:
         logger.warning("[analyze] %s", f)
     return findings

@@ -166,6 +166,20 @@ RULES: Dict[str, tuple] = {
                  "request (resubmitted prefix != prompt + emitted ids, "
                  "budget overrun, or eos already emitted) — recovery "
                  "would silently change output tokens"),
+    # ---- layer 8: redistribution auditor (reshard plans + restored
+    #      shardings, analyze/reshard_rules.py)
+    "RESHARD001": (SEV_ERROR,
+                   "redistribution plan peak live bytes exceed the "
+                   "chunked bound O(max(src_shard, dst_shard) + chunk) — "
+                   "the plan silently degenerated toward global "
+                   "materialization, the replicated-restore OOM hazard "
+                   "the reshard substrate exists to remove"),
+    "RESHARD002": (SEV_ERROR,
+                   "restored leaf sharding disagrees with the template "
+                   "spec — the caller's jit will silently re-lay-out "
+                   "(or OOM re-gathering) every step, and a replicated "
+                   "leaf that should be sharded holds n_devices x its "
+                   "byte budget"),
 }
 
 

@@ -1,0 +1,95 @@
+"""Layer 8: redistribution auditor — chunked-plan byte bounds and
+restored-sharding agreement (`easydist_tpu.reshard`).
+
+The reshard substrate's whole contract is "never the global array":
+every plan promises peak live bytes of O(max(src_shard, dst_shard) +
+chunk).  These rules make that promise checkable BEFORE bytes move and
+verifiable AFTER a restore lands:
+
+  RESHARD001 (error)  a plan's `peak_live_bytes()` exceeds its
+                      `chunked_bound()`.  The usual causes: a chunk
+                      ceiling silently ignored (one ChunkOp staging the
+                      whole array), or a planner change that regressed
+                      to replicate-then-slice.  Peak bytes at real model
+                      scale IS the OOM that kills an elastic restart.
+  RESHARD002 (error)  a restored leaf's sharding disagrees with the
+                      restore template's spec.  The caller's jit owns
+                      the layout; a leaf that came back replicated (or
+                      on the wrong axis) costs n_devices x its byte
+                      budget and a re-layout collective on every step —
+                      bitwise-invisible, so only an audit catches it.
+
+Both audit plain data (a `ReshardPlan`, a pair of pytrees), so goldens
+are cheap fixtures, not compiled programs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from .findings import Finding, make_finding
+
+__all__ = ["audit_reshard_plan", "audit_restored_state"]
+
+
+def audit_reshard_plan(plan, node: str = "reshard") -> List[Finding]:
+    """RESHARD001 over one redistribution plan (reshard.plan.ReshardPlan
+    or anything exposing peak_live_bytes()/chunked_bound())."""
+    findings: List[Finding] = []
+    peak = int(plan.peak_live_bytes())
+    bound = int(plan.chunked_bound())
+    if peak > bound:
+        n_chunks = len(getattr(plan, "chunks", ()) or ())
+        findings.append(make_finding(
+            "RESHARD001", node,
+            f"plan peak live bytes {peak} exceed the chunked bound "
+            f"{bound} (src_shard={getattr(plan, 'src_shard_bytes', '?')}, "
+            f"dst_shard={getattr(plan, 'dst_shard_bytes', '?')}, "
+            f"chunk_limit={getattr(plan, 'chunk_limit_bytes', '?')}, "
+            f"{n_chunks} chunk(s)) — the plan degenerated toward global "
+            f"materialization"))
+    return findings
+
+
+def _sharding_equal(got, want, ndim: int) -> bool:
+    if got is None or want is None:
+        return got is want
+    eq = getattr(want, "is_equivalent_to", None)
+    if eq is not None:
+        try:
+            return bool(eq(got, ndim))
+        except Exception:
+            pass
+    return got == want
+
+
+def audit_restored_state(restored: Any, template: Any,
+                         node: str = "restore") -> List[Finding]:
+    """RESHARD002: every leaf whose template carried an explicit
+    multi-device sharding must have come back on exactly that sharding.
+    Template leaves without one (host arrays, ShapeDtypeStructs with no
+    sharding) are unconstrained — the restore planner chose for them."""
+    import jax
+
+    findings: List[Finding] = []
+    got_leaves, got_def = jax.tree_util.tree_flatten(restored)
+    want_leaves, want_def = jax.tree_util.tree_flatten(template)
+    if got_def != want_def:
+        findings.append(make_finding(
+            "RESHARD002", node,
+            f"restored tree structure {got_def} differs from the "
+            f"template {want_def}"))
+        return findings
+    for i, (got, want) in enumerate(zip(got_leaves, want_leaves)):
+        want_sh = getattr(want, "sharding", None)
+        if want_sh is None or getattr(want_sh, "num_devices", 1) <= 1:
+            continue
+        got_sh = getattr(got, "sharding", None)
+        ndim = len(getattr(want, "shape", ()) or ())
+        if not _sharding_equal(got_sh, want_sh, ndim):
+            findings.append(make_finding(
+                "RESHARD002", f"{node}.leaf[{i}]",
+                f"restored sharding {got_sh} disagrees with the template "
+                f"spec {want_sh} — the leaf will be re-laid-out (or held "
+                f"replicated) on every step"))
+    return findings

@@ -169,6 +169,38 @@ def resharding_cost(var_bytes: float, up: Placement, down: Placement,
     return cost
 
 
+def collective_wire_bytes(kind: str, var_bytes: float, n: int) -> float:
+    """Wire bytes of one collective family over `n` participants — the
+    closed forms above, keyed by the kind labels a `reshard` plan's
+    ChunkOps carry.  "local"/"slice" move nothing; unknown kinds price
+    as a full point-to-point copy (pessimistic, never free)."""
+    if n <= 1 or kind in ("local", "slice"):
+        return 0.0
+    if kind == "all_gather":
+        return _all_gather(var_bytes, n)
+    if kind == "all_reduce":
+        return _all_reduce(var_bytes, n)
+    if kind == "reduce_scatter":
+        return _reduce_scatter(var_bytes, n)
+    if kind == "all_to_all":
+        return _all_to_all(var_bytes, n)
+    return var_bytes
+
+
+def redistribution_cost(wire_bytes: float, n_chunks: int,
+                        axis: MeshAxisSpec) -> float:
+    """Alpha-beta seconds of a chunked redistribution plan along `axis`:
+    every chunk that moves bytes pays one collective launch latency on
+    top of its share of the wire time (the same model `resharding_cost`
+    applies to solver edges — a reshard plan is just N of those edges,
+    so the solver and the elastic path price redistribution with one
+    vocabulary)."""
+    if wire_bytes <= 0.0:
+        return 0.0
+    return (max(1, n_chunks) * axis.resolved_latency()
+            + wire_bytes / axis.resolved_bandwidth())
+
+
 def placement_bytes(var_bytes: float, p: Placement, axis_size: int) -> float:
     """Per-device bytes held for a tensor under placement `p`."""
     if p is not None and p.is_shard():

@@ -253,6 +253,14 @@ prefill_attention_backend = os.environ.get("EASYDIST_PREFILL_ATTENTION",
 speculate_k = _env_int("EASYDIST_SPECULATE_K", 0)
 speculate_drafter = os.environ.get("EASYDIST_SPECULATE_DRAFTER", "ngram")
 
+# ---------------- reshard (easydist_tpu.reshard) ----------------
+# chunk ceiling (bytes) for redistribution plans: the "+ chunk" term of
+# the RESHARD001 peak-live-bytes bound.  Each plan step stages at most
+# this much on top of one src shard + one dst shard; smaller chunks cap
+# transient memory at the price of more collective launches (the
+# elastic.restore.oom recovery path halves this and re-plans).
+reshard_chunk_bytes = _env_int("EASYDIST_RESHARD_CHUNK_BYTES", 64 * 2**20)
+
 # ---------------- resilience (easydist_tpu.resilience) ----------------
 # deterministic fault schedule, e.g. "step.nan_grad@7,ckpt.write.partial@2"
 # — names must come from resilience.faultinject.FAULT_POINTS (validated at

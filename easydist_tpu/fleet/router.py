@@ -99,6 +99,10 @@ class FleetConfig:
     handoff_retries / handoff_backoff_ms / handoff_deadline_ms: transport
         send_pages retry policy for prefill handoff and drain migration
         (deadline None = retries alone bound the attempt count).
+    migrate_wave_bytes: byte ceiling per drain-migration WAVE — hot-page
+        paths are batched by the reshard chunk planner (reshard.chunk_waves)
+        so in-flight migration bytes stay bounded regardless of how much
+        warm trie a draining replica holds (0 = one unbounded wave).
     """
     affinity_weight: float = 2.0
     occupancy_weight: float = 1.0
@@ -113,6 +117,7 @@ class FleetConfig:
     handoff_retries: int = 2
     handoff_backoff_ms: float = 5.0
     handoff_deadline_ms: Optional[float] = None
+    migrate_wave_bytes: int = 8 << 20
 
     def __post_init__(self):
         if self.policy not in ("affinity", "random"):
@@ -127,6 +132,8 @@ class FleetConfig:
                 f"{self.quarantine_after}")
         if self.handoff_retries < 0:
             raise ValueError("handoff_retries must be >= 0")
+        if self.migrate_wave_bytes < 0:
+            raise ValueError("migrate_wave_bytes must be >= 0")
 
 
 @dataclass
@@ -684,26 +691,31 @@ class FleetRouter:
         cfg = self.config
         migrated = 0
         for bucket, paths in pages.items():
-            for path in paths:
-                for dst in survivors:
-                    # manifest-verified + retried like any other handoff
-                    # (FLEET002); migration is best-effort — a path that
-                    # fails permanently is dropped (survivors recompute
-                    # the prefix on demand), never half-committed
-                    try:
-                        migrated += self.transport.send_pages(
-                            path, dst.session, None, bucket=bucket,
-                            src=rep.replica_id, dst=dst.replica_id,
-                            deadline_s=(cfg.handoff_deadline_ms / 1e3
-                                        if cfg.handoff_deadline_ms
-                                        is not None else None),
-                            retries=cfg.handoff_retries,
-                            backoff_s=cfg.handoff_backoff_ms / 1e3)
-                    except TransportError as e:
-                        logger.warning(
-                            "drain migration %s->%s dropped a path: %s",
-                            rep.replica_id, dst.replica_id, e)
-                        self.metrics.inc("pages_migration_failed")
+            for dst in survivors:
+                # wave-batched through the shared reshard chunk planner:
+                # in-flight bytes stay under migrate_wave_bytes however
+                # warm the draining trie is.  Each path is still a
+                # manifest-verified + retried handoff (FLEET002);
+                # migration is best-effort — a path that fails
+                # permanently is dropped (survivors recompute the prefix
+                # on demand), never half-committed
+                def _drop(i, e, _dst=dst):
+                    logger.warning(
+                        "drain migration %s->%s dropped a path: %s",
+                        rep.replica_id, _dst.replica_id, e)
+                    self.metrics.inc("pages_migration_failed")
+
+                res = self.transport.send_paths_chunked(
+                    paths, dst.session, bucket=bucket,
+                    max_wave_bytes=cfg.migrate_wave_bytes,
+                    on_drop=_drop,
+                    src=rep.replica_id, dst=dst.replica_id,
+                    deadline_s=(cfg.handoff_deadline_ms / 1e3
+                                if cfg.handoff_deadline_ms
+                                is not None else None),
+                    retries=cfg.handoff_retries,
+                    backoff_s=cfg.handoff_backoff_ms / 1e3)
+                migrated += res["chunks"]
         self._audit_drain(rep)
         del self._replicas[rep.replica_id]
         self.metrics.inc("drains_completed")

@@ -68,6 +68,18 @@ class PageCorruptError(TransportError):
     the source still holds pristine pages."""
 
 
+def path_nbytes(path: Sequence[Page]) -> int:
+    """Payload bytes of one chunk path (K/V arrays only; token ids are
+    noise at page granularity).  `.nbytes` is metadata on both numpy and
+    device arrays — sizing a path never forces a host copy."""
+    total = 0
+    for _tokens, kv in path:
+        for arr in kv.values():
+            nb = getattr(arr, "nbytes", None)
+            total += int(nb if nb is not None else np.asarray(arr).nbytes)
+    return total
+
+
 def manifest_key(manifest: Dict[str, object]) -> str:
     """Stable identity of one transfer's CONTENT: sha256 over the ordered
     page digests.  Two attempts shipping the same pages share a key, so
@@ -206,6 +218,57 @@ class KVTransport:
                     "retrying in %.1fms", src, dst, attempt, e,
                     delay * 1e3)
                 sleep(delay)
+
+    def send_paths_chunked(self, paths: Sequence[Sequence[Page]],
+                           dst_session, *, bucket: Optional[int] = None,
+                           max_wave_bytes: Optional[int] = None,
+                           on_drop=None, src: str = "?", dst: str = "?",
+                           **send_kw) -> Dict[str, int]:
+        """Migrate many chunk paths in byte-bounded WAVES.
+
+        Drain migration ships a draining replica's whole hot working set;
+        unbatched, its in-flight bytes scale with trie warmth.  This
+        reuses the reshard planner's wave batcher (`reshard.chunk_waves`
+        — the same greedy prefix grouping that bounds redistribution
+        chunks) to cap the bytes entering `send_pages` per wave at
+        `max_wave_bytes` (falls back to `edconfig.reshard_chunk_bytes`;
+        a single path over the cap ships alone — paths are indivisible,
+        ancestors must land with descendants).
+
+        Per-path semantics are unchanged: each path still goes through
+        `send_pages` (manifest verify, retry, idempotent commit), and a
+        path that fails permanently is reported via `on_drop(i, error)`
+        and skipped — best-effort drain, never half-committed.  Returns
+        {"chunks", "paths_sent", "paths_dropped", "waves", "bytes"}.
+        """
+        from easydist_tpu.reshard import chunk_waves
+
+        paths = list(paths)
+        if max_wave_bytes is None:
+            from easydist_tpu import config as edconfig
+
+            max_wave_bytes = edconfig.reshard_chunk_bytes
+        sizes = [path_nbytes(p) for p in paths]
+        out = {"chunks": 0, "paths_sent": 0, "paths_dropped": 0,
+               "waves": 0, "bytes": 0}
+        for lo, hi in chunk_waves(sizes, max_wave_bytes):
+            out["waves"] += 1
+            for i in range(lo, hi):
+                try:
+                    out["chunks"] += self.send_pages(
+                        paths[i], dst_session, None, bucket=bucket,
+                        src=src, dst=dst, **send_kw)
+                    out["paths_sent"] += 1
+                    out["bytes"] += sizes[i]
+                except TransportError as e:
+                    out["paths_dropped"] += 1
+                    if on_drop is not None:
+                        on_drop(i, e)
+                    else:
+                        logger.warning(
+                            "chunked migration %s->%s dropped path %d: "
+                            "%s", src, dst, i, e)
+        return out
 
 
 class InProcessTransport(KVTransport):

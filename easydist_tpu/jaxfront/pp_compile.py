@@ -414,8 +414,13 @@ class PPCompiledFunction:
                 "state already holds logical leaves")
         packed, shared = state[0]
         # host gather first: the packed buffer is sharded pp x siblings,
-        # and the slicing below is host-side bookkeeping, not device work
-        packed = jax.device_get(packed)
+        # and the slicing below is host-side bookkeeping, not device work.
+        # Chunked fetch (reshard/) so the host never stages more than one
+        # shard + one chunk beyond the output buffer — at real model scale
+        # the packed transport buffer is the largest live array there is.
+        from easydist_tpu import reshard
+
+        packed = reshard.fetch_chunked(packed)
         shared = tuple(jax.device_get(s) for s in shared)
         diff_leaves = unpack((jnp.asarray(packed),
                               tuple(jnp.asarray(s) for s in shared)))

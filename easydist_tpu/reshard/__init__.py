@@ -1,0 +1,40 @@
+"""Portable chunked array redistribution (arXiv:2112.01075).
+
+One substrate for every reshard path in the system: elastic checkpoint
+restore onto a shrunk/grown mesh (`reshard.restore` + runtime/
+checkpoint.py), pp `export_state_dict` host gathers (`fetch_chunked`),
+fleet hot-page drain migration (`chunk_waves` via fleet/transport.py),
+and live-array moves between arbitrary (mesh, spec) pairs
+(`redistribute`).  Plans are priced through `autoflow/cost_model` and
+audited by the analyze layer (RESHARD001/RESHARD002) against the
+O(max(src_shard, dst_shard) + chunk) peak-live-bytes contract.
+"""
+
+from .plan import (  # noqa: F401
+    HOST,
+    ChunkOp,
+    MeshDesc,
+    ReshardPlan,
+    chunk_spans,
+    chunk_waves,
+    device_windows,
+    normalize_spec,
+    plan_redistribute,
+    sharding_desc,
+    state_fingerprint,
+    topology_shifted,
+)
+from .exec import (  # noqa: F401
+    ReshardOOMError,
+    fetch_chunked,
+    redistribute,
+)
+from .restore import RestorePlan, plan_restore  # noqa: F401
+
+__all__ = [
+    "HOST", "ChunkOp", "MeshDesc", "ReshardPlan", "RestorePlan",
+    "ReshardOOMError", "chunk_spans", "chunk_waves", "device_windows",
+    "fetch_chunked", "normalize_spec", "plan_redistribute",
+    "plan_restore", "redistribute", "sharding_desc", "state_fingerprint",
+    "topology_shifted",
+]

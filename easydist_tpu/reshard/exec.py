@@ -1,0 +1,231 @@
+"""Execute a `ReshardPlan` on live arrays.
+
+Two lowering strategies, picked by what the device sets allow:
+
+  * **collective path** — src and dst shardings enumerate the SAME
+    device list: each ChunkOp becomes the jit program its `kind` names
+    (dynamic_slice replicated over the dst mesh = slice + all-gather of
+    ONE chunk; dynamic_update_slice into the donated dst buffer lands
+    it).  Per-device live bytes are src_shard + dst_shard + chunk —
+    exactly `plan.peak_live_bytes()`.
+
+  * **staged path** — device sets differ (elastic shrink/grow, where
+    half the source mesh is gone or the target has fresh devices): each
+    dst shard is assembled on host from the source's addressable shards
+    in chunk-bounded copies and `device_put` one shard at a time, then
+    stitched with `make_array_from_single_device_arrays`.  Host live
+    bytes are one dst shard + one chunk; the global array never exists
+    anywhere.
+
+`fetch_chunked` is the export-path variant (device -> host numpy) the pp
+`export_state_dict` re-packing rides: per-shard chunked reads instead of
+one global `device_get`.
+
+Every entry point audits its plan through the analyze layer
+(RESHARD001: peak live bytes must stay under the chunked bound) before
+moving a byte.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import numpy as np
+
+from . import plan as planlib
+
+logger = logging.getLogger(__name__)
+
+
+class ReshardOOMError(RuntimeError):
+    """A chunked transfer step exceeded its memory budget (or the
+    `elastic.restore.oom` fault point said it did); recoverable by
+    re-planning with a smaller chunk."""
+
+
+def _audit(rplan: planlib.ReshardPlan, node: str) -> None:
+    try:
+        from easydist_tpu.analyze import check_reshard_plan
+    except ImportError:  # analyze is an optional layer at runtime
+        return
+    check_reshard_plan(rplan, node=node)
+
+
+def _desc_of(sharding, ndim: int):
+    mesh_desc, spec = planlib.sharding_desc(sharding, ndim)
+    if mesh_desc is None:
+        mesh_desc = planlib.MeshDesc(("rep",), (1,))
+    return mesh_desc, spec
+
+
+def _device_list(sharding):
+    import jax
+
+    mesh = getattr(sharding, "mesh", None)
+    if mesh is not None:
+        return list(mesh.devices.flat)
+    try:
+        return list(sharding._device_assignment)
+    except Exception:
+        return list(jax.devices())
+
+
+def _norm_windows(indices_map, shape):
+    """devices_indices_map slices -> {device: Window} with concrete
+    bounds."""
+    out = {}
+    for dev, idx in indices_map.items():
+        win = []
+        for sl, dim in zip(idx, shape):
+            lo, hi, _ = sl.indices(dim)
+            win.append((lo, hi))
+        out[dev] = tuple(win)
+    return out
+
+
+def redistribute(x, dst_sharding, *, chunk_bytes: Optional[int] = None,
+                 rplan: Optional[planlib.ReshardPlan] = None,
+                 node: str = "redistribute"):
+    """Move `x` to `dst_sharding` as a composed chunked program planned
+    by `plan_redistribute` (or the caller-supplied `rplan`).  Returns an
+    array committed to exactly `dst_sharding`; never materializes the
+    global array on any device."""
+    import jax
+
+    src_sharding = getattr(x, "sharding", None)
+    if src_sharding is not None and dst_sharding is not None:
+        eq = getattr(src_sharding, "is_equivalent_to", None)
+        try:
+            if eq is not None and eq(dst_sharding, x.ndim):
+                return x  # already there: the zero-cost fast path
+        except Exception:
+            pass
+    if rplan is None:
+        src_desc = _desc_of(src_sharding, x.ndim)
+        dst_desc = _desc_of(dst_sharding, x.ndim)
+        rplan = planlib.plan_redistribute(
+            x.shape, x.dtype, src_desc, dst_desc, chunk_bytes=chunk_bytes)
+    _audit(rplan, node)
+
+    src_devs = _device_list(src_sharding) if src_sharding is not None else []
+    dst_devs = _device_list(dst_sharding)
+    if src_devs == dst_devs and len(dst_devs) > 0:
+        return _exec_collective(x, dst_sharding, rplan)
+    return _exec_staged(x, dst_sharding, rplan)
+
+
+def _exec_collective(x, dst_sharding, rplan: planlib.ReshardPlan):
+    """Same-device-set lowering: per chunk, a replicated dynamic_slice
+    (GSPMD emits slice + all-gather of just the chunk) then a donated
+    dynamic_update_slice into the dst-sharded output buffer."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = dst_sharding.mesh
+    rep = NamedSharding(mesh, PartitionSpec())
+    shape, dtype = x.shape, x.dtype
+
+    out = jax.jit(lambda: jnp.zeros(shape, dtype),
+                  out_shardings=dst_sharding)()
+
+    # two static chunk geometries at most (uniform spans + a ragged
+    # tail), so the jit cache stays warm across the loop
+    def slice_fn(a, starts, sizes):
+        return lax.dynamic_slice(a, starts, sizes)
+
+    slice_jit = jax.jit(slice_fn, static_argnames=("sizes",),
+                        out_shardings=rep)
+    update_jit = jax.jit(
+        lambda o, c, starts: lax.dynamic_update_slice(o, c, starts),
+        out_shardings=dst_sharding, donate_argnums=0)
+
+    for op in rplan.chunks:
+        starts = tuple(jnp.asarray(lo, jnp.int32) for lo, _hi in op.window)
+        sizes = tuple(hi - lo for lo, hi in op.window)
+        if not starts:  # scalar
+            return jax.device_put(x, dst_sharding)
+        chunk = slice_jit(x, starts, sizes)
+        out = update_jit(out, chunk, starts)
+    return out
+
+
+def _exec_staged(x, dst_sharding, rplan: planlib.ReshardPlan):
+    """Cross-device-set lowering: build each dst shard on host from the
+    src's addressable shards, one shard and one chunk-bounded copy at a
+    time, then stitch the sharded array without a global buffer."""
+    import jax
+
+    shape = tuple(x.shape)
+    dtype = np.dtype(x.dtype)
+    src_shards = [(tuple((sl.indices(d)[0], sl.indices(d)[1])
+                         for sl, d in zip(s.index, shape)),
+                   s.data) for s in x.addressable_shards]
+    dst_map = _norm_windows(
+        dst_sharding.devices_indices_map(shape), shape)
+
+    bufs = []
+    for dev, dwin in dst_map.items():
+        buf = np.empty([hi - lo for lo, hi in dwin], dtype)
+        for op in rplan.chunks:
+            region = planlib.intersect(dwin, op.window) if shape else dwin
+            if shape and region is None:
+                continue
+            for swin, sdata in src_shards:
+                ov = planlib.intersect(swin, region) if shape else swin
+                if shape and ov is None:
+                    continue
+                # replicas overwrite with identical values — harmless
+                dst_idx = tuple(slice(lo - dlo, hi - dlo) for (lo, hi),
+                                (dlo, _dhi) in zip(ov, dwin))
+                src_idx = tuple(slice(lo - slo, hi - slo) for (lo, hi),
+                                (slo, _shi) in zip(ov, swin))
+                buf[dst_idx] = np.asarray(sdata)[src_idx]
+        bufs.append(jax.device_put(buf, dev))
+    return jax.make_array_from_single_device_arrays(
+        shape, dst_sharding, bufs)
+
+
+def fetch_chunked(x, chunk_bytes: Optional[int] = None,
+                  node: str = "fetch") -> np.ndarray:
+    """Device -> host gather in chunk-bounded per-shard reads (the
+    export-path replacement for a global `jax.device_get`).  The full
+    host buffer is the POINT of an export; what the plan bounds is the
+    staging: no read moves more than one chunk, no device ever holds
+    more than its shard."""
+    src_sharding = getattr(x, "sharding", None)
+    src_desc = _desc_of(src_sharding, getattr(x, "ndim", 0))
+    rplan = planlib.plan_redistribute(
+        x.shape, x.dtype, src_desc, (planlib.HOST, ()),
+        chunk_bytes=chunk_bytes)
+    _audit(rplan, node)
+
+    shape = tuple(x.shape)
+    dtype = np.dtype(x.dtype)
+    out = np.empty(shape, dtype)
+    if not shape:
+        return np.asarray(x)
+    shards = getattr(x, "addressable_shards", None)
+    if not shards:
+        return np.asarray(x)
+    seen = set()
+    for s in shards:
+        swin = tuple((sl.indices(d)[0], sl.indices(d)[1])
+                     for sl, d in zip(s.index, shape))
+        if swin in seen:
+            continue  # replica: identical bytes, skip the re-copy
+        seen.add(swin)
+        data = None
+        for op in rplan.chunks:
+            ov = planlib.intersect(swin, op.window)
+            if ov is None:
+                continue
+            if data is None:
+                data = np.asarray(s.data)  # one shard staged at a time
+            dst_idx = tuple(slice(lo, hi) for lo, hi in ov)
+            src_idx = tuple(slice(lo - slo, hi - slo) for (lo, hi),
+                            (slo, _shi) in zip(ov, swin))
+            out[dst_idx] = data[src_idx]
+    return out

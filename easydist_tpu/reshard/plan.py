@@ -1,0 +1,441 @@
+"""Host-side planning for portable array redistribution
+(arXiv:2112.01075, PAPERS.md): given a tensor living as (mesh, spec) at
+the source and wanted as (mesh, spec) at the destination, emit a
+composed program of CHUNKED collective steps — slice / all-gather /
+all-to-all / dynamic-update compositions — whose peak live bytes are
+bounded by O(max(src_shard, dst_shard) + chunk), never the global array.
+
+Everything in this module is pure numpy/python on *descriptions*: a
+`MeshDesc` is serializable and survives the mesh it describes (the whole
+point — an elastic restore plans src->dst where the SRC mesh no longer
+exists, reading its description from the checkpoint manifest's mesh
+fingerprint).  Execution lives in `reshard.exec`; checkpoint restore
+planning in `reshard.restore`; pricing goes through the same
+`autoflow/cost_model` alpha-beta collective forms the solver uses, so
+the solver and the elastic path reason about redistribution with one
+vocabulary (DistIR's deterministic-pricing principle, arXiv:2111.05426).
+
+The RESHARD001 analyze rule audits every plan against `chunked_bound()`:
+a plan whose `peak_live_bytes()` exceeds the bound silently degenerated
+to global materialization — exactly the replicated-restore OOM hazard
+this library exists to remove.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+FINGERPRINT_FORMAT = 1
+
+# spec entry per tensor dim: an axis name (sharded along it) or None
+Spec = Tuple[Optional[str], ...]
+# half-open index window, one (start, stop) per tensor dim
+Window = Tuple[Tuple[int, int], ...]
+
+
+@dataclass(frozen=True)
+class MeshDesc:
+    """A device mesh as data: axis names/sizes plus the device kinds it
+    was built over.  Serializable (`to_meta`/`from_meta`) so a checkpoint
+    manifest can carry the SAVE-time mesh and restore can plan against it
+    after the physical mesh is gone."""
+
+    axis_names: Tuple[str, ...]
+    axis_sizes: Tuple[int, ...]
+    device_kinds: Tuple[str, ...] = ()
+
+    def __post_init__(self):
+        if len(self.axis_names) != len(self.axis_sizes):
+            raise ValueError(
+                f"axis_names {self.axis_names} and axis_sizes "
+                f"{self.axis_sizes} differ in length")
+        if any(s < 1 for s in self.axis_sizes):
+            raise ValueError(f"axis sizes must be >= 1: {self.axis_sizes}")
+
+    @property
+    def n_devices(self) -> int:
+        return int(np.prod(self.axis_sizes)) if self.axis_sizes else 1
+
+    def axis_size(self, name: str) -> int:
+        return self.axis_sizes[self.axis_names.index(name)]
+
+    def to_meta(self) -> Dict[str, Any]:
+        return {"axes": list(self.axis_names),
+                "sizes": [int(s) for s in self.axis_sizes],
+                "device_kinds": list(self.device_kinds)}
+
+    @classmethod
+    def from_meta(cls, meta: Dict[str, Any]) -> "MeshDesc":
+        return cls(tuple(meta.get("axes", [])),
+                   tuple(int(s) for s in meta.get("sizes", [])),
+                   tuple(meta.get("device_kinds", [])))
+
+    @classmethod
+    def from_mesh(cls, mesh) -> "MeshDesc":
+        """From a live jax Mesh."""
+        kinds = tuple(sorted({getattr(d, "device_kind", "?")
+                              for d in mesh.devices.flat}))
+        return cls(tuple(mesh.axis_names),
+                   tuple(int(s) for s in mesh.devices.shape), kinds)
+
+
+# the destination of a host gather (export paths): one "device", the host
+HOST = MeshDesc(("host",), (1,), ("host",))
+
+
+def normalize_spec(spec: Sequence, ndim: int) -> Spec:
+    """PartitionSpec-ish -> canonical per-dim tuple of axis-name-or-None,
+    padded to `ndim`.  A multi-axis dim entry (tuple of names) is only
+    supported for length 1; longer entries degrade that dim to
+    replicated — the planner never guesses at block-cyclic layouts."""
+    out: List[Optional[str]] = []
+    for entry in tuple(spec)[:ndim]:
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, str):
+            out.append(entry)
+        elif isinstance(entry, (tuple, list)):
+            out.append(entry[0] if len(entry) == 1 else None)
+        else:
+            out.append(None)
+    out.extend([None] * (ndim - len(out)))
+    return tuple(out)
+
+
+def _dim_block(dim: int, parts: int) -> int:
+    return -(-dim // parts)  # ceil: jax pads the last shard on uneven dims
+
+
+def device_windows(shape: Sequence[int], mesh: MeshDesc,
+                   spec: Sequence) -> List[Window]:
+    """Per-device global index windows, in row-major device order over the
+    mesh axes (the order `Mesh(devices.reshape(sizes))` enumerates).
+    Devices along mesh axes a spec does not use hold replicas (identical
+    windows)."""
+    shape = tuple(int(s) for s in shape)
+    spec = normalize_spec(spec, len(shape))
+    for name in spec:
+        if name is not None and name not in mesh.axis_names:
+            raise ValueError(
+                f"spec axis {name!r} not in mesh axes {mesh.axis_names}")
+    windows: List[Window] = []
+    sizes = mesh.axis_sizes or (1,)
+    for linear in range(mesh.n_devices):
+        coords = np.unravel_index(linear, sizes) if mesh.axis_sizes else (0,)
+        win: List[Tuple[int, int]] = []
+        for d, dim in enumerate(shape):
+            name = spec[d]
+            if name is None:
+                win.append((0, dim))
+                continue
+            k = mesh.axis_names.index(name)
+            parts = mesh.axis_sizes[k]
+            block = _dim_block(dim, parts)
+            i = int(coords[k])
+            win.append((min(i * block, dim), min((i + 1) * block, dim)))
+        windows.append(tuple(win))
+    return windows
+
+
+def window_bytes(win: Window, itemsize: int) -> int:
+    n = itemsize
+    for lo, hi in win:
+        n *= max(0, hi - lo)
+    return n
+
+
+def max_shard_bytes(shape: Sequence[int], itemsize: int, mesh: MeshDesc,
+                    spec: Sequence) -> int:
+    wins = device_windows(shape, mesh, spec)
+    return max((window_bytes(w, itemsize) for w in wins), default=0)
+
+
+def intersect(a: Window, b: Window) -> Optional[Window]:
+    out = []
+    for (alo, ahi), (blo, bhi) in zip(a, b):
+        lo, hi = max(alo, blo), min(ahi, bhi)
+        if lo >= hi:
+            return None
+        out.append((lo, hi))
+    return tuple(out)
+
+
+# ------------------------------------------------------------- chunking
+def chunk_spans(total: int, per_chunk: int) -> List[Tuple[int, int]]:
+    """[0, total) as half-open spans of at most `per_chunk` (>=1)."""
+    per_chunk = max(1, int(per_chunk))
+    if total <= 0:
+        return [(0, 0)] if total == 0 else []
+    return [(lo, min(lo + per_chunk, total))
+            for lo in range(0, total, per_chunk)]
+
+
+def chunk_waves(sizes: Sequence[int], limit: Optional[int]
+                ) -> List[Tuple[int, int]]:
+    """Greedy prefix batching of work items into waves whose summed bytes
+    stay under `limit` (an item alone may exceed it — indivisible).  The
+    SAME planner bounds in-flight bytes for fleet hot-page drain
+    migration that bounds chunk bytes for array redistribution; returns
+    half-open index spans over `sizes`."""
+    n = len(sizes)
+    if not n:
+        return []
+    if not limit or limit <= 0:
+        return [(0, n)]
+    waves: List[Tuple[int, int]] = []
+    lo, acc = 0, 0
+    for i, s in enumerate(sizes):
+        if i > lo and acc + s > limit:
+            waves.append((lo, i))
+            lo, acc = i, 0
+        acc += int(s)
+    waves.append((lo, n))
+    return waves
+
+
+# ------------------------------------------------------------- the plan
+@dataclass(frozen=True)
+class ChunkOp:
+    """One step of the composed redistribution program: move the data in
+    `window` (global index coordinates) from wherever the src layout
+    holds it into the dst layout.  `kind` names the collective the step
+    lowers to; `bytes` is the chunk payload, `wire_bytes` what actually
+    crosses links (0 when every dst device already holds its piece)."""
+
+    window: Window
+    kind: str  # "local" | "slice" | "all_gather" | "all_to_all" | "gather_host"
+    bytes: int
+    wire_bytes: int
+
+
+@dataclass
+class ReshardPlan:
+    """A chunked redistribution program plus the byte accounting the
+    RESHARD001 audit and the cost model price."""
+
+    shape: Tuple[int, ...]
+    dtype: str
+    src_mesh: MeshDesc
+    src_spec: Spec
+    dst_mesh: MeshDesc
+    dst_spec: Spec
+    chunks: List[ChunkOp] = field(default_factory=list)
+    chunk_limit_bytes: int = 0   # the requested ceiling
+    min_chunk_bytes: int = 0     # smallest indivisible unit (one dim-0 row)
+    src_shard_bytes: int = 0
+    dst_shard_bytes: int = 0
+
+    def global_bytes(self) -> int:
+        return int(np.prod(self.shape, dtype=np.int64) *
+                   np.dtype(self.dtype).itemsize) if self.shape \
+            else np.dtype(self.dtype).itemsize
+
+    def wire_bytes(self) -> int:
+        return sum(op.wire_bytes for op in self.chunks)
+
+    def max_chunk_bytes(self) -> int:
+        return max((op.bytes for op in self.chunks), default=0)
+
+    def peak_live_bytes(self) -> int:
+        """Worst-case per-device live bytes while the program runs: the
+        source shard is still alive, the destination shard is being
+        built, and one chunk is in flight."""
+        return (self.src_shard_bytes + self.dst_shard_bytes
+                + self.max_chunk_bytes())
+
+    def chunked_bound(self) -> int:
+        """The O(max(src_shard, dst_shard) + chunk) contract RESHARD001
+        enforces.  The chunk term is the ceiling the plan was ASKED for
+        (or the smallest indivisible unit when a single row exceeds it)
+        — a plan whose actual chunks blew past that has degenerated
+        toward global materialization."""
+        chunk_ceiling = max(self.chunk_limit_bytes, self.min_chunk_bytes)
+        return (2 * max(self.src_shard_bytes, self.dst_shard_bytes)
+                + chunk_ceiling)
+
+    def cost_s(self, axis=None) -> float:
+        """Alpha-beta seconds of the program, priced through the same
+        autoflow/cost_model forms the solver uses for resharding edges."""
+        from easydist_tpu.autoflow import cost_model
+
+        if axis is None:
+            axis = cost_model.MeshAxisSpec(
+                "reshard", max(self.src_mesh.n_devices,
+                               self.dst_mesh.n_devices, 1))
+        return cost_model.redistribution_cost(
+            float(self.wire_bytes()),
+            sum(1 for op in self.chunks if op.wire_bytes > 0), axis)
+
+    def summary(self) -> Dict[str, Any]:
+        return {"shape": list(self.shape), "dtype": self.dtype,
+                "src": {"mesh": self.src_mesh.to_meta(),
+                        "spec": list(self.src_spec)},
+                "dst": {"mesh": self.dst_mesh.to_meta(),
+                        "spec": list(self.dst_spec)},
+                "n_chunks": len(self.chunks),
+                "kinds": sorted({op.kind for op in self.chunks}),
+                "wire_bytes": int(self.wire_bytes()),
+                "peak_live_bytes": int(self.peak_live_bytes()),
+                "chunked_bound": int(self.chunked_bound())}
+
+
+def _classify(src_mesh: MeshDesc, src_spec: Spec,
+              dst_mesh: MeshDesc, dst_spec: Spec) -> str:
+    """Which collective family the per-chunk step lowers to."""
+    if dst_mesh is HOST or dst_mesh == HOST:
+        return "gather_host"
+    if (src_mesh, src_spec) == (dst_mesh, dst_spec):
+        return "local"
+    src_dims = {d for d, a in enumerate(src_spec) if a is not None}
+    dst_dims = {d for d, a in enumerate(dst_spec) if a is not None}
+    if not src_dims:
+        return "slice"          # replicated source: every chunk is local
+    if src_dims and dst_dims and src_dims != dst_dims:
+        return "all_to_all"     # repartition across different dims
+    if dst_dims == src_dims:
+        src_parts = [src_mesh.axis_size(src_spec[d]) for d in sorted(src_dims)]
+        dst_parts = [dst_mesh.axis_size(dst_spec[d]) for d in sorted(dst_dims)]
+        if dst_parts == src_parts:
+            return "slice"      # same partition, different device set
+        return "all_gather" if max(dst_parts) < max(src_parts) \
+            else "all_to_all"   # coarsen = subgroup gather; refine = split
+    return "all_gather"         # sharded -> replicated
+
+
+def plan_redistribute(shape: Sequence[int], dtype,
+                      src: Tuple[MeshDesc, Sequence],
+                      dst: Tuple[MeshDesc, Sequence],
+                      chunk_bytes: Optional[int] = None) -> ReshardPlan:
+    """Plan moving one `shape`/`dtype` tensor from layout `src` to layout
+    `dst`, each a (MeshDesc, spec) pair.  Chunks tile dim 0 so that no
+    step stages more than `chunk_bytes` (default
+    `edconfig.reshard_chunk_bytes`); a single dim-0 row is the
+    indivisible floor.  Wire bytes per chunk are computed exactly from
+    the index windows: a dst device's piece is free when the same-index
+    src device already holds it (elastic shrink/grow keeps surviving
+    devices at their old linear index, so the overlap is real, not an
+    accident)."""
+    from easydist_tpu import config as edconfig
+
+    if chunk_bytes is None:
+        chunk_bytes = edconfig.reshard_chunk_bytes
+    chunk_bytes = int(chunk_bytes)
+    shape = tuple(int(s) for s in shape)
+    dtype = np.dtype(dtype)
+    src_mesh, src_spec_in = src
+    dst_mesh, dst_spec_in = dst
+    src_spec = normalize_spec(src_spec_in, len(shape))
+    dst_spec = normalize_spec(dst_spec_in, len(shape))
+    itemsize = dtype.itemsize
+
+    src_wins = device_windows(shape, src_mesh, src_spec)
+    dst_wins = device_windows(shape, dst_mesh, dst_spec)
+    plan = ReshardPlan(
+        shape=shape, dtype=dtype.name,
+        src_mesh=src_mesh, src_spec=src_spec,
+        dst_mesh=dst_mesh, dst_spec=dst_spec,
+        chunk_limit_bytes=chunk_bytes,
+        src_shard_bytes=max(window_bytes(w, itemsize) for w in src_wins),
+        dst_shard_bytes=max(window_bytes(w, itemsize) for w in dst_wins))
+
+    if not shape:  # scalar: one indivisible chunk
+        row_bytes = itemsize
+        spans = [(0, 1)]
+        full: Window = ()
+    else:
+        row_bytes = itemsize * int(
+            np.prod(shape[1:], dtype=np.int64)) if len(shape) > 1 \
+            else itemsize
+        rows = max(1, chunk_bytes // max(row_bytes, 1))
+        spans = chunk_spans(shape[0], rows)
+        full = tuple((0, d) for d in shape[1:])
+    plan.min_chunk_bytes = row_bytes
+
+    kind = _classify(src_mesh, src_spec, dst_mesh, dst_spec)
+    for lo, hi in spans:
+        win: Window = ((lo, hi),) + full if shape else ()
+        payload = window_bytes(win, itemsize) if shape else itemsize
+        wire = 0
+        if kind != "local":
+            for j, dwin in enumerate(dst_wins):
+                need = intersect(dwin, win) if shape else win
+                if shape and need is None:
+                    continue
+                need_b = window_bytes(need, itemsize) if shape else itemsize
+                local_b = 0
+                if j < len(src_wins):
+                    have = intersect(src_wins[j], need) if shape else need
+                    if not shape or have is not None:
+                        local_b = window_bytes(have, itemsize) if shape \
+                            else itemsize
+                wire += max(0, need_b - local_b)
+        plan.chunks.append(ChunkOp(window=win, kind=kind,
+                                   bytes=payload, wire_bytes=wire))
+    return plan
+
+
+# --------------------------------------------------- mesh fingerprinting
+def sharding_desc(sharding, ndim: int) -> Tuple[Optional[MeshDesc], Spec]:
+    """(MeshDesc, spec) of a live jax sharding; (None, replicated) for
+    single-device / unknown shardings."""
+    spec_tuple = getattr(sharding, "spec", None)
+    mesh = getattr(sharding, "mesh", None)
+    if mesh is None or spec_tuple is None:
+        return None, normalize_spec((), ndim)
+    try:
+        return (MeshDesc.from_mesh(mesh),
+                normalize_spec(tuple(spec_tuple), ndim))
+    except Exception:
+        return None, normalize_spec((), ndim)
+
+
+def state_fingerprint(state: Any) -> Dict[str, Any]:
+    """The mesh fingerprint `save_checkpoint` stamps into the manifest
+    meta: current device population (count + kinds) plus, per array
+    leaf in flatten order, its shape/dtype and SAVE-time (mesh, spec).
+    Restore compares this against the live topology to detect a shift
+    and to plan the per-leaf src->dst redistribution."""
+    import jax
+
+    devices = jax.devices()
+    leaves_meta: List[Dict[str, Any]] = []
+    leaves, _treedef = jax.tree_util.tree_flatten(state)
+    for leaf in leaves:
+        if not (hasattr(leaf, "shape") and hasattr(leaf, "dtype")):
+            leaves_meta.append({"kind": "opaque"})
+            continue
+        entry: Dict[str, Any] = {
+            "kind": "array",
+            "shape": [int(s) for s in leaf.shape],
+            "dtype": str(np.dtype(leaf.dtype)),
+        }
+        mesh_desc, spec = sharding_desc(getattr(leaf, "sharding", None),
+                                        len(leaf.shape))
+        if mesh_desc is not None and mesh_desc.n_devices > 1:
+            entry["mesh"] = mesh_desc.to_meta()
+            entry["spec"] = [s for s in spec]
+        leaves_meta.append(entry)
+    return {"format": FINGERPRINT_FORMAT,
+            "n_devices": len(devices),
+            "device_kinds": sorted({getattr(d, "device_kind", "?")
+                                    for d in devices}),
+            "leaves": leaves_meta}
+
+
+def topology_shifted(saved_fp: Optional[Dict[str, Any]],
+                     devices=None) -> bool:
+    """True when the saved fingerprint describes a different device
+    population than the live one (count or kinds) — the signal that
+    restore must plan redistribution instead of assuming layouts match."""
+    if not saved_fp:
+        return False
+    import jax
+
+    devices = jax.devices() if devices is None else devices
+    kinds = sorted({getattr(d, "device_kind", "?") for d in devices})
+    return (int(saved_fp.get("n_devices", -1)) != len(devices)
+            or list(saved_fp.get("device_kinds", [])) != kinds)

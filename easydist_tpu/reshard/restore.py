@@ -1,0 +1,187 @@
+"""Checkpoint-restore planning on the redistribution substrate.
+
+`plan_restore(like, saved_meta)` turns the manifest's mesh fingerprint
+(what the state looked like at SAVE time) plus the restore template
+(what the caller wants NOW) into per-leaf destination shardings and
+`ReshardPlan`s:
+
+  * template leaf already carries a multi-device sharding -> that IS the
+    destination (the caller's jit owns the layout); the plan prices the
+    saved->template move.
+  * template leaf is host/single-device but the fingerprint recorded a
+    (mesh, spec) for it -> re-fit the saved mesh onto the CURRENT device
+    population (outermost axis scales by the device ratio) and keep the
+    saved spec, so a shrunk/grown restart restores each leaf SHARDED —
+    per-device bytes stay O(leaf/n_devices), never the replicated
+    fallback.
+  * no usable information -> replicated over current devices (the
+    legacy fallback); the caller is told how many bytes that costs per
+    device so it can warn against the HBM budget.
+
+The orbax reader already fetches only each shard's byte ranges when
+given sharded targets, so executing these plans is exactly "restore into
+the planned shardings" — the plan is what makes the byte bound
+auditable (RESHARD001) before any I/O happens.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import plan as planlib
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class RestorePlan:
+    """Per-leaf destinations + plans for one checkpoint restore."""
+
+    topology_shift: bool = False
+    had_fingerprint: bool = False
+    # flat, aligned with jax.tree_util.tree_flatten(like):
+    shardings: List[Any] = field(default_factory=list)
+    plans: List[Tuple[int, planlib.ReshardPlan]] = field(
+        default_factory=list)
+    # (leaf index, per-device bytes) of leaves falling back to replicated
+    replicated_leaves: List[Tuple[int, int]] = field(default_factory=list)
+
+    def peak_live_bytes(self) -> int:
+        return max((p.peak_live_bytes() for _i, p in self.plans), default=0)
+
+    def chunked_bound(self) -> int:
+        return max((p.chunked_bound() for _i, p in self.plans), default=0)
+
+    def replicated_bytes_per_device(self) -> int:
+        return sum(b for _i, b in self.replicated_leaves)
+
+    def summary(self) -> Dict[str, Any]:
+        return {"topology_shift": self.topology_shift,
+                "had_fingerprint": self.had_fingerprint,
+                "n_planned": len(self.plans),
+                "n_replicated": len(self.replicated_leaves),
+                "replicated_bytes_per_device":
+                    int(self.replicated_bytes_per_device()),
+                "peak_live_bytes": int(self.peak_live_bytes()),
+                "chunked_bound": int(self.chunked_bound())}
+
+
+def _fit_mesh(saved: planlib.MeshDesc, n_now: int
+              ) -> Optional[planlib.MeshDesc]:
+    """Re-fit a saved mesh onto `n_now` devices: the OUTERMOST axis
+    absorbs the device ratio (elastic scale events add/remove whole
+    slices along one axis); None when no integer fit exists."""
+    p = saved.n_devices
+    if p == n_now:
+        return saved
+    sizes = list(saved.axis_sizes)
+    if not sizes:
+        return None
+    scaled = sizes[0] * n_now
+    if scaled % p != 0:
+        return None
+    new0 = scaled // p
+    if new0 < 1:
+        return None
+    return planlib.MeshDesc(saved.axis_names, (new0, *sizes[1:]),
+                            saved.device_kinds)
+
+
+def _build_mesh(desc: planlib.MeshDesc):
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:desc.n_devices]).reshape(
+        desc.axis_sizes)
+    return Mesh(devs, desc.axis_names)
+
+
+def _replicated_sharding():
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    devs = np.array(jax.devices())
+    return NamedSharding(Mesh(devs, ("restore",)), PartitionSpec())
+
+
+def plan_restore(like: Any, saved_meta: Optional[Dict[str, Any]],
+                 chunk_bytes: Optional[int] = None) -> RestorePlan:
+    """Build the restore plan for template `like` given the checkpoint
+    manifest's `mesh` fingerprint (None for legacy checkpoints)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    fp = (saved_meta or {}).get("mesh") if saved_meta else None
+    # process-level shift (device population/kind changed) is necessary
+    # but not sufficient: a restart onto a SUB-mesh of the same process
+    # (the in-process drill, or a job shrinking within one slice) shows
+    # the same jax.devices() — the per-leaf saved->destination mesh
+    # comparison below catches those too
+    out = RestorePlan(had_fingerprint=bool(fp),
+                      topology_shift=planlib.topology_shifted(fp))
+    leaves, _treedef = jax.tree_util.tree_flatten(like)
+    saved_leaves = list(fp.get("leaves", [])) if fp else []
+    n_now = len(jax.devices())
+    rep = None
+    mesh_cache: Dict[planlib.MeshDesc, Any] = {}
+
+    for i, leaf in enumerate(leaves):
+        if not (hasattr(leaf, "shape") and hasattr(leaf, "dtype")):
+            out.shardings.append(None)
+            continue
+        shape = tuple(int(s) for s in leaf.shape)
+        itemsize = np.dtype(leaf.dtype).itemsize
+        leaf_bytes = int(np.prod(shape, dtype=np.int64)) * itemsize \
+            if shape else itemsize
+
+        saved = saved_leaves[i] if i < len(saved_leaves) else {}
+        src_desc = None
+        if (saved.get("kind") == "array" and "mesh" in saved
+                and list(saved.get("shape", [])) == list(shape)):
+            src_desc = (planlib.MeshDesc.from_meta(saved["mesh"]),
+                        planlib.normalize_spec(
+                            tuple(saved.get("spec", [])), len(shape)))
+
+        template_sharding = getattr(leaf, "sharding", None)
+        if (template_sharding is not None
+                and getattr(template_sharding, "num_devices", 1) > 1):
+            # the caller's layout wins; the plan prices saved -> template
+            out.shardings.append(template_sharding)
+            dst_desc = planlib.sharding_desc(template_sharding, len(shape))
+            if dst_desc[0] is not None and src_desc is not None:
+                if (dst_desc[0].n_devices != src_desc[0].n_devices
+                        or dst_desc[0].axis_sizes != src_desc[0].axis_sizes):
+                    out.topology_shift = True
+                out.plans.append((i, planlib.plan_redistribute(
+                    shape, leaf.dtype, src_desc, dst_desc,
+                    chunk_bytes=chunk_bytes)))
+            continue
+
+        if src_desc is not None:
+            fitted = _fit_mesh(src_desc[0], n_now)
+            spec = src_desc[1]
+            if fitted is not None and any(a is not None for a in spec):
+                if fitted not in mesh_cache:
+                    mesh_cache[fitted] = _build_mesh(fitted)
+                sharding = NamedSharding(mesh_cache[fitted],
+                                         PartitionSpec(*spec))
+                if fitted != src_desc[0]:
+                    out.topology_shift = True
+                out.shardings.append(sharding)
+                out.plans.append((i, planlib.plan_redistribute(
+                    shape, leaf.dtype, src_desc, (fitted, spec),
+                    chunk_bytes=chunk_bytes)))
+                continue
+
+        # legacy fallback: replicated over the current devices —
+        # per-device cost is the WHOLE leaf, which is what the caller's
+        # HBM-budget warning is about
+        if rep is None:
+            rep = _replicated_sharding()
+        out.shardings.append(rep)
+        out.replicated_leaves.append((i, leaf_bytes))
+    return out

@@ -50,6 +50,11 @@ FAULT_POINTS = frozenset({
     "preempt.sigterm",        # host preemption signal at a step boundary
     "step.nan_grad",          # poisoned batch -> non-finite gradients
     "data.stall",             # input pipeline stops producing
+    # elastic topology shift (runtime/elastic.py + runtime/checkpoint.py
+    # + reshard/)
+    "elastic.mesh.shrink",    # slice shrank: SIGTERM, restart on fewer devices
+    "elastic.restore.chunk_corrupt",  # bit rot in the checkpoint being restored
+    "elastic.restore.oom",    # chunked restore step exceeds its memory budget
     # serving (serve/engine.py)
     "serve.exec_timeout",     # executable dispatch exceeds the watchdog
     "serve.oom_bucket",       # batch-bucket compile exhausts device memory

@@ -36,7 +36,23 @@ bookkeeping).
 
 Fault points (resilience/faultinject): `ckpt.write.partial` truncates a
 just-written array file and dies before commit; `ckpt.manifest.corrupt`
-flips bytes in a committed file so verification must catch it.
+flips bytes in a committed file so verification must catch it;
+`elastic.restore.chunk_corrupt` damages the checkpoint being RESTORED so
+load-time verification falls back to the previous committed step;
+`elastic.restore.oom` fails a chunked-restore step so the planner
+retries with a halved chunk.
+
+Topology-shift restore: `save_checkpoint` stamps a **mesh fingerprint**
+(`reshard.state_fingerprint` — device count/kinds + per-leaf (mesh,
+spec)) into the manifest meta.  `_restore` hands it to
+`reshard.plan_restore`, which maps every saved leaf onto the CURRENT
+device population and plans a chunked redistribution per leaf (audited
+by RESHARD001 against the O(max(src_shard, dst_shard) + chunk) bound,
+RESHARD002 after the restore lands) — so a job that saved on 8 devices
+resumes sharded on 4 (or back on 8) without ever materializing a global
+array; the replicated fallback only remains for legacy checkpoints
+without a fingerprint, and warns loudly when its per-device byte cost
+would blow the HBM budget.
 """
 
 from __future__ import annotations
@@ -141,13 +157,32 @@ def _walk_files(root: str) -> List[str]:
     return sorted(out)
 
 
+def _mesh_fingerprint(state: Any) -> Optional[Dict[str, Any]]:
+    """The manifest's topology-shift detector (reshard.state_fingerprint);
+    None when the state carries nothing fingerprintable — a save must
+    never fail because its meta could not be enriched."""
+    try:
+        from easydist_tpu.reshard import state_fingerprint
+
+        return state_fingerprint(state)
+    except Exception as e:  # pragma: no cover - best-effort enrichment
+        logger.debug("checkpoint: mesh fingerprint skipped (%s)", e)
+        return None
+
+
 def save_checkpoint(path: str, state: Any, step: int, keep: int = 3,
                     meta: Optional[Dict[str, Any]] = None) -> str:
     """Atomically save `state` (arbitrary pytree of arrays, possibly
     sharded) under `path/step_{step}`.  Synchronous; returns the committed
     checkpoint dir.  `meta` lands in the manifest (the elastic loop stores
-    the data cursor there)."""
+    the data cursor there; the mesh fingerprint is stamped automatically
+    so restore can detect a topology shift)."""
     ocp = _ocp()
+    meta = dict(meta or {})
+    if "mesh" not in meta:
+        fp = _mesh_fingerprint(state)
+        if fp is not None:
+            meta["mesh"] = fp
     path = os.path.abspath(path)
     os.makedirs(path, exist_ok=True)
     tmp = os.path.join(path, f".tmp_step_{step}_{uuid.uuid4().hex[:8]}")
@@ -180,7 +215,7 @@ def save_checkpoint(path: str, state: Any, step: int, keep: int = 3,
             "format": MANIFEST_FORMAT,
             "step": int(step),
             "created": time.time(),
-            "meta": dict(meta or {}),
+            "meta": meta,
             "files": {},
         }
         for rel in _walk_files(tmp):
@@ -214,20 +249,29 @@ def save_checkpoint(path: str, state: Any, step: int, keep: int = 3,
     if faultinject.fire("ckpt.manifest.corrupt"):
         # simulate post-commit bit rot: flip bytes in the largest data
         # file; load-time verification MUST catch this and fall back
-        files = sorted(
-            ((os.path.getsize(os.path.join(final, r)), r)
-             for r in _walk_files(final)
-             if r not in (MANIFEST_NAME, COMMITTED_NAME)), reverse=True)
-        if files:
-            victim = os.path.join(final, files[0][1])
-            with open(victim, "r+b") as fh:
-                data = fh.read()
-                fh.seek(len(data) // 2)
-                fh.write(bytes(b ^ 0xFF for b in data[
-                    len(data) // 2:len(data) // 2 + 8]) or b"\xff")
+        _flip_committed_bytes(final)
 
     _gc_old(path, keep, protect=step)
     return final
+
+
+def _flip_committed_bytes(ckpt_dir: str) -> None:
+    """Flip 8 bytes mid-file in the largest data file of a COMMITTED
+    checkpoint — the shared corruption shape behind the
+    `ckpt.manifest.corrupt` (rot after save) and
+    `elastic.restore.chunk_corrupt` (rot discovered at restore) fault
+    points; manifest verification must catch either."""
+    files = sorted(
+        ((os.path.getsize(os.path.join(ckpt_dir, r)), r)
+         for r in _walk_files(ckpt_dir)
+         if r not in (MANIFEST_NAME, COMMITTED_NAME)), reverse=True)
+    if files:
+        victim = os.path.join(ckpt_dir, files[0][1])
+        with open(victim, "r+b") as fh:
+            data = fh.read()
+            fh.seek(len(data) // 2)
+            fh.write(bytes(b ^ 0xFF for b in data[
+                len(data) // 2:len(data) // 2 + 8]) or b"\xff")
 
 
 def _step_dirs(path: str) -> List[Tuple[int, str]]:
@@ -328,6 +372,11 @@ def load_checkpoint(path: str, like: Any, step: Optional[int] = None,
     last_err: Optional[str] = None
     for cand in candidates:
         ckpt_dir = os.path.join(path, f"step_{cand}")
+        if faultinject.fire("elastic.restore.chunk_corrupt"):
+            # bit rot discovered at RESTORE time: damage the candidate
+            # before verification so the manifest catches it and the
+            # loop falls back to the previous committed step
+            _flip_committed_bytes(ckpt_dir)
         if verify:
             problems = verify_checkpoint(ckpt_dir)
             if problems:
@@ -339,16 +388,32 @@ def load_checkpoint(path: str, like: Any, step: Optional[int] = None,
                     "committed step", msg)
                 last_err = msg
                 continue
-        state = _restore(ckpt_dir, like)
+        meta = checkpoint_meta(path, cand)
+        state = _restore(ckpt_dir, like, meta=meta)
         if with_meta:
-            return state, cand, checkpoint_meta(path, cand)
+            return state, cand, meta
         return state
     raise CheckpointCorruptionError(
         f"every committed checkpoint under {path} failed verification "
         f"(last: {last_err})")
 
 
-def _restore(ckpt_dir: str, like: Any) -> Any:
+# diagnostics of the most recent _restore in this process (set even when
+# the restore itself then fails): what the elastic-chaos drill gates on
+_last_restore_report: Optional[Dict[str, Any]] = None
+
+
+def last_restore_report() -> Optional[Dict[str, Any]]:
+    """Summary of the most recent restore's redistribution plan:
+    topology_shift, per-leaf plan counts, peak_live_bytes vs the
+    RESHARD001 chunked bound, replicated-fallback byte cost, and the
+    chunk size actually used (halved when `elastic.restore.oom` fired)."""
+    return _last_restore_report
+
+
+def _restore(ckpt_dir: str, like: Any,
+             meta: Optional[Dict[str, Any]] = None) -> Any:
+    global _last_restore_report
     ocp = _ocp()
     arrays_dir = os.path.join(ckpt_dir, ARRAYS_SUBDIR)
     wrapped = True
@@ -356,41 +421,102 @@ def _restore(ckpt_dir: str, like: Any) -> Any:
         arrays_dir = ckpt_dir  # legacy layout (pre-commit-protocol)
         wrapped = False
 
-    def replicated_sharding():
-        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+    from easydist_tpu.reshard import restore as reshard_restore
 
-        import numpy as np
+    # ---- plan per-leaf destinations + redistribution (reshard/restore):
+    # template shardings win; fingerprinted leaves re-fit onto the
+    # current devices; only fingerprint-less leaves fall back replicated.
+    # The elastic.restore.oom fault fails the first plan's execution
+    # budget — recovery is re-planning with a halved chunk, same dsts.
+    chunk_bytes = edconfig.reshard_chunk_bytes
+    findings = 0
+    while True:
+        rplan = reshard_restore.plan_restore(like, meta,
+                                             chunk_bytes=chunk_bytes)
+        try:
+            from easydist_tpu.analyze import check_reshard_plan
+        except ImportError:  # analyze is an optional layer at runtime
+            check_reshard_plan = None
+        if check_reshard_plan is not None:
+            for i, leaf_plan in rplan.plans:
+                findings += len(check_reshard_plan(
+                    leaf_plan,
+                    node=f"restore[{os.path.basename(ckpt_dir)}]"
+                         f".leaf[{i}]"))
+        if faultinject.fire("elastic.restore.oom"):
+            chunk_bytes = max(1, chunk_bytes // 2)
+            logger.warning(
+                "checkpoint: chunked restore exceeded its memory budget "
+                "(injected); re-planning with chunk_bytes=%d", chunk_bytes)
+            continue
+        break
 
-        devs = np.array(jax.devices())
-        return NamedSharding(Mesh(devs, ("restore",)), PartitionSpec())
+    if rplan.topology_shift:
+        logger.warning(
+            "checkpoint: topology shift restoring %s (saved on %s "
+            "device(s)) — planned %d per-leaf redistribution(s), peak "
+            "live %d B under bound %d B, %d leaf/leaves replicated",
+            ckpt_dir, (meta or {}).get("mesh", {}).get("n_devices", "?"),
+            len(rplan.plans), rplan.peak_live_bytes(),
+            rplan.chunked_bound(), len(rplan.replicated_leaves))
 
-    rep = replicated_sharding()
+    # replicated fallback is an OOM hazard at scale: per-device cost is
+    # the SUM of every fallback leaf — warn loudly against the HBM
+    # budget even when nothing else in the new path is in play
+    rep_bytes = rplan.replicated_bytes_per_device()
+    if rep_bytes:
+        budget = 0
+        try:
+            from easydist_tpu.analyze import resolve_hbm_budget
 
-    def as_abstract(x):
-        if hasattr(x, "shape") and hasattr(x, "dtype"):
-            sharding = getattr(x, "sharding", None)
-            # A single-device sharding in the template usually means
-            # "freshly initialized host arrays".  Restoring committed to
-            # device 0 clashes with multi-device jits, and sharding=None
-            # makes orbax fall back to the SAVED topology (which may no
-            # longer exist on an elastic restart).  Restore replicated
-            # over the CURRENT devices instead — valid on any topology,
-            # and jit reshards from there per its constraints.
-            if sharding is None or getattr(sharding, "num_devices", 1) <= 1:
-                sharding = rep
-            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
-        return x
+            budget = resolve_hbm_budget()
+        except Exception:
+            pass
+        if budget and rep_bytes > budget:
+            logger.warning(
+                "checkpoint: REPLICATED restore fallback for %d leaf/"
+                "leaves costs %d bytes PER DEVICE — over the HBM budget "
+                "of %d bytes (EASYDIST_ANALYZE_HBM_BUDGET).  Save with a "
+                "current save_checkpoint (mesh fingerprint) or pass a "
+                "sharded template to restore chunked instead.",
+                len(rplan.replicated_leaves), rep_bytes, budget)
 
-    abstract = jax.tree_util.tree_map(as_abstract, like)
+    leaves, treedef = jax.tree_util.tree_flatten(like)
+    abs_leaves = []
+    for leaf, sharding in zip(leaves, rplan.shardings):
+        if (sharding is not None and hasattr(leaf, "shape")
+                and hasattr(leaf, "dtype")):
+            abs_leaves.append(jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype, sharding=sharding))
+        else:
+            abs_leaves.append(leaf)
+    abstract = jax.tree_util.tree_unflatten(treedef, abs_leaves)
     if wrapped:
         abstract = {"state": abstract}
+
+    _last_restore_report = {
+        "ckpt_dir": ckpt_dir, **rplan.summary(),
+        "chunk_bytes": int(chunk_bytes), "reshard_findings": int(findings),
+    }
 
     def do_restore():
         with ocp.StandardCheckpointer() as ckptr:
             return ckptr.restore(arrays_dir, abstract)
 
     out = _retry_io(do_restore, f"restore {ckpt_dir}")
-    return out["state"] if wrapped else out
+    state = out["state"] if wrapped else out
+
+    # RESHARD002: every leaf the template constrained must have come
+    # back on exactly that sharding
+    try:
+        from easydist_tpu.analyze import check_restored_state
+
+        findings += len(check_restored_state(
+            state, like, node=f"restore[{os.path.basename(ckpt_dir)}]"))
+        _last_restore_report["reshard_findings"] = int(findings)
+    except ImportError:
+        pass
+    return state
 
 
 def _gc_old(path: str, keep: int, protect: Optional[int] = None) -> None:

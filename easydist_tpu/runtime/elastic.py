@@ -23,8 +23,13 @@ implements that loop hardened end-to-end:
     `DataStallError` instead of hanging the job silently forever.
 
 Every path above is exercised deterministically by the fault points
-`preempt.sigterm`, `step.nan_grad`, `data.stall`, `ckpt.write.partial` and
-`ckpt.manifest.corrupt` (resilience/faultinject.py).
+`preempt.sigterm`, `step.nan_grad`, `data.stall`, `ckpt.write.partial`,
+`ckpt.manifest.corrupt`, and — for the topology-shift contract
+(reshard/ + runtime/checkpoint.py) — `elastic.mesh.shrink` (the slice
+shrank: same SIGTERM grace as a preemption, restart lands on fewer
+devices), `elastic.restore.chunk_corrupt`, and `elastic.restore.oom`
+(resilience/faultinject.py).  The `bench.py --elastic-chaos` drill
+gates the full 8 -> SIGTERM -> 4 -> 8 cycle on bitwise loss parity.
 """
 
 from __future__ import annotations
@@ -39,9 +44,25 @@ from easydist_tpu.resilience import faultinject
 from easydist_tpu.resilience.guard import GuardedStep
 from easydist_tpu.resilience.preempt import PreemptedError, PreemptionHandler
 
-from .checkpoint import latest_step, load_checkpoint, save_checkpoint
+from .checkpoint import (last_restore_report, latest_step, load_checkpoint,
+                         save_checkpoint)
 
 logger = logging.getLogger(__name__)
+
+
+def _perfdb_note(sub_key: str, entry: dict) -> None:
+    """Best-effort PerfDB history row under the "elastic" key — loud
+    events (legacy-cursor heuristic, topology shift) must be visible in
+    the same store the drills and dashboards read, but recording them
+    can never fail a training run."""
+    try:
+        from easydist_tpu.runtime.perfdb import PerfDB
+
+        db = PerfDB()
+        db.append_history("elastic", sub_key, entry)
+        db.persist()
+    except Exception as e:  # pragma: no cover - diagnostics only
+        logger.debug("elastic: perfdb note %s skipped (%s)", sub_key, e)
 
 
 class DataStallError(RuntimeError):
@@ -127,11 +148,35 @@ def run_training(step_fn: Callable, init_state: Callable, data_iter,
         state, start, meta = load_checkpoint(
             ckpt_dir, init_state(), with_meta=True)
         logger.info("elastic: resumed from step %d", start)
+        report = last_restore_report()
+        if report and report.get("topology_shift"):
+            saved_n = (meta.get("mesh") or {}).get("n_devices", "?")
+            logger.warning(
+                "elastic: resumed across a topology shift (checkpoint "
+                "saved on %s device(s)) — %d leaf redistribution(s) "
+                "planned, restore peak %d B under bound %d B",
+                saved_n, report.get("n_planned", 0),
+                report.get("peak_live_bytes", 0),
+                report.get("chunked_bound", 0))
+            _perfdb_note("topology_shift", {
+                "step": start, "saved_n_devices": saved_n,
+                "n_planned": report.get("n_planned"),
+                "peak_live_bytes": report.get("peak_live_bytes"),
+                "chunked_bound": report.get("chunked_bound"),
+                "n_replicated": report.get("n_replicated")})
         cursor = meta.get("batches_consumed")
         if cursor is None:
             # legacy checkpoint without a manifest cursor: the old
             # steps==batches heuristic is the only information available
             cursor = start
+            logger.warning(
+                "elastic: checkpoint step %d predates the manifest data "
+                "cursor — resuming on the steps==batches heuristic, "
+                "which DOUBLE-SAMPLES whenever a step consumed more "
+                "than one batch; re-save with the current "
+                "save_checkpoint to clear this", start)
+            _perfdb_note("legacy_cursor", {
+                "step": start, "heuristic": "steps==batches"})
         # position the data stream: without this, a restart re-trains on
         # batches the restored state already saw (silent double-sampling)
         if hasattr(data_iter, "skip"):
@@ -159,6 +204,16 @@ def run_training(step_fn: Callable, init_state: Callable, data_iter,
     with PreemptionHandler(grace_s=preempt_grace_s) as pre:
         for step in range(start, total_steps):
             if faultinject.fire("preempt.sigterm"):
+                signal.raise_signal(signal.SIGTERM)
+            if faultinject.fire("elastic.mesh.shrink"):
+                # the slice shrank under us: the platform delivers the
+                # same grace signal as a preemption — the difference is
+                # that the RESTART lands on fewer devices, which the
+                # fingerprinted restore path must absorb
+                logger.warning(
+                    "elastic: mesh shrink notice at step %d (injected) — "
+                    "checkpointing and exiting for a smaller restart",
+                    step)
                 signal.raise_signal(signal.SIGTERM)
             if pre.requested:
                 t_ck = time.perf_counter()

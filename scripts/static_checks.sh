@@ -318,6 +318,61 @@ else
     echo "static_checks: jax not importable; skipping bench.py --fleet-chaos"
 fi
 
+# elastic-chaos gate: train on 8 virtual devices, take a mesh-shrink
+# SIGTERM mid-run, restart on a 4-device sub-mesh (newest checkpoint
+# corrupted -> one-step fallback + replay), grow back to 8 (restore
+# chunk budget "OOMs" -> halve and replan); the full loss stream AND
+# final state must be bitwise-identical to an uninterrupted 8-device
+# run, both restores must detect the topology shift and route through
+# the reshard planner inside the RESHARD001 byte bound with zero
+# findings, and every scheduled fault must fire
+if python -c "import jax" >/dev/null 2>&1; then
+    echo "== bench.py --elastic-chaos (topology-shift recovery drill gate)"
+    out=$(python bench.py --elastic-chaos 2>/dev/null) || rc=1
+    echo "$out"
+    verdict=$(python - "$out" <<'PYEOF'
+import json, sys
+try:
+    r = json.loads(sys.argv[1].strip().splitlines()[-1])
+    if "error" in r:
+        print("error: " + r["error"])
+    elif not r.get("final_state_bitwise"):
+        print("final state diverges from the uninterrupted 8-device run")
+    elif not r.get("loss_stream_bitwise"):
+        print(f"loss stream diverges at {r.get('loss_mismatches')}")
+    elif not r.get("shrink_notice_preempted"):
+        print("mesh-shrink notice never preempted the loop")
+    elif r.get("fault_plan_unfired", 1) != 0:
+        print(f"{r.get('fault_plan_unfired')} scheduled fault(s) never fired")
+    elif r.get("topology_shifts_detected") != 2:
+        print(f"detected {r.get('topology_shifts_detected')} topology "
+              f"shift(s), expected 2 (8->4 and 4->8)")
+    elif not r.get("restore_peak_within_bound"):
+        print("a restore plan's peak live bytes exceeded the chunked bound")
+    elif r.get("reshard_findings", 1) != 0:
+        print(f"{r.get('reshard_findings')} RESHARD001/002 finding(s)")
+    elif not r.get("steps_replayed_after_fallback"):
+        print("corrupt-checkpoint fallback replayed no step "
+              "(drill tested nothing)")
+    elif r.get("value") != 1.0:
+        print("drill gate value != 1.0")
+    elif r.get("perf_regression"):
+        print(f"committed-floor regression: {r.get('value')} is >10% below "
+              f"last-good {r.get('last_good_value')}")
+    else:
+        print("ok")
+except Exception as e:
+    print(f"unparseable: {e}")
+PYEOF
+)
+    if [ "$verdict" != "ok" ]; then
+        echo "static_checks: elastic-chaos gate failed ($verdict)"
+        rc=1
+    fi
+else
+    echo "static_checks: jax not importable; skipping bench.py --elastic-chaos"
+fi
+
 # speculative-decoding gate: draft/verify greedy decode must beat plain
 # decode >= 1.4x tokens/s on the repetitive (hot-prompt) workload and
 # slow the adversarial (always-rejected-drafts) workload by <= 1.15x,

@@ -1,0 +1,124 @@
+"""Layer 8 redistribution auditor goldens: RESHARD001 (a plan's peak
+live bytes exceed the chunked bound — the planner degenerated toward
+global materialization) and RESHARD002 (a restored leaf landed on a
+sharding the template didn't ask for).  Each known-bad fixture fires its
+rule exactly once; each clean fixture yields zero findings."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from easydist_tpu.analyze import (audit_reshard_plan, audit_restored_state,
+                                  check_reshard_plan, check_restored_state)
+from easydist_tpu.analyze.findings import AnalysisError
+from easydist_tpu.reshard import MeshDesc, plan_redistribute
+from easydist_tpu.reshard.plan import ChunkOp, ReshardPlan
+
+DP8 = MeshDesc(("dp",), (8,))
+DP4 = MeshDesc(("dp",), (4,))
+
+
+def _clean_plan():
+    return plan_redistribute((16, 8), np.float32, (DP8, ("dp", None)),
+                             (DP4, ("dp", None)), chunk_bytes=128)
+
+
+def _degenerate_plan():
+    """A hand-built plan that staged the WHOLE array as one chunk while
+    claiming a 64 B ceiling — the RESHARD001 shape (a chunk limit
+    silently ignored)."""
+    return ReshardPlan(
+        shape=(16, 8), dtype="float32",
+        src_mesh=DP8, src_spec=("dp", None),
+        dst_mesh=DP4, dst_spec=("dp", None),
+        chunks=[ChunkOp(window=((0, 16), (0, 8)), kind="all_gather",
+                        bytes=512, wire_bytes=448)],
+        chunk_limit_bytes=64, min_chunk_bytes=32,
+        src_shard_bytes=64, dst_shard_bytes=128)
+
+
+class TestReshard001:
+    def test_clean_plan_zero_findings(self):
+        assert audit_reshard_plan(_clean_plan()) == []
+
+    def test_degenerate_plan_fires_once(self):
+        findings = audit_reshard_plan(_degenerate_plan(), node="leaf[0]")
+        assert len(findings) == 1
+        f = findings[0]
+        assert f.rule_id == "RESHARD001" and f.severity == "error"
+        assert f.node == "leaf[0]"
+        assert "global materialization" in f.message
+
+    def test_every_grid_plan_is_clean(self):
+        # the planner must never emit a plan its own audit rejects
+        for chunk_bytes in (64, 256, 1 << 20):
+            for src, dst in (((DP8, ("dp", None)), (DP4, ("dp", None))),
+                             ((DP4, (None, "dp")), (DP8, ("dp", None))),
+                             ((DP8, ("dp", None)), (DP8, (None, None)))):
+                plan = plan_redistribute((64, 8), np.float32, src, dst,
+                                         chunk_bytes=chunk_bytes)
+                assert audit_reshard_plan(plan) == []
+
+    def test_hook_raises_under_analyze_raise(self):
+        with pytest.raises(AnalysisError, match="RESHARD001"):
+            check_reshard_plan(_degenerate_plan())
+
+    def test_hook_clean_returns_empty(self):
+        assert check_reshard_plan(_clean_plan()) == []
+
+
+class TestReshard002:
+    @pytest.fixture()
+    def shardings(self, cpu_devices):
+        mesh = Mesh(np.asarray(cpu_devices), ("dp",))
+        return (NamedSharding(mesh, P("dp", None)),
+                NamedSharding(mesh, P(None, "dp")))
+
+    def _arr(self, sharding):
+        return jax.device_put(
+            jnp.zeros((16, 8), jnp.float32), sharding)
+
+    def test_matching_shardings_zero_findings(self, shardings):
+        row, _col = shardings
+        template = {"w": jax.ShapeDtypeStruct((16, 8), jnp.float32,
+                                              sharding=row)}
+        assert audit_restored_state({"w": self._arr(row)}, template) == []
+
+    def test_wrong_layout_fires_once(self, shardings):
+        row, col = shardings
+        template = {"w": jax.ShapeDtypeStruct((16, 8), jnp.float32,
+                                              sharding=row)}
+        findings = audit_restored_state({"w": self._arr(col)}, template,
+                                        node="restore[step_3]")
+        assert len(findings) == 1
+        f = findings[0]
+        assert f.rule_id == "RESHARD002" and f.severity == "error"
+        assert f.node == "restore[step_3].leaf[0]"
+
+    def test_unconstrained_template_leaf_is_free(self, shardings):
+        _row, col = shardings
+        # template without a sharding: the restore planner chose — any
+        # landing layout is acceptable
+        template = {"w": jax.ShapeDtypeStruct((16, 8), jnp.float32)}
+        assert audit_restored_state({"w": self._arr(col)}, template) == []
+
+    def test_tree_structure_mismatch_fires_once(self, shardings):
+        row, _col = shardings
+        template = {"w": jax.ShapeDtypeStruct((16, 8), jnp.float32,
+                                              sharding=row)}
+        findings = audit_restored_state(
+            {"w": self._arr(row), "extra": 1}, template)
+        assert len(findings) == 1
+        assert findings[0].rule_id == "RESHARD002"
+        assert "tree structure" in findings[0].message
+
+    def test_hook_raises_under_analyze_raise(self, shardings):
+        row, col = shardings
+        template = {"w": jax.ShapeDtypeStruct((16, 8), jnp.float32,
+                                              sharding=row)}
+        with pytest.raises(AnalysisError, match="RESHARD002"):
+            check_restored_state({"w": self._arr(col)}, template)

@@ -1,0 +1,295 @@
+"""Redistribution planner unit tests: a seeded grid of (shape, src
+mesh/spec, dst mesh/spec) pairs executed against a NUMPY SHADOW MODEL —
+the plan's chunk windows, replayed as plain array copies, must rebuild
+every destination shard exactly — plus the byte-accounting invariants
+RESHARD001 audits (peak under the chunked bound for every planned
+program) and deterministic pricing through the autoflow cost model.
+
+Everything here is host-side numpy on mesh DESCRIPTIONS; no jax, no
+devices — which is the planner's whole contract (it must plan restores
+whose source mesh no longer exists).
+"""
+
+import numpy as np
+import pytest
+
+from easydist_tpu.reshard import (HOST, MeshDesc, chunk_spans, chunk_waves,
+                                  device_windows, normalize_spec,
+                                  plan_redistribute)
+from easydist_tpu.reshard.plan import (intersect, max_shard_bytes,
+                                       window_bytes)
+
+
+# ------------------------------------------------------------ mesh desc
+class TestMeshDesc:
+    def test_meta_round_trip(self):
+        m = MeshDesc(("dp", "tp"), (4, 2), ("TPU v4",))
+        assert MeshDesc.from_meta(m.to_meta()) == m
+        assert m.n_devices == 8
+        assert m.axis_size("tp") == 2
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            MeshDesc(("dp",), (4, 2))
+        with pytest.raises(ValueError):
+            MeshDesc(("dp",), (0,))
+
+    def test_meta_is_json_plain(self):
+        import json
+
+        meta = MeshDesc(("dp",), (8,), ("host",)).to_meta()
+        assert json.loads(json.dumps(meta)) == meta
+
+
+class TestNormalizeSpec:
+    def test_pads_and_passes_names(self):
+        assert normalize_spec(("dp",), 3) == ("dp", None, None)
+
+    def test_single_axis_tuple_unwraps(self):
+        assert normalize_spec((("dp",), None), 2) == ("dp", None)
+
+    def test_multi_axis_dim_degrades_to_replicated(self):
+        # block-cyclic layouts are out of scope: never guess
+        assert normalize_spec((("dp", "tp"),), 1) == (None,)
+
+    def test_truncates_past_ndim(self):
+        assert normalize_spec(("dp", "tp", "pp"), 2) == ("dp", "tp")
+
+
+# ------------------------------------------------------- device windows
+class TestDeviceWindows:
+    def test_shard_and_replica_windows(self):
+        mesh = MeshDesc(("dp",), (4,))
+        wins = device_windows((8, 6), mesh, ("dp", None))
+        assert len(wins) == 4
+        assert wins[0] == ((0, 2), (0, 6))
+        assert wins[3] == ((6, 8), (0, 6))
+
+    def test_replicated_spec_identical_windows(self):
+        mesh = MeshDesc(("dp",), (4,))
+        wins = device_windows((8,), mesh, (None,))
+        assert all(w == ((0, 8),) for w in wins)
+
+    def test_uneven_dim_ceil_blocks(self):
+        # jax pads the LAST shard on uneven dims: ceil blocks, clipped
+        mesh = MeshDesc(("dp",), (4,))
+        wins = device_windows((7,), mesh, ("dp",))
+        assert [w[0] for w in wins] == [(0, 2), (2, 4), (4, 6), (6, 7)]
+
+    def test_unknown_axis_raises(self):
+        with pytest.raises(ValueError, match="not in mesh axes"):
+            device_windows((8,), MeshDesc(("dp",), (2,)), ("tp",))
+
+    def test_2d_mesh_row_major_order(self):
+        mesh = MeshDesc(("dp", "tp"), (2, 2))
+        wins = device_windows((4, 4), mesh, ("dp", "tp"))
+        # linear order is row-major over (dp, tp)
+        assert wins == [((0, 2), (0, 2)), ((0, 2), (2, 4)),
+                        ((2, 4), (0, 2)), ((2, 4), (2, 4))]
+
+
+# ------------------------------------------------------------- chunking
+class TestChunking:
+    def test_chunk_spans_cover_and_bound(self):
+        spans = chunk_spans(10, 3)
+        assert spans == [(0, 3), (3, 6), (6, 9), (9, 10)]
+        assert chunk_spans(0, 3) == [(0, 0)]
+
+    def test_chunk_waves_bound_and_cover(self):
+        sizes = [4, 4, 4, 10, 1, 1]
+        waves = chunk_waves(sizes, 8)
+        # full coverage, in order, no overlap
+        flat = [i for lo, hi in waves for i in range(lo, hi)]
+        assert flat == list(range(len(sizes)))
+        # every multi-item wave stays under the limit; the oversized
+        # item (10 > 8) ships alone — indivisible
+        for lo, hi in waves:
+            if hi - lo > 1:
+                assert sum(sizes[lo:hi]) <= 8
+        assert (3, 4) in waves
+
+    def test_chunk_waves_no_limit_single_wave(self):
+        assert chunk_waves([1, 2, 3], None) == [(0, 3)]
+        assert chunk_waves([], 8) == []
+
+
+# --------------------------------------------- the numpy shadow machine
+def _shadow_execute(plan):
+    """Replay the plan's chunk windows as numpy copies: for every dst
+    device, fill its shard buffer from the global array restricted to
+    each chunk window.  The union must rebuild every dst shard exactly
+    and touch each element of it exactly once."""
+    shape = plan.shape
+    global_arr = np.arange(int(np.prod(shape)), dtype=np.float32
+                           ).reshape(shape)
+    dst_wins = device_windows(shape, plan.dst_mesh, plan.dst_spec)
+    for dwin in dst_wins:
+        buf = np.full([hi - lo for lo, hi in dwin], np.nan, np.float32)
+        hits = np.zeros(buf.shape, np.int32)
+        for op in plan.chunks:
+            ov = intersect(dwin, op.window)
+            if ov is None:
+                continue
+            dst_idx = tuple(slice(lo - dlo, hi - dlo) for (lo, hi),
+                            (dlo, _dhi) in zip(ov, dwin))
+            src_idx = tuple(slice(lo, hi) for lo, hi in ov)
+            buf[dst_idx] = global_arr[src_idx]
+            hits[dst_idx] += 1
+        want = global_arr[tuple(slice(lo, hi) for lo, hi in dwin)]
+        np.testing.assert_array_equal(buf, want)
+        assert (hits == 1).all(), "chunks overlapped or missed elements"
+
+
+MESHES = {
+    "dp8": MeshDesc(("dp",), (8,)),
+    "dp4": MeshDesc(("dp",), (4,)),
+    "dp2tp2": MeshDesc(("dp", "tp"), (2, 2)),
+    "one": MeshDesc(("dp",), (1,)),
+}
+
+# seeded (shape, src, dst) grid: shrink, grow, respec-across-dims,
+# gather-to-replicated, scatter-from-replicated, uneven dims, 2d mesh
+GRID = [
+    ((16, 8), ("dp8", ("dp", None)), ("dp4", ("dp", None))),
+    ((16, 8), ("dp4", ("dp", None)), ("dp8", ("dp", None))),
+    ((16, 8), ("dp8", (None, "dp")), ("dp4", (None, "dp"))),
+    ((16, 8), ("dp8", ("dp", None)), ("dp8", (None, "dp"))),
+    ((16, 8), ("dp8", ("dp", None)), ("dp8", (None, None))),
+    ((16, 8), ("dp8", (None, None)), ("dp8", ("dp", None))),
+    ((7, 5), ("dp4", ("dp", None)), ("dp2tp2", ("dp", "tp"))),
+    ((12, 6), ("dp2tp2", ("dp", "tp")), ("dp8", ("dp", None))),
+    ((9,), ("dp4", ("dp",)), ("dp4", (None,))),
+    ((16, 8), ("dp8", ("dp", None)), ("dp8", ("dp", None))),  # identity
+]
+
+
+class TestPlanGrid:
+    @pytest.mark.parametrize("shape,src,dst", GRID,
+                             ids=[f"{s}:{a[0]}->{b[0]}" for s, a, b in GRID])
+    def test_shadow_model_rebuilds_every_dst_shard(self, shape, src, dst):
+        plan = plan_redistribute(
+            shape, np.float32, (MESHES[src[0]], src[1]),
+            (MESHES[dst[0]], dst[1]), chunk_bytes=128)
+        _shadow_execute(plan)
+
+    @pytest.mark.parametrize("shape,src,dst", GRID,
+                             ids=[f"{s}:{a[0]}->{b[0]}" for s, a, b in GRID])
+    def test_peak_never_exceeds_chunked_bound(self, shape, src, dst):
+        # the RESHARD001 contract holds for EVERY plan the planner emits
+        for chunk_bytes in (64, 128, 1 << 20):
+            plan = plan_redistribute(
+                shape, np.float32, (MESHES[src[0]], src[1]),
+                (MESHES[dst[0]], dst[1]), chunk_bytes=chunk_bytes)
+            assert plan.peak_live_bytes() <= plan.chunked_bound()
+            assert plan.max_chunk_bytes() <= max(plan.chunk_limit_bytes,
+                                                 plan.min_chunk_bytes)
+
+    def test_chunk_count_tracks_ceiling(self):
+        # (16, 8) f32: one dim-0 row is 32 B; a 64 B ceiling = 2 rows
+        # per chunk = 8 chunks; a huge ceiling = 1 chunk
+        src = (MESHES["dp8"], (None, "dp"))
+        dst = (MESHES["dp4"], (None, "dp"))
+        small = plan_redistribute((16, 8), np.float32, src, dst,
+                                  chunk_bytes=64)
+        big = plan_redistribute((16, 8), np.float32, src, dst,
+                                chunk_bytes=1 << 20)
+        assert len(small.chunks) == 8
+        assert len(big.chunks) == 1
+        # smaller chunks, smaller peak — the "+ chunk" term shrinks
+        assert small.peak_live_bytes() < big.peak_live_bytes()
+        # wire bytes are chunking-invariant (same data moves)
+        assert small.wire_bytes() == big.wire_bytes()
+
+    def test_identity_plan_is_local_and_free(self):
+        plan = plan_redistribute(
+            (16, 8), np.float32, (MESHES["dp8"], ("dp", None)),
+            (MESHES["dp8"], ("dp", None)))
+        assert {op.kind for op in plan.chunks} == {"local"}
+        assert plan.wire_bytes() == 0
+
+    def test_shrink_credits_surviving_device_overlap(self):
+        # 8-dev shard-on-dim1 -> 4-dev: surviving device j keeps its old
+        # window as a subset of its new one, so wire bytes are strictly
+        # less than the naive "every dst shard fully fetched"
+        plan = plan_redistribute(
+            (16, 8), np.float32, (MESHES["dp8"], (None, "dp")),
+            (MESHES["dp4"], (None, "dp")))
+        naive = 4 * 16 * 2 * 4  # 4 dst shards x [16,2] x f32
+        assert 0 < plan.wire_bytes() < naive
+
+    def test_classification(self):
+        def kind(src, dst):
+            p = plan_redistribute((16, 8), np.float32,
+                                  (MESHES[src[0]], src[1]),
+                                  (MESHES[dst[0]], dst[1]))
+            kinds = {op.kind for op in p.chunks}
+            assert len(kinds) == 1
+            return kinds.pop()
+
+        assert kind(("dp8", ("dp", None)), ("dp4", ("dp", None))) == \
+            "all_gather"          # coarsen: subgroup gather
+        assert kind(("dp4", ("dp", None)), ("dp8", ("dp", None))) == \
+            "all_to_all"          # refine: split
+        assert kind(("dp8", ("dp", None)), ("dp8", (None, "dp"))) == \
+            "all_to_all"          # repartition across dims
+        assert kind(("dp8", ("dp", None)), ("dp8", (None, None))) == \
+            "all_gather"          # sharded -> replicated
+        assert kind(("dp8", (None, None)), ("dp8", ("dp", None))) == \
+            "slice"               # replicated source: all local slices
+
+    def test_gather_host_plan(self):
+        plan = plan_redistribute((16, 8), np.float32,
+                                 (MESHES["dp8"], ("dp", None)), (HOST, ()))
+        assert {op.kind for op in plan.chunks} == {"gather_host"}
+        assert plan.dst_shard_bytes == plan.global_bytes()
+        assert plan.peak_live_bytes() <= plan.chunked_bound()
+
+    def test_scalar_plan(self):
+        plan = plan_redistribute((), np.float32,
+                                 (MESHES["dp8"], ()), (MESHES["dp4"], ()))
+        assert len(plan.chunks) == 1
+        assert plan.peak_live_bytes() <= plan.chunked_bound()
+
+
+class TestCost:
+    def test_cost_monotone_in_wire_bytes_and_chunks(self):
+        from easydist_tpu.autoflow.cost_model import MeshAxisSpec
+
+        axis = MeshAxisSpec("reshard", 8)
+        src = (MESHES["dp8"], ("dp", None))
+        small = plan_redistribute((16, 8), np.float32, src,
+                                  (MESHES["dp4"], ("dp", None)))
+        big = plan_redistribute((64, 8), np.float32, src,
+                                (MESHES["dp4"], ("dp", None)))
+        assert 0.0 < small.cost_s(axis) <= big.cost_s(axis)
+        # chunking adds latency terms, never removes them
+        chunky = plan_redistribute((64, 8), np.float32, src,
+                                   (MESHES["dp4"], ("dp", None)),
+                                   chunk_bytes=64)
+        assert chunky.cost_s(axis) >= big.cost_s(axis)
+
+    def test_local_plan_costs_nothing(self):
+        plan = plan_redistribute((16, 8), np.float32,
+                                 (MESHES["dp8"], ("dp", None)),
+                                 (MESHES["dp8"], ("dp", None)))
+        assert plan.cost_s() == 0.0
+
+    def test_summary_is_json_plain(self):
+        import json
+
+        plan = plan_redistribute((16, 8), np.float32,
+                                 (MESHES["dp8"], ("dp", None)),
+                                 (MESHES["dp4"], ("dp", None)))
+        s = plan.summary()
+        assert json.loads(json.dumps(s)) == s
+        assert s["n_chunks"] == len(plan.chunks)
+
+
+class TestShardBytes:
+    def test_max_shard_bytes_uneven(self):
+        # 7 rows over 4 parts: ceil block 2 -> biggest shard 2 rows
+        assert max_shard_bytes((7, 3), 4, MESHES["dp4"],
+                               ("dp", None)) == 2 * 3 * 4
+
+    def test_window_bytes_empty(self):
+        assert window_bytes(((4, 4), (0, 3)), 4) == 0

@@ -41,7 +41,10 @@ def test_commit_protocol_layout(tmp_path):
     with open(os.path.join(final, MANIFEST_NAME)) as f:
         manifest = json.load(f)
     assert manifest["step"] == 7
-    assert manifest["meta"] == {"batches_consumed": 7}
+    assert manifest["meta"]["batches_consumed"] == 7
+    # the SAVE-time mesh fingerprint rides in the manifest meta
+    assert manifest["meta"]["mesh"]["format"] == 1
+    assert manifest["meta"]["mesh"]["n_devices"] >= 1
     # every data file is checksummed
     assert manifest["files"]
     for rel, want in manifest["files"].items():
@@ -49,7 +52,7 @@ def test_commit_protocol_layout(tmp_path):
         assert want["bytes"] == os.path.getsize(os.path.join(final, rel))
     assert latest_step(root) == 7
     assert verify_checkpoint(final) == []
-    assert checkpoint_meta(root, 7) == {"batches_consumed": 7}
+    assert checkpoint_meta(root, 7)["batches_consumed"] == 7
 
 
 def test_partial_write_is_invisible(tmp_path):
@@ -77,7 +80,7 @@ def test_corrupt_newest_falls_back(tmp_path):
     # ... but load falls back to the last verifiable step
     state, step, meta = load_checkpoint(root, _state(99), with_meta=True)
     assert step == 3
-    assert meta == {"batches_consumed": 3}
+    assert meta["batches_consumed"] == 3
     assert _bitwise_equal(state, _state(0))
     # asking for the corrupt step EXPLICITLY must refuse, not substitute
     with pytest.raises(CheckpointCorruptionError):

@@ -136,6 +136,144 @@ def test_recover_step_nan_grad(tmp_path):
     assert guard["skips"] == 1 and guard["steps"] == TOTAL
 
 
+# ---------------------------------------- elastic topology-shift axis
+#
+# The changed-device-count leg of the matrix: train with the state
+# STORED sharded over an 8-device mesh, lose half the slice, resume on
+# the surviving 4, grow back — every leg must recover to the bitwise
+# reference.  Compute runs as ONE fixed single-device program (GSPMD
+# would re-partition "replicated" compute differently per device count,
+# breaking bitwise parity), so only the storage layout — the thing the
+# reshard substrate owns — changes across mesh sizes.
+
+ELASTIC_TOTAL = 6
+
+
+@jax.jit
+def _elastic_math(w, x, y):
+    loss, g = jax.value_and_grad(
+        lambda v: jnp.mean((x @ v - y) ** 2))(w)
+    return w - 0.1 * g, loss
+
+
+def _elastic_setup(n_dev):
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("dp",))
+    store = NamedSharding(mesh, P(None, "dp"))
+
+    def init_w():
+        return jax.device_put(jnp.zeros((4, 8), jnp.float32), store)
+
+    def step(w, x, y):
+        w1, loss = _elastic_math(jnp.asarray(jax.device_get(w)), x, y)
+        return jax.device_put(w1, store), loss
+
+    return init_w, step
+
+
+class ELoader(Loader):
+    def __next__(self):
+        i = self.batches_consumed
+        self.batches_consumed += 1
+        kx, ky = jax.random.split(jax.random.PRNGKey(i))
+        return (jax.random.normal(kx, (8, 4)),
+                jax.random.normal(ky, (8, 8)))
+
+
+def _erun(ckpt_dir, n_dev, **kw):
+    init_w, step = _elastic_setup(n_dev)
+    return run_training(step, init_w, ELoader(), str(ckpt_dir),
+                        ELASTIC_TOTAL, checkpoint_every=2, **kw)
+
+
+@pytest.fixture(scope="module")
+def elastic_baseline(tmp_path_factory):
+    final = _erun(tmp_path_factory.mktemp("elastic_baseline"), 8)
+    return _bits(final)
+
+
+def test_recover_elastic_shrink_8_to_4(tmp_path, elastic_baseline):
+    from easydist_tpu.runtime.checkpoint import last_restore_report
+
+    with faultinject.fault_plan("elastic.mesh.shrink@4"):
+        with pytest.raises(PreemptedError):
+            _erun(tmp_path, 8)
+        assert faultinject.unfired() == []
+    # the manifest carries the SAVE-time mesh fingerprint
+    meta = checkpoint_meta(str(tmp_path), latest_step(str(tmp_path)))
+    assert meta["mesh"]["n_devices"] == 8
+    leaf = [e for e in meta["mesh"]["leaves"] if e["kind"] == "array"][0]
+    assert leaf["spec"] == [None, "dp"]
+    # restart on HALF the mesh: bitwise-identical to the 8-device run
+    final = _erun(tmp_path, 4)
+    assert _bits(final) == elastic_baseline
+    report = last_restore_report()
+    assert report["topology_shift"] and report["n_planned"] >= 1
+    assert report["reshard_findings"] == 0
+    assert 0 < report["peak_live_bytes"] <= report["chunked_bound"]
+
+
+def test_recover_elastic_grow_4_to_8(tmp_path, elastic_baseline):
+    from easydist_tpu.runtime.checkpoint import last_restore_report
+
+    with faultinject.fault_plan("preempt.sigterm@4"):
+        with pytest.raises(PreemptedError):
+            _erun(tmp_path, 4)
+    final = _erun(tmp_path, 8)
+    assert _bits(final) == elastic_baseline
+    report = last_restore_report()
+    assert report["topology_shift"] and report["reshard_findings"] == 0
+
+
+def test_recover_elastic_restore_chunk_corrupt(tmp_path, elastic_baseline):
+    # newest checkpoint's data rots while the restore reads it: fall
+    # back one committed step, replay, still land bitwise — on 4 devices
+    with faultinject.fault_plan("elastic.mesh.shrink@4"):
+        with pytest.raises(PreemptedError):
+            _erun(tmp_path, 8)
+    newest = latest_step(str(tmp_path))
+    with faultinject.fault_plan("elastic.restore.chunk_corrupt@1"):
+        final = _erun(tmp_path, 4)
+        assert faultinject.unfired() == []
+    assert _bits(final) == elastic_baseline
+    # the corrupt newest checkpoint was skipped, then re-passed on the
+    # way to ELASTIC_TOTAL
+    assert latest_step(str(tmp_path)) == ELASTIC_TOTAL
+    assert newest < ELASTIC_TOTAL
+
+
+def test_recover_elastic_restore_oom(tmp_path, elastic_baseline):
+    # the chunked restore "OOMs" once: halve chunk_bytes, re-plan, land
+    from easydist_tpu import config as edconfig
+    from easydist_tpu.runtime.checkpoint import last_restore_report
+
+    _erun(tmp_path, 4)  # a completed 4-device run to grow out of
+    with faultinject.fault_plan("elastic.restore.oom@1"):
+        final = _erun(tmp_path, 8)  # restore-only resume at step 6
+        assert faultinject.unfired() == []
+    assert _bits(final) == elastic_baseline
+    report = last_restore_report()
+    assert report["chunk_bytes"] == edconfig.reshard_chunk_bytes // 2
+    assert report["reshard_findings"] == 0
+
+
+def test_legacy_cursor_resume_warns_loudly(tmp_path, caplog):
+    import logging
+
+    from easydist_tpu.runtime.checkpoint import save_checkpoint
+
+    # a checkpoint WITHOUT the manifest data cursor (what an old build
+    # wrote): resume must fall back to steps==batches and say so
+    init_w, _step = _elastic_setup(8)
+    save_checkpoint(str(tmp_path), init_w(), step=2)
+    with caplog.at_level(logging.WARNING,
+                         logger="easydist_tpu.runtime.elastic"):
+        _erun(tmp_path, 8)
+    assert any("steps==batches" in r.message for r in caplog.records)
+
+
 def _echo_engine(**cfg_kw):
     from easydist_tpu.serve import ServeConfig, ServeEngine
 
