@@ -55,7 +55,17 @@ A rule-based analyzer that runs after solving and before execution
            O(max(src_shard, dst_shard) + chunk) bound (silent
            degeneration to global materialization — the elastic-restore
            OOM), RESHARD002 a restored leaf whose sharding disagrees
-           with the restore template's spec.
+           with the restore template's spec;
+  layer 9  simulator/autoscaler auditor (`audit_prediction`,
+           `audit_scale_decisions`, analyze/sim_rules.py) — SIM001 a
+           simulator prediction whose relative error against a measured
+           bench actual exceeds the committed bound
+           (sim.simulate.SIM_REL_ERROR_BOUND) — the capacity planner
+           and autoscaler would steer the fleet on numbers the hardware
+           no longer agrees with; SIM002 autoscaler flap — opposite-
+           direction scale actuations inside the hysteresis window (an
+           A-B-A oscillation), each reversal paying a drain +
+           page-migration + spin-up round trip for nothing.
 
 Surfaced via `CompiledFunction.analyze()`, `bench.py --analyze`, and the
 dryrun gate; findings export through the runtime PerfDB under
@@ -86,6 +96,7 @@ from .schedule_rules import (gpipe_schedule_tables, schedule_stats,
                              verify_schedule_tables)
 from .serve_rules import (audit_chunked_prefill, audit_decode_donation,
                           audit_prefix_cache, audit_speculative_rewind)
+from .sim_rules import audit_prediction, audit_scale_decisions
 from .strategy_rules import audit_solver_objective, verify_axis
 
 logger = logging.getLogger(__name__)
@@ -112,6 +123,8 @@ __all__ = [
     "audit_page_table", "check_page_table",
     "audit_reshard_plan", "audit_restored_state",
     "check_reshard_plan", "check_restored_state",
+    "audit_prediction", "audit_scale_decisions",
+    "check_sim_prediction", "check_sim_autoscale",
 ]
 
 
@@ -347,6 +360,38 @@ def check_resume_descriptor(descriptor, resume_prompt=None,
     from easydist_tpu import config as edconfig
 
     findings = audit_resume(descriptor, resume_prompt, node=node)
+    report = AnalysisReport(findings)
+    if report.errors() and edconfig.analyze_raise:
+        report.raise_on_errors()
+    for f in findings:
+        logger.warning("[analyze] %s", f)
+    return findings
+
+
+def check_sim_prediction(rows, bound=None, node: str = "sim"):
+    """Validation hook for `bench.py --simulate`: SIM001 (a prediction
+    row's relative error exceeds the committed bound) raises under
+    `analyze_raise` — a fleet steered on drifted predictions is the
+    failure the simulator gate exists to catch.  Returns the findings."""
+    from easydist_tpu import config as edconfig
+
+    findings = audit_prediction(rows, bound=bound, node=node)
+    report = AnalysisReport(findings)
+    if report.errors() and edconfig.analyze_raise:
+        report.raise_on_errors()
+    for f in findings:
+        logger.warning("[analyze] %s", f)
+    return findings
+
+
+def check_sim_autoscale(decisions, window=None, node: str = "autoscale"):
+    """Post-drill hook for `bench.py --autoscale`: SIM002 (opposite
+    scale actuations inside the hysteresis window — an A-B-A flap)
+    raises under `analyze_raise` over the autoscaler's decision log.
+    Returns the findings."""
+    from easydist_tpu import config as edconfig
+
+    findings = audit_scale_decisions(decisions, window=window, node=node)
     report = AnalysisReport(findings)
     if report.errors() and edconfig.analyze_raise:
         report.raise_on_errors()

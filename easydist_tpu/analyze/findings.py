@@ -180,6 +180,21 @@ RULES: Dict[str, tuple] = {
                    "(or OOM re-gathering) every step, and a replicated "
                    "leaf that should be sharded holds n_devices x its "
                    "byte budget"),
+    # ---- layer 9: simulator/autoscaler auditor (prediction fidelity +
+    #      control-loop stability, analyze/sim_rules.py)
+    "SIM001": (SEV_ERROR,
+               "simulator prediction drifted beyond the committed "
+               "relative-error bound against a measured bench actual — "
+               "the capacity planner and autoscaler are steering the "
+               "fleet on numbers the hardware no longer agrees with "
+               "(stale calibration, an uncalibrated residual domain, or "
+               "a cost-model regression)"),
+    "SIM002": (SEV_ERROR,
+               "autoscaler flap: opposite-direction scale actuations "
+               "inside the hysteresis window (an A-B-A oscillation) — "
+               "each reversal pays a drain + page-migration + spin-up "
+               "round trip for zero steady-state change, so the "
+               "confirm/cooldown gates are mis-tuned or bypassed"),
 }
 
 

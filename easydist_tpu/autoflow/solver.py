@@ -52,12 +52,11 @@ def _cached_op_times() -> Dict[str, float]:
     """PerfDB op-time table, reloaded only when the DB file changes (the
     solver runs once per mesh axis per compile).  Thread-safe."""
     global _op_times_cache
-    import os
+    from easydist_tpu.runtime.perfdb import db_mtime
 
     path = edconfig.prof_db_path
-    try:
-        mtime = os.path.getmtime(path)
-    except OSError:
+    mtime = db_mtime(path)
+    if mtime is None:
         return {}
     key = (path, mtime)
     with _op_times_lock:

@@ -63,6 +63,9 @@ FAULT_POINTS = frozenset({
     "fleet.transport.stall",  # KV page transfer attempt hangs past budget
     "fleet.transport.page_corrupt",  # bit flip in a page in flight
     "fleet.probe.flap",       # health probe falsely reports no progress
+    # SLO autoscaler (sim/autoscale.py)
+    "autoscale.metrics.stale",   # planner sees frozen occupancy/p99
+    "autoscale.scaleup.fail",    # replica spin-up raises mid-ramp
 })
 
 

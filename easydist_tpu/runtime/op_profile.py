@@ -43,8 +43,7 @@ def load_op_times() -> Dict[str, float]:
     from .perfdb import PerfDB
 
     try:
-        db = PerfDB()
-        return dict(db._db.get(backend_key(), {}))
+        return dict(PerfDB().snapshot().get(backend_key(), {}))
     except Exception:
         return {}
 

@@ -3,9 +3,11 @@ graph_profile_db.py:24-48 — pickle at ~/.easydist/perf.db)."""
 
 from __future__ import annotations
 
+import copy
 import os
 import pickle
-from typing import Any, Optional
+import threading
+from typing import Any, Dict, Optional
 
 from easydist_tpu import config as edconfig
 
@@ -14,6 +16,7 @@ class PerfDB:
 
     def __init__(self, path: Optional[str] = None):
         self.path = path or edconfig.prof_db_path
+        self._lock = threading.RLock()
         self._db = {}
         if os.path.exists(self.path):
             try:
@@ -23,23 +26,55 @@ class PerfDB:
                 self._db = {}
 
     def get_op_perf(self, key: str, sub_key: str) -> Optional[Any]:
-        return self._db.get(key, {}).get(sub_key)
+        with self._lock:
+            return self._db.get(key, {}).get(sub_key)
 
     def record_op_perf(self, key: str, sub_key: str, value: Any) -> None:
-        self._db.setdefault(key, {})[sub_key] = value
+        with self._lock:
+            self._db.setdefault(key, {})[sub_key] = value
 
     def append_history(self, key: str, sub_key: str, entry: Any,
                        cap: int = 32) -> None:
         """Append `entry` to a bounded history list under (key, sub_key) —
         the shape serving metrics and fleet gauges use, so N writers keep
         rolling windows instead of clobbering one value."""
-        hist = self.get_op_perf(key, sub_key) or []
-        self.record_op_perf(key, sub_key, (list(hist) + [entry])[-cap:])
+        with self._lock:
+            hist = self._db.get(key, {}).get(sub_key) or []
+            self._db.setdefault(key, {})[sub_key] = \
+                (list(hist) + [entry])[-cap:]
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Read-only export of the whole store as a deep-copied plain dict
+        ({key: {sub_key: value}}).  The consumer owns the copy — mutating
+        it never touches the live store, and concurrent writers (serving
+        metrics exporters, calibration) never tear a read mid-walk.  This
+        is how the simulator/planner consume calibration and metrics
+        without reaching into `_db` or re-reading the pickle path."""
+        with self._lock:
+            return copy.deepcopy(self._db)
+
+    def source_mtime(self) -> Optional[float]:
+        """mtime of the backing pickle, or None when it does not exist —
+        the cache-invalidation key callers use instead of re-deriving the
+        path from config themselves."""
+        return db_mtime(self.path)
 
     def persist(self) -> None:
-        os.makedirs(os.path.dirname(self.path), exist_ok=True)
-        with open(self.path, "wb") as f:
-            pickle.dump(self._db, f)
+        with self._lock:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            with open(self.path, "wb") as f:
+                pickle.dump(self._db, f)
 
     def __len__(self) -> int:
-        return sum(len(v) for v in self._db.values())
+        with self._lock:
+            return sum(len(v) for v in self._db.values())
+
+
+def db_mtime(path: Optional[str] = None) -> Optional[float]:
+    """mtime of the (default) PerfDB pickle without loading it — the
+    cheap staleness probe cache invalidators key on (autoflow.solver's
+    op-time cache)."""
+    try:
+        return os.path.getmtime(path or edconfig.prof_db_path)
+    except OSError:
+        return None

@@ -1,0 +1,46 @@
+"""Calibrated performance simulator, capacity planner, and SLO
+autoscaler (docs/SIMULATOR.md).
+
+Three connected layers over one cost vocabulary:
+
+  `sim.simulate`   discrete-event replay of a solved MetaIR graph — op
+                   times from the PerfDB op-profile/calibrate datasheet,
+                   collective times and overlap discounts from
+                   `autoflow.cost_model`, pipeline schedules replayed
+                   from the 1F1B tick tables.  Predicts train step time,
+                   decode tokens/s at a given occupancy, and TTFT under
+                   chunked prefill, validated against `bench.py` actuals
+                   within `SIM_REL_ERROR_BOUND`.
+  `sim.capacity`   MeshDesc + TrafficSpec + SLO -> ranked replica plans
+                   through the simulator plus an open-loop queueing
+                   layer over the router's least-loaded dispatch.
+  `sim.autoscale`  the control loop: ServeMetrics occupancy/p99 via
+                   PerfDB snapshots, planner target with hysteresis,
+                   FleetRouter drain / replica spin-up actuation.
+
+Layer-9 analyze rules audit the whole stack: SIM001 (prediction drift
+beyond the committed bound) and SIM002 (autoscale flap/oscillation).
+"""
+
+from .autoscale import Autoscaler, AutoscaleConfig, MetricsView
+from .capacity import (SLO, CapacityPlan, CapacityPlanner, ReplicaProfile,
+                       TrafficSpec)
+from .events import Event, EventLog, ServerPool, Stream, percentile
+from .simulate import (RESIDUAL_KEY, SIM_REL_ERROR_BOUND, OpTimeTable,
+                       SimReport, load_residual, predict_decode_throughput,
+                       predict_fn_seconds, predict_pipeline_step,
+                       predict_ttft, relative_error, replay_graph,
+                       simulate_pipeline, simulate_train_step,
+                       store_residual)
+
+__all__ = [
+    "Autoscaler", "AutoscaleConfig", "MetricsView",
+    "SLO", "CapacityPlan", "CapacityPlanner", "ReplicaProfile",
+    "TrafficSpec",
+    "Event", "EventLog", "ServerPool", "Stream", "percentile",
+    "RESIDUAL_KEY", "SIM_REL_ERROR_BOUND", "OpTimeTable", "SimReport",
+    "load_residual", "predict_decode_throughput", "predict_fn_seconds",
+    "predict_pipeline_step", "predict_ttft", "relative_error",
+    "replay_graph", "simulate_pipeline", "simulate_train_step",
+    "store_residual",
+]

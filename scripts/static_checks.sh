@@ -420,5 +420,97 @@ else
     echo "static_checks: jax not importable; skipping bench.py --speculate"
 fi
 
+# simulator-validation gate: every held-out validation preset's predicted
+# time must land within the committed relative-error bound of the bench
+# actual measured on THIS host (calibration presets fit the per-domain
+# residual and are excluded), with zero SIM001 analyze findings
+if python -c "import jax" >/dev/null 2>&1; then
+    echo "== bench.py --simulate (calibrated-simulator validation gate)"
+    out=$(python bench.py --simulate 2>/dev/null) || rc=1
+    echo "$out"
+    verdict=$(python - "$out" <<'PYEOF'
+import json, sys
+try:
+    r = json.loads(sys.argv[1].strip().splitlines()[-1])
+    if "error" in r:
+        print("error: " + r["error"])
+    elif r.get("value", 0) < r.get("n_validation_presets", 4):
+        print(f"only {r.get('value')}/{r.get('n_validation_presets')} "
+              f"validation presets within the "
+              f"{r.get('rel_error_bound')} bound "
+              f"(worst rel err {r.get('worst_rel_error')})")
+    elif r.get("sim_findings", 1) != 0:
+        print(f"{r.get('sim_findings')} SIM001 finding(s) on the "
+              f"validation rows")
+    elif r.get("perf_regression"):
+        print(f"committed-floor regression: {r.get('value')} is >10% below "
+              f"last-good {r.get('last_good_value')}")
+    else:
+        print("ok")
+except Exception as e:
+    print(f"unparseable: {e}")
+PYEOF
+)
+    if [ "$verdict" != "ok" ]; then
+        echo "static_checks: simulate gate failed ($verdict)"
+        rc=1
+    fi
+else
+    echo "static_checks: jax not importable; skipping bench.py --simulate"
+fi
+
+# autoscale ramp-drill gate: the deterministic ramp-up/hold/ramp-down
+# drill must drop zero requests, keep committed tokens bitwise-identical
+# to the fixed-fleet reference, converge each phase to the capacity
+# planner's independently computed target, log zero SIM002 flap
+# findings, and degrade gracefully (hold + loud warning, still zero
+# drops, still bitwise) under both catalogued autoscale fault points
+if python -c "import jax" >/dev/null 2>&1; then
+    echo "== bench.py --autoscale (SLO-autoscaler ramp drill gate)"
+    out=$(python bench.py --autoscale 2>/dev/null) || rc=1
+    echo "$out"
+    verdict=$(python - "$out" <<'PYEOF'
+import json, sys
+try:
+    r = json.loads(sys.argv[1].strip().splitlines()[-1])
+    if "error" in r:
+        print("error: " + r["error"])
+    elif r.get("dropped_requests", 1) != 0:
+        print(f"ramp drill dropped {r.get('dropped_requests')} request(s)")
+    elif not r.get("parity_bitwise"):
+        print("scaled-fleet ids diverge from the fixed-fleet run")
+    elif not r.get("targets_match_planner"):
+        print(f"phase replica counts {r.get('phase_replicas')} do not "
+              f"match planner targets (high={r.get('planner_target_high')}"
+              f", low={r.get('planner_target_low')})")
+    elif r.get("flap_findings", 1) != 0:
+        print(f"{r.get('flap_findings')} SIM002 flap finding(s) in the "
+              f"decision log")
+    elif not (r.get("stale_arm", {}).get("drops", 1) == 0
+              and r.get("stale_arm", {}).get("bitwise")):
+        print(f"stale-metrics arm degraded unsafely: {r.get('stale_arm')}")
+    elif not (r.get("scaleup_fail_arm", {}).get("drops", 1) == 0
+              and r.get("scaleup_fail_arm", {}).get("bitwise")):
+        print("scale-up-failure arm degraded unsafely: "
+              f"{r.get('scaleup_fail_arm')}")
+    elif r.get("value", 0) != 1.0:
+        print(f"ramp survival {r.get('value')} != 1.0")
+    elif r.get("perf_regression"):
+        print(f"committed-floor regression: {r.get('value')} is >10% below "
+              f"last-good {r.get('last_good_value')}")
+    else:
+        print("ok")
+except Exception as e:
+    print(f"unparseable: {e}")
+PYEOF
+)
+    if [ "$verdict" != "ok" ]; then
+        echo "static_checks: autoscale gate failed ($verdict)"
+        rc=1
+    fi
+else
+    echo "static_checks: jax not importable; skipping bench.py --autoscale"
+fi
+
 [ "$ran" = 0 ] && echo "static_checks: no external linters ran (configs still validated by CI tests)"
 exit $rc

@@ -1,0 +1,104 @@
+"""Layer 9 simulator/autoscaler auditor goldens: SIM001 (prediction
+drift beyond the committed bound), SIM002 (autoscale flap inside the
+hysteresis window).  Each known-bad fixture fires its rule exactly once;
+each clean fixture yields zero findings."""
+
+import pytest
+
+from easydist_tpu.analyze import (audit_prediction, audit_scale_decisions,
+                                  check_sim_autoscale, check_sim_prediction)
+from easydist_tpu.analyze.findings import AnalysisError
+
+
+def _row(preset="gpt_train", predicted=1.0, measured=1.0):
+    return {"preset": preset, "predicted_s": predicted,
+            "measured_s": measured}
+
+
+class TestSIM001:
+    def test_clean_rows_no_findings(self):
+        rows = [_row(predicted=1.05, measured=1.0),
+                _row("llama_train", 0.9, 1.0)]
+        assert audit_prediction(rows, bound=0.25) == []
+
+    def test_drift_fires_exactly_once(self):
+        rows = [_row(predicted=1.0, measured=1.0),
+                _row("llama_train", predicted=2.0, measured=1.0)]
+        findings = audit_prediction(rows, bound=0.25)
+        assert len(findings) == 1
+        f = findings[0]
+        assert f.rule_id == "SIM001" and f.severity == "error"
+        assert "llama_train" in f.node
+        assert "bound" in f.message
+
+    def test_default_bound_is_the_committed_one(self):
+        from easydist_tpu.sim import SIM_REL_ERROR_BOUND
+
+        just_inside = 1.0 + SIM_REL_ERROR_BOUND - 1e-6
+        just_outside = 1.0 + SIM_REL_ERROR_BOUND + 1e-3
+        assert audit_prediction([_row(predicted=just_inside)]) == []
+        assert len(audit_prediction([_row(predicted=just_outside)])) == 1
+
+    def test_unmeasured_preset_fires(self):
+        # a preset without a usable measurement was never validated
+        for bad in (_row(measured=0.0), _row(measured=None),
+                    {"preset": "x", "predicted_s": 1.0}):
+            findings = audit_prediction([bad], bound=0.5)
+            assert len(findings) == 1
+            assert findings[0].rule_id == "SIM001"
+
+    def test_hook_raises_under_analyze_raise(self):
+        with pytest.raises(AnalysisError, match="SIM001"):
+            check_sim_prediction([_row(predicted=10.0, measured=1.0)],
+                                 bound=0.25)
+
+
+def _d(tick, action, **kw):
+    return {"tick": tick, "action": action, **kw}
+
+
+class TestSIM002:
+    def test_clean_log_no_findings(self):
+        log = [_d(1, "hold"), _d(2, "scale_up"), _d(3, "hold"),
+               _d(9, "scale_down")]  # reversal outside the window
+        assert audit_scale_decisions(log, window=4) == []
+
+    def test_same_direction_is_not_a_flap(self):
+        log = [_d(1, "scale_up"), _d(2, "scale_up"), _d(3, "scale_up")]
+        assert audit_scale_decisions(log, window=4) == []
+
+    def test_flap_fires_exactly_once(self):
+        log = [_d(2, "scale_up"), _d(4, "scale_down"), _d(12, "hold")]
+        findings = audit_scale_decisions(log, window=4)
+        assert len(findings) == 1
+        f = findings[0]
+        assert f.rule_id == "SIM002" and f.severity == "error"
+        assert "tick[4]" in f.node
+        assert "reverses" in f.message
+
+    def test_window_boundary_is_legitimate(self):
+        # the gates guarantee a gap of >= window; exactly window is the
+        # earliest legal reversal, one tick less is a flap
+        at_window = [_d(2, "scale_up"), _d(6, "scale_down")]
+        inside = [_d(2, "scale_up"), _d(5, "scale_down")]
+        assert audit_scale_decisions(at_window, window=4) == []
+        assert len(audit_scale_decisions(inside, window=4)) == 1
+
+    def test_aba_sequence_fires_per_reversal(self):
+        log = [_d(1, "scale_up"), _d(2, "scale_down"), _d(3, "scale_up")]
+        assert len(audit_scale_decisions(log, window=4)) == 2
+
+    def test_default_window_matches_autoscale_config(self):
+        from easydist_tpu.sim import AutoscaleConfig
+
+        cfg = AutoscaleConfig()
+        gap = cfg.confirm_evals + cfg.cooldown_evals
+        flap = [_d(2, "scale_up"), _d(2 + gap - 1, "scale_down")]
+        legal = [_d(2, "scale_up"), _d(2 + gap, "scale_down")]
+        assert len(audit_scale_decisions(flap)) == 1
+        assert audit_scale_decisions(legal) == []
+
+    def test_hook_raises_under_analyze_raise(self):
+        with pytest.raises(AnalysisError, match="SIM002"):
+            check_sim_autoscale([_d(1, "scale_up"), _d(2, "scale_down")],
+                                window=4)

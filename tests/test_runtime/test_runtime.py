@@ -56,6 +56,77 @@ def test_perfdb_roundtrip(tmp_path):
     assert len(db2) == 1
 
 
+def test_perfdb_snapshot_is_deep_copied(tmp_path):
+    """The consumer owns the snapshot: mutating it (even nested values)
+    never touches the live store."""
+    db = PerfDB(path=str(tmp_path / "perf.db"))
+    db.record_op_perf("cal", "cpu", {"hbm_bandwidth": 1e9})
+    db.append_history("serving", "engine[d0]", {"gauges": {"occ": 0.5}})
+    snap = db.snapshot()
+    snap["cal"]["cpu"]["hbm_bandwidth"] = -1.0
+    snap["serving"]["engine[d0]"][0]["gauges"]["occ"] = 9.9
+    snap["new_key"] = {"x": 1}
+    assert db.get_op_perf("cal", "cpu") == {"hbm_bandwidth": 1e9}
+    assert db.get_op_perf("serving", "engine[d0]") == \
+        [{"gauges": {"occ": 0.5}}]
+    assert "new_key" not in db.snapshot()
+
+
+def test_perfdb_snapshot_concurrent_with_writers(tmp_path):
+    """snapshot() under concurrent writers never tears: every exported
+    dict is internally consistent and walkable."""
+    import threading
+
+    db = PerfDB(path=str(tmp_path / "perf.db"))
+    stop = threading.Event()
+    errors = []
+
+    def writer(i):
+        n = 0
+        while not stop.is_set():
+            db.record_op_perf(f"k{i}", f"s{n % 7}", n)
+            db.append_history("hist", f"w{i}", {"n": n}, cap=8)
+            n += 1
+
+    def reader():
+        try:
+            while not stop.is_set():
+                snap = db.snapshot()
+                for key, subs in snap.items():
+                    for sub_key, val in subs.items():
+                        _ = (key, sub_key, val)
+        except Exception as e:  # pragma: no cover - the failure signal
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(3)] + [threading.Thread(target=reader)
+                                     for _ in range(2)]
+    for t in threads:
+        t.start()
+    import time as _time
+
+    _time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert errors == []
+    assert all(len(db.snapshot().get("hist", {}).get(f"w{i}", [])) <= 8
+               for i in range(3))
+
+
+def test_perfdb_mtime_probe(tmp_path):
+    from easydist_tpu.runtime.perfdb import db_mtime
+
+    path = str(tmp_path / "perf.db")
+    assert db_mtime(path) is None
+    db = PerfDB(path=path)
+    assert db.source_mtime() is None
+    db.record_op_perf("k", "s", 1)
+    db.persist()
+    assert db_mtime(path) == db.source_mtime()
+    assert isinstance(db.source_mtime(), float)
+
+
 def test_cost_and_memory_analysis():
     fn = jax.jit(lambda x: (x @ x).sum())
     compiled = fn.lower(jnp.ones((64, 64))).compile()

@@ -1,0 +1,112 @@
+"""Capacity planner: deterministic traffic traces, service-model
+arithmetic, sweep ranking, and target monotonicity in load."""
+
+import pytest
+
+from easydist_tpu.reshard.plan import MeshDesc
+from easydist_tpu.sim import (SLO, CapacityPlanner, ReplicaProfile,
+                              TrafficSpec)
+
+PROFILE = ReplicaProfile(per_token_s=0.01, chunk_s=0.05, chunk_tokens=16,
+                         n_slots=4, chips=1)
+MESH = MeshDesc(axis_names=("replica",), axis_sizes=(4,))
+
+
+def _planner(**kw):
+    kw.setdefault("n_requests", 256)
+    kw.setdefault("seed", 0)
+    return CapacityPlanner(PROFILE, MESH, **kw)
+
+
+class TestTrafficSpec:
+    def test_sample_is_deterministic(self):
+        spec = TrafficSpec(req_per_s=10.0, prompt_lens=(16, 64),
+                           output_lens=(8,), prefix_reuse=0.5)
+        assert spec.sample(50, seed=3) == spec.sample(50, seed=3)
+        assert spec.sample(50, seed=3) != spec.sample(50, seed=4)
+
+    def test_sample_shapes(self):
+        spec = TrafficSpec(req_per_s=5.0, prompt_lens=(32,),
+                           output_lens=(4,))
+        trace = spec.sample(20)
+        arrivals = [a for a, _, _, _ in trace]
+        assert arrivals == sorted(arrivals)
+        assert all(p == 32 and o == 4 and hit is False
+                   for _, p, o, hit in trace)
+
+    def test_nonpositive_rate_rejected(self):
+        with pytest.raises(ValueError):
+            TrafficSpec(req_per_s=0.0).sample(1)
+
+
+class TestReplicaProfile:
+    def test_prefill_chunks(self):
+        assert PROFILE.prefill_chunks(16, False) == 1
+        assert PROFILE.prefill_chunks(17, False) == 2
+        assert PROFILE.prefill_chunks(64, False) == 4
+        # a warm prefix leaves only the trailing chunk
+        assert PROFILE.prefill_chunks(64, True) == 1
+
+    def test_service_times(self):
+        # 2 chunks + first decode step
+        assert PROFILE.ttft_service_s(32, False) == \
+            pytest.approx(2 * 0.05 + 0.01)
+        assert PROFILE.decode_service_s(8) == pytest.approx(7 * 0.01)
+        assert PROFILE.decode_service_s(1) == 0.0
+
+
+class TestPlanner:
+    def test_sweep_ranks_feasible_cheapest_first(self):
+        traffic = TrafficSpec(req_per_s=4.0, prompt_lens=(32,),
+                              output_lens=(8,))
+        slo = SLO(ttft_p99_s=1.0, per_token_p99_s=0.05)
+        plans = _planner().plan(traffic, slo)
+        assert plans  # full sweep over the mesh
+        feasible = [p for p in plans if p.feasible]
+        assert feasible, "light load on a 4-replica mesh must fit"
+        # ranked: every feasible plan precedes every infeasible one, and
+        # the head of the list is the cheapest feasible configuration
+        first_infeasible = next((i for i, p in enumerate(plans)
+                                 if not p.feasible), len(plans))
+        assert all(p.feasible for p in plans[:first_infeasible])
+        assert plans[0].chips == min(p.chips for p in feasible)
+        assert _planner().min_feasible(traffic, slo).n_replicas == \
+            plans[0].n_replicas
+
+    def test_plan_is_deterministic(self):
+        traffic = TrafficSpec(req_per_s=6.0, prompt_lens=(32,),
+                              output_lens=(8,))
+        slo = SLO(ttft_p99_s=0.5, per_token_p99_s=0.05)
+        a = [p.as_dict() for p in _planner().plan(traffic, slo)]
+        b = [p.as_dict() for p in _planner().plan(traffic, slo)]
+        assert a == b
+
+    def test_target_monotone_in_load(self):
+        slo = SLO(ttft_p99_s=0.4, per_token_p99_s=0.05)
+        targets = [_planner().target_replicas(
+            TrafficSpec(req_per_s=r, prompt_lens=(32,), output_lens=(8,)),
+            slo) for r in (1.0, 8.0, 30.0)]
+        assert targets == sorted(targets)
+        assert targets[0] >= 1
+        assert targets[-1] <= _planner().max_replicas
+
+    def test_impossible_slo_pins_full_mesh(self):
+        traffic = TrafficSpec(req_per_s=5.0, prompt_lens=(64,),
+                              output_lens=(8,))
+        # per-token SLO below the replica's own step time: nothing fits
+        slo = SLO(ttft_p99_s=10.0, per_token_p99_s=PROFILE.per_token_s / 2)
+        planner = _planner()
+        assert planner.min_feasible(traffic, slo) is None
+        assert planner.target_replicas(traffic, slo) == \
+            planner.max_replicas
+
+    def test_split_must_keep_a_decode_replica(self):
+        traffic = TrafficSpec(req_per_s=1.0)
+        slo = SLO(ttft_p99_s=1.0, per_token_p99_s=1.0)
+        with pytest.raises(ValueError):
+            _planner().evaluate(2, traffic, slo, n_prefill=2)
+
+    def test_chips_bound_max_replicas(self):
+        fat = ReplicaProfile(per_token_s=0.01, chunk_s=0.05,
+                             chunk_tokens=16, n_slots=4, chips=2)
+        assert CapacityPlanner(fat, MESH).max_replicas == 2
