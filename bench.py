@@ -1003,6 +1003,8 @@ def analyze_main():
             db.persist()
         except Exception:
             pass
+        from easydist_tpu.jaxfront.discovery import GLOBAL_COUNTERS
+
         result.update({
             "value": counts["error"],
             "warnings": counts["warning"],
@@ -1011,6 +1013,10 @@ def analyze_main():
             "memory": memory,
             "schedule": sched,
             "solver_audit_max_delta": audit_max_delta,
+            # pruned-discovery counters accumulated over every compile
+            # this scenario ran (ISSUE 17: compile-time observability)
+            "discovery": {k: round(v, 3)
+                          for k, v in GLOBAL_COUNTERS.snapshot().items()},
             "n_chips": 8,
             "device": "host cpu (virtual 8-device mesh)",
         })
@@ -2633,6 +2639,174 @@ def simulate_main():
     print(json.dumps(result), flush=True)
 
 
+def discovery_main():
+    """Pruned ShardCombine discovery scenario (`--discovery`): measure
+    execution-discovery probe compiles across FOUR gpt recompiles (the
+    Automap story — elastic resizes and serving batch/seq variants retrace
+    the same network), three sweeps over the same traces:
+
+      baseline  seed behavior: no propagation groups, no batched probes,
+                no persistent cache (every eqn signature discovers alone)
+      cold      pruning + batching on, persistent cache on but EMPTY
+      warm      same cache dir again, fresh process-level cache instances
+                (disk round-trip — the second compile of a serving fleet)
+
+    Presets are OFF for all three sweeps so the gate isolates the
+    execution-discovery machinery itself (with the analytic bank on, both
+    sides shrink and the ratio measures the bank, not the pruning).
+
+    Gates: cold >= 5x fewer probes, warm >= 10x, and the variant-0
+    discovery rules AND solved per-axis strategies byte-identical between
+    baseline and pruned — pruning must never change what the solver picks.
+    Headline value (ratio_cold) lands in the committed floor file via
+    --update-last-good like the other CPU-deterministic scenarios."""
+    result = {"metric": "discovery_probe_reduction_cold", "value": 0,
+              "unit": "x"}
+    t_scn = time.perf_counter()
+    try:
+        import shutil
+        import tempfile
+
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+        from easydist_tpu import config as edconfig
+        from easydist_tpu.autoflow.cost_model import MeshAxisSpec
+        from easydist_tpu.jaxfront import discovery as disc
+        from easydist_tpu.jaxfront.api import solve_axes
+        from easydist_tpu.jaxfront.inline import inline_calls
+        from easydist_tpu.jaxfront.interpreter import ShardingAnalyzer
+        from easydist_tpu.metashard.metaop import probe_calls
+        from easydist_tpu.models import gpt
+
+        world = 8
+        # batch/seq variants chosen so no dim size aliases another role
+        # (dim=48, vocab=96: distinct from every batch and seq value)
+        variants = [(16, 64), (32, 64), (16, 128), (32, 128)]
+
+        def trace(b, s):
+            cfg = gpt.GPTConfig.tiny(vocab=96, seq=s, dim=48, heads=4,
+                                     layers=2)
+            params = gpt.gpt_init(cfg, jax.random.PRNGKey(0))
+            x = jax.random.randint(jax.random.PRNGKey(1), (b, s), 0,
+                                   cfg.vocab)
+            y = jax.random.randint(jax.random.PRNGKey(2), (b, s), 0,
+                                   cfg.vocab)
+            closed = jax.make_jaxpr(
+                lambda p, t, g: jax.value_and_grad(gpt.gpt_loss)(
+                    p, cfg, t, g))(params, x, y)
+            return inline_calls(closed)  # production inlines before analysis
+
+        traces = [trace(b, s) for b, s in variants]
+
+        _KNOBS = ("discovery_prune", "discovery_batch_probes",
+                  "discovery_persistent_cache", "discovery_cache_dir",
+                  "discovery_use_presets", "discovery_crosscheck")
+
+        def sweep(label, prune, batch, cache_dir):
+            saved = {k: getattr(edconfig, k) for k in _KNOBS}
+            edconfig.discovery_prune = prune
+            edconfig.discovery_batch_probes = batch
+            edconfig.discovery_persistent_cache = bool(cache_dir)
+            edconfig.discovery_cache_dir = cache_dir or ""
+            edconfig.discovery_use_presets = False
+            edconfig.discovery_crosscheck = False
+            disc.clear_cache_instances()
+            try:
+                totals = disc.DiscoveryCounters()
+                p0, t0 = probe_calls(), time.perf_counter()
+                first = None
+                for closed in traces:
+                    a = ShardingAnalyzer(closed, world_size=world)
+                    rules, shape_info = a.run()
+                    totals.merge(a.counters)
+                    if first is None:
+                        first = (closed, rules, shape_info, a.names)
+                wall = time.perf_counter() - t0
+                probes = probe_calls() - p0
+                log(f"# {label}: {probes} probes, {wall:.1f}s, "
+                    f"{totals.groups} groups, "
+                    f"{totals.rules_from_group} grouped, "
+                    f"{totals.rules_from_cache} cached")
+                return {"probes": probes, "wall": wall, "totals": totals,
+                        "first": first}
+            finally:
+                for k, v in saved.items():
+                    setattr(edconfig, k, v)
+
+        def strategies_of(first):
+            closed, rules, shape_info, names = first
+            per_axis, _ = solve_axes(closed, [MeshAxisSpec(name="d",
+                                                           size=world)],
+                                     world, rules, shape_info, names)
+            return [{n: repr(s) for n, s in (chosen or {}).items()}
+                    for chosen in per_axis]
+
+        cache_dir = tempfile.mkdtemp(prefix="ed_disc_bench_")
+        try:
+            base = sweep("baseline (seed: prune/batch/cache off)",
+                         prune=False, batch=False, cache_dir=None)
+            cold = sweep("cold (prune+batch on, empty cache)",
+                         prune=True, batch=True, cache_dir=cache_dir)
+            disc.clear_cache_instances()  # warm must round-trip the disk
+            warm = sweep("warm (same cache dir)",
+                         prune=True, batch=True, cache_dir=cache_dir)
+        finally:
+            shutil.rmtree(cache_dir, ignore_errors=True)
+
+        rules_equal = (repr(sorted(base["first"][1].items()))
+                       == repr(sorted(cold["first"][1].items())))
+        strategies_equal = (strategies_of(base["first"])
+                            == strategies_of(cold["first"]))
+
+        ratio_cold = base["probes"] / max(cold["probes"], 1)
+        ratio_warm = base["probes"] / max(warm["probes"], 1)
+        ok = (ratio_cold >= 5.0 and ratio_warm >= 10.0
+              and rules_equal and strategies_equal)
+
+        ct = cold["totals"]
+        result.update({
+            "value": round(ratio_cold, 2),
+            "ratio_cold": round(ratio_cold, 2),
+            "ratio_warm": round(ratio_warm, 2),
+            "probes_baseline": int(base["probes"]),
+            "probes_cold": int(cold["probes"]),
+            "probes_warm": int(warm["probes"]),
+            "rules_equal": bool(rules_equal),
+            "strategies_equal": bool(strategies_equal),
+            "discovery": {
+                "groups": int(ct.groups),
+                "rules_discovered": int(ct.rules_discovered),
+                "rules_from_group": int(ct.rules_from_group),
+                "rules_from_cache_warm": int(
+                    warm["totals"].rules_from_cache),
+                "probes_compiled": int(ct.probes_compiled),
+            },
+            "n_variants": len(variants),
+            "device": "host cpu",
+            "verdict": "ok" if ok else "regression",
+        })
+        _attach_measured(
+            result,
+            wall_s=time.perf_counter() - t_scn,
+            discovery_baseline_s=base["wall"],
+            discovery_cold_s=cold["wall"],
+            discovery_warm_s=warm["wall"])
+        log(f"# discovery gate: cold {ratio_cold:.1f}x warm "
+            f"{ratio_warm:.1f}x rules_equal={rules_equal} "
+            f"strategies_equal={strategies_equal}")
+    except Exception as e:  # always land the JSON line
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        result["error"] = f"{type(e).__name__}: {e}"
+        result["verdict"] = "error"
+    _annotate_vs_last_good(result)
+    _maybe_update_last_good(result)
+    print(json.dumps(result), flush=True)
+
+
 def autoscale_main():
     """SLO-autoscaler ramp drill (`--autoscale`): deterministic
     ramp-up / hold / ramp-down traffic through a `FleetRouter` under the
@@ -2879,6 +3053,8 @@ if __name__ == "__main__":
         simulate_main()
     elif "--autoscale" in sys.argv:
         autoscale_main()
+    elif "--discovery" in sys.argv:
+        discovery_main()
     elif "--speculate" in sys.argv:
         speculate_main()
     elif "--fleet" in sys.argv:

@@ -65,7 +65,14 @@ A rule-based analyzer that runs after solving and before execution
            no longer agrees with; SIM002 autoscaler flap — opposite-
            direction scale actuations inside the hysteresis window (an
            A-B-A oscillation), each reversal paying a drain +
-           page-migration + spin-up round trip for nothing.
+           page-migration + spin-up round trip for nothing;
+  layer 10 pruned-discovery auditor (`audit_rule_transfer`,
+           analyze/discovery_rules.py) — DISC001 a propagation-group or
+           rule-cache transfer that instantiated a representative rule
+           the member's shapes cannot carry (row/rank mismatch, halo
+           wider than a member shard, size-sensitive rule across
+           non-identical shapes); DISC002 execution discovery firing for
+           a primitive whose analytic preset declined the instance.
 
 Surfaced via `CompiledFunction.analyze()`, `bench.py --analyze`, and the
 dryrun gate; findings export through the runtime PerfDB under
@@ -89,6 +96,7 @@ from .memory_rules import (audit_remat_plan, check_hbm_budget,
                            resolve_hbm_budget, verify_memory_plan)
 from .overlap_rules import (lint_overlap_fn, lint_overlap_jaxpr,
                             lint_overlap_plan)
+from .discovery_rules import audit_rule_transfer
 from .reshard_rules import audit_reshard_plan, audit_restored_state
 from .resilience_rules import (audit_checkpoint_root, audit_guard_parity,
                                guard_off_jaxpr)
@@ -125,6 +133,7 @@ __all__ = [
     "check_reshard_plan", "check_restored_state",
     "audit_prediction", "audit_scale_decisions",
     "check_sim_prediction", "check_sim_autoscale",
+    "audit_rule_transfer",
 ]
 
 

@@ -195,6 +195,19 @@ RULES: Dict[str, tuple] = {
                "each reversal pays a drain + page-migration + spin-up "
                "round trip for zero steady-state change, so the "
                "confirm/cooldown gates are mis-tuned or bypassed"),
+    # ---- layer 10: pruned-discovery auditor (propagation-group and
+    #      cache transfers, analyze/discovery_rules.py)
+    "DISC001": (SEV_ERROR,
+                "propagation-group member shapes are incompatible with "
+                "the instantiated representative rule (row/rank mismatch, "
+                "halo wider than a member shard, or a size-sensitive rule "
+                "transferred across shapes) — the pruner reused a rule "
+                "the member could not have discovered"),
+    "DISC002": (SEV_WARNING,
+                "execution discovery fired for a primitive that has an "
+                "analytic preset — the preset declined this instance, so "
+                "the compile pays the probe harness for an op the preset "
+                "bank claims to cover"),
 }
 
 

@@ -64,6 +64,11 @@ def _node_seconds(node: MetaNode) -> float:
                nbytes / edconfig.hbm_bandwidth)
 
 
+# public name: the jaxfront composite-discovery pricer uses the same
+# roofline estimate when it prices control-flow body strategies
+node_seconds = _node_seconds
+
+
 class ReachabilityMap:
     """Transitive closure over graph ops + per-edge independent peer FLOPs."""
 

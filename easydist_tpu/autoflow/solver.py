@@ -99,10 +99,15 @@ class SpmdSolver:
     """Solve one mesh axis for a coarsened MetaGraph."""
 
     def __init__(self, graph: MetaGraph, axis: MeshAxisSpec,
-                 reachability=None, free_outputs: bool = False):
+                 reachability=None, free_outputs: bool = False,
+                 cluster_dedup: Optional[bool] = None):
         self.graph = graph
         self.axis = axis
         self.reachability = reachability
+        # per-solve override of edconfig.solver_cluster_dedup (composite-body
+        # solves pass False: tying would fight their per-placeholder pins)
+        self.cluster_dedup = edconfig.solver_cluster_dedup \
+            if cluster_dedup is None else cluster_dedup
         # composite-body solves (scan/remat): graph outputs cross the
         # composite boundary with their own recombines, so sharded/partial
         # outputs must not be priced as if handed back replicated
@@ -122,8 +127,7 @@ class SpmdSolver:
         self.tie_rep: Dict[int, int] = {c.cid: c.cid for c in self.clusters}
         # under a hard memory cap, only non-uniform per-instance assignments
         # may be feasible and refinement is disabled — solve untied
-        if edconfig.solver_cluster_dedup \
-                and edconfig.per_device_memory_cap <= 0:
+        if self.cluster_dedup and edconfig.per_device_memory_cap <= 0:
             self._compute_tie_groups()
 
     # ------------------------------------------------------------ model build
@@ -554,7 +558,7 @@ class SpmdSolver:
                 # can dodge a cap); the uncapped fallback must re-tie, or
                 # the larger untied ILP lands on a different near-tie than
                 # the cap-0 solve and the remat planner sees a worse plan
-                if edconfig.solver_cluster_dedup:
+                if self.cluster_dedup:
                     self._compute_tie_groups()
                 return self._ilp_solve(apply_memory_cap=False)
             raise RuntimeError(f"MILP failed: status={res.status} {res.message}")

@@ -59,6 +59,34 @@ discovery_hint_numel = _env_int("EASYDIST_DISCOVERY_HINT_NUMEL", 2**24)
 # exponential in the number of tensor args; jax primitives rarely exceed 3)
 discovery_max_candidates = _env_int("EASYDIST_DISCOVERY_MAX_CANDIDATES", 4096)
 
+# ---------------- pruned discovery (jaxfront/discovery.py) ----------------
+# Automap-style propagation grouping (arXiv:2112.02958): canonicalize eqn
+# signatures into dim-role equivalence classes and run discovery once per
+# group representative, instantiating the rule for every member.  The kill
+# switch (EASYDIST_DISCOVERY_PRUNE=0) restores per-signature discovery
+# end-to-end; chosen strategies are identical either way (gated by
+# tests/test_jaxfront/test_discovery.py and bench.py --discovery).
+discovery_prune = _env_bool("EASYDIST_DISCOVERY_PRUNE", True)
+# persist discovered rules across process restarts, keyed by canonical
+# signature + a knob/cost-model salt (atomic tempfile+replace store like
+# the strategy cache's) — warm runs skip probe compilation entirely
+discovery_persistent_cache = _env_bool("EASYDIST_DISCOVERY_CACHE", True)
+# cache directory; empty = "<compile_cache_dir>/discovery"
+discovery_cache_dir = os.environ.get("EASYDIST_DISCOVERY_CACHE_DIR", "")
+# fuse a candidate's per-shard probe executions into ONE batched (vmapped)
+# bind instead of nshards sequential eager calls; falls back to the
+# sequential loop per-op on any batching failure
+discovery_batch_probes = _env_bool("EASYDIST_DISCOVERY_BATCH_PROBES", True)
+# analytic preset rules (jaxfront/presets.py); 0 forces execution
+# discovery for every primitive (bench probe-ratio measurement uses this
+# to compare pruned vs unpruned discovery on honest probe counts)
+discovery_use_presets = _env_bool("EASYDIST_DISCOVERY_PRESETS", True)
+# one-shot cross-check mode: execute-validate each analytic preset rule
+# against the ShardCombine harness on small shapes (every preset shard
+# group must execute and recombine exactly as declared); expensive,
+# default off — enabled by the preset-validation test
+discovery_crosscheck = _env_bool("EASYDIST_DISCOVERY_CROSSCHECK", False)
+
 # ---------------- solver ----------------
 enable_graph_coarsen = _env_bool("EASYDIST_ENABLE_GRAPH_COARSEN", True)
 coarsen_level = _env_int("EASYDIST_COARSEN_LEVEL", 1)

@@ -269,7 +269,7 @@ def _compile_cache_key(closed_jaxpr, axis_specs) -> str:
     # schema + cost-model salt: cached strategies are only valid for the
     # solver/cost-model that produced them; a version bump or a tuned
     # bandwidth/latency knob must miss, not silently serve stale plans
-    h.update(("v7|" + "|".join(
+    h.update(("v8|" + "|".join(
         f"{k}={getattr(edconfig, k)}" for k in
         ("ici_bandwidth", "dcn_bandwidth", "ici_latency", "dcn_latency",
          "hbm_bandwidth", "all_to_all_punish_factor",
@@ -775,12 +775,13 @@ def compile_step(func, args, kwargs, mesh=None, state_io="auto",
     # divisibility, so a dim only shardable on a small axis must not be
     # filtered out by a larger one
     world = min((s.size for s in axis_specs), default=1)
-    t0 = time.perf_counter()
     analyzer = ShardingAnalyzer(closed_jaxpr, world_size=world)
-    rules, shape_info = analyzer.run()
+    rules, shape_info = analyzer.run()  # logs its own one-line summary
     names = analyzer.names
-    logger.info("[discovery] %d unique op signatures in %.2fs", len(rules),
-                time.perf_counter() - t0)
+    if edconfig.use_op_cost_db:
+        from easydist_tpu.runtime.perfdb import record_discovery
+
+        record_discovery(analyzer.counters.snapshot())
 
     state_io_names = {}
     for out_idx, in_idx in state_pairs.items():
@@ -791,7 +792,9 @@ def compile_step(func, args, kwargs, mesh=None, state_io="auto",
 
     # ---- per-axis sequential solve (layer-1 analyzer findings collected
     # per axis, on exactly the graph each solve saw)
-    analysis_findings: List[object] = []
+    # discovery findings (DISC001/DISC002) ride the same report as the
+    # solver-layer findings
+    analysis_findings: List[object] = list(analyzer.findings)
     solver_audits: List[Dict[str, float]] = []
     per_axis, graph = solve_axes(closed_jaxpr, axis_specs, world, rules,
                                  shape_info, names, state_io_names,

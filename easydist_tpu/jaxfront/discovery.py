@@ -1,0 +1,421 @@
+"""Automap-style pruned ShardCombine discovery (arXiv:2112.02958).
+
+Execution discovery prices every unique ``(primitive, shapes, params)``
+signature by running the op ``nshards x candidates`` times — the dominant
+compile cost of the whole stack.  Automap's observation is that most of
+those signatures are *role-equivalent*: the discovered rule depends on each
+dimension's role (which dims are equal, which are broadcast size-1, which
+divide the shard count), not on its absolute size.  This module provides
+the three pruning substrates the interpreter composes:
+
+  canonical_signature  dim-role-normalized eqn key.  Signatures that agree
+                       here form one *propagation group*; discovery runs on
+                       the first member and the rule is instantiated for
+                       the rest.  Isomorphic subgraphs (stacked transformer
+                       layers) collapse because their eqns canonicalize
+                       pairwise: layer k's ops hash identically to layer
+                       k+1's once var identities are stripped.
+  DiscoveryCache       persistent canonical-signature -> rule store (atomic
+                       tempfile+os.replace writes, one pickle per knob
+                       salt), so warm runs skip probe compilation entirely.
+  DiscoveryCounters    probes_compiled / rules_from_group / rules_from_cache
+                       / discovery_seconds — exported to the PerfDB and the
+                       bench `measured` blocks.
+
+Soundness: a transferred rule is dim-indexed, and the solver re-checks
+divisibility against each member's actual shapes at strategy_pool() time,
+so role-equivalence only has to guarantee identical discovery *outcomes*.
+Rules carrying absolute-size artifacts (halo widths, block-cyclic blocks,
+priced composite strategies) transfer only between byte-identical shapes —
+`rule_transferable` enforces that, and analyze layer 10 (DISC001) audits
+every instantiation after the fact.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import pickle
+import re
+import tempfile
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from easydist_tpu import config as edconfig
+
+logger = logging.getLogger(__name__)
+
+# bump to invalidate every persisted discovery rule (schema change, rule
+# semantics change); the knob salt handles configuration drift
+CACHE_VERSION = "disc-v2"  # v2: positive-uniform float probe inputs
+
+# memory addresses in repr() (bound methods, callables captured in eqn
+# params) would make canonical signatures process-unique — strip them so
+# the persistent cache can hit across restarts
+_ADDR_RE = re.compile(r" at 0x[0-9a-fA-F]+")
+
+
+# --------------------------------------------------------------- counters
+
+class DiscoveryCounters:
+    """Per-trace discovery accounting (one instance per top-level
+    ShardingAnalyzer; sub-analyzers share their parent's)."""
+
+    _INT_FIELDS = ("probes_compiled", "rules_preset", "rules_from_group",
+                   "rules_from_cache", "rules_discovered", "groups",
+                   "crosscheck_checked", "crosscheck_failures")
+
+    def __init__(self):
+        for f in self._INT_FIELDS:
+            setattr(self, f, 0)
+        self.discovery_seconds = 0.0
+
+    def snapshot(self) -> Dict[str, float]:
+        out = {f: getattr(self, f) for f in self._INT_FIELDS}
+        out["discovery_seconds"] = self.discovery_seconds
+        return out
+
+    def merge(self, other: "DiscoveryCounters") -> None:
+        for f in self._INT_FIELDS:
+            setattr(self, f, getattr(self, f) + getattr(other, f))
+        self.discovery_seconds += other.discovery_seconds
+
+
+# process-wide accumulation across compiles (PerfDB export reads this)
+GLOBAL_COUNTERS = DiscoveryCounters()
+
+
+def reset_global_counters() -> None:
+    global GLOBAL_COUNTERS
+    GLOBAL_COUNTERS = DiscoveryCounters()
+
+
+# ----------------------------------------------------- canonical signature
+
+def _has_jaxpr_param(val) -> bool:
+    from jax.extend import core as jex_core
+
+    if isinstance(val, (jex_core.Jaxpr, jex_core.ClosedJaxpr)):
+        return True
+    if isinstance(val, (tuple, list)):
+        return any(_has_jaxpr_param(v) for v in val)
+    return False
+
+
+def is_composite(eqn) -> bool:
+    """Call-like eqns (remat/scan/cond/while/pjit) whose rule embeds a
+    priced body solve — canonicalized by exact structure, never merged
+    across shapes (the prices are shape-dependent seconds)."""
+    return any(_has_jaxpr_param(v) for v in eqn.params.values())
+
+
+def eqn_tensor_shapes(eqn) -> List[Tuple[int, ...]]:
+    """Shapes of the inputs that occupy discovery rows, in row order —
+    the same convention presets._tensor_avals / MetaOp use (non-Literal
+    vars, plus array-valued literals; scalar literals take no row)."""
+    from jax.extend import core as jex_core
+
+    shapes = []
+    for v in eqn.invars:
+        if isinstance(v, jex_core.Literal):
+            if getattr(v.val, "ndim", None) is not None and v.val.ndim > 0:
+                shapes.append(tuple(v.val.shape))
+        else:
+            aval = getattr(v, "aval", None)
+            if hasattr(aval, "shape"):
+                shapes.append(tuple(aval.shape))
+    return shapes
+
+
+def canonical_signature(eqn, world_size: int) -> str:
+    """Dim-role-normalized cache key: two eqns with the same canonical
+    signature provably drive execution discovery to the same rule.
+
+    Normalization per tensor dimension:
+      - size 1 stays literal (broadcast semantics differ from sharded dims)
+      - small sizes stay literal (divisibility/halo edge cases are decided
+        by absolute size below ~4x the shard unit)
+      - large sizes map to (size-equality class, divisibility flags): the
+        class index ties dims that must shrink/shard together (contraction
+        partners, residual adds), the flags preserve exactly what the
+        discovery harness checks (`% nshards`) and what the solver checks
+        downstream (`% world_size`)
+    Literal values and params are kept verbatim (address-stripped): any
+    shape smuggled through params (reshape new_sizes, slice indices)
+    conservatively splits the group.  Composite eqns canonicalize to their
+    exact structure hash — body prices are shape-specific.
+    """
+    from jax.extend import core as jex_core
+
+    from .interpreter import eqn_signature, hash_array_bytes
+
+    prim = eqn.primitive.name
+    nshards = edconfig.discovery_nshards
+
+    if is_composite(eqn):
+        exact = _ADDR_RE.sub("", eqn_signature(eqn, None))
+        digest = hashlib.sha256(exact.encode()).hexdigest()[:24]
+        return f"{prim}|w{world_size}|composite:{digest}"
+
+    # sizes at/below the cutoff stay literal: size 1 is broadcast, and a
+    # dim the probe harness can't split `nshards` ways twice over has its
+    # shardability decided by absolute size.  Above it the (equality-class,
+    # divisibility-flags) token preserves exactly what discovery and the
+    # solver check, so e.g. dim=256 and ffn=1024 matmuls share one group.
+    small_cutoff = max(8, 2 * nshards)
+    size_classes: Dict[int, int] = {}
+
+    def tok(size: int) -> str:
+        if size <= small_cutoff:
+            return str(size)
+        cls = size_classes.setdefault(size, len(size_classes))
+        # %nshards is what the probe harness checks when splitting a dim;
+        # %world_size is what strategy_pool re-checks downstream — the two
+        # flags are exactly the size information discovery consumes
+        return (f"D{cls}"
+                f".{int(size % nshards == 0)}"
+                f"{int(size % world_size == 0)}")
+
+    parts = []
+    shape_toks: Dict[str, str] = {}  # repr(shape tuple) -> tokenized form
+    lit_classes: Dict[str, int] = {}
+
+    def lit_tok(val) -> str:
+        """Scalar literals: degenerate values (0, +-1, non-finite) keep
+        their value — multiplying by 0 or 1 can collapse probe outputs
+        and accidentally match a different recombination — and every
+        other value maps to a first-appearance equality class.  The
+        VALUE of a generic scalar never feeds the sharding structure,
+        only its pattern of reuse across operands does."""
+        try:
+            f = float(val)
+        except (TypeError, ValueError):
+            return f"lit:{val!r}"
+        if f in (0.0, 1.0, -1.0) or not np.isfinite(f):
+            return f"lit:{val!r}"
+        cls = lit_classes.setdefault(repr(val), len(lit_classes))
+        dt = getattr(val, "dtype", type(val).__name__)
+        return f"lit:L{cls}:{dt}"
+
+    def shape_part(shape) -> str:
+        dims = ",".join(tok(d) for d in shape)
+        if len(shape) >= 1 and any(d > small_cutoff for d in shape):
+            shape_toks[repr(tuple(shape))] = f"({dims})"
+        return dims
+
+    for v in eqn.invars:
+        if isinstance(v, jex_core.Literal):
+            val = v.val
+            if isinstance(val, np.ndarray) and val.size > 1:
+                dims = shape_part(val.shape)
+                parts.append(f"lit:{val.dtype.name}[{dims}]:"
+                             f"{hash_array_bytes(val)}")
+            else:
+                parts.append(lit_tok(val))
+        else:
+            aval = getattr(v, "aval", None)
+            if hasattr(aval, "shape"):
+                parts.append(f"{aval.dtype.name}[{shape_part(aval.shape)}]")
+            else:
+                parts.append("?")
+    for v in eqn.outvars:
+        aval = getattr(v, "aval", None)
+        if hasattr(aval, "shape"):
+            parts.append(f"->{aval.dtype.name}[{shape_part(aval.shape)}]")
+    try:
+        params = str(sorted(eqn.params.items()))
+    except Exception:
+        params = str(eqn.params)
+    params = _ADDR_RE.sub("", params)
+    # shape-valued params (broadcast_in_dim's shape, full-dim slice limits,
+    # ...) co-vary with the tensor shapes: rewrite exact occurrences of an
+    # in/out shape tuple with its tokenized form so role-equivalent eqns
+    # whose params only restate their shapes still share a group.  Any
+    # other param int stays literal and conservatively splits the group.
+    for exact, tokd in sorted(shape_toks.items(),
+                              key=lambda kv: -len(kv[0])):
+        params = params.replace(exact, tokd)
+    raw = f"{';'.join(parts)}|{params}"
+    digest = hashlib.sha256(raw.encode()).hexdigest()[:24]
+    return f"{prim}|w{world_size}|{digest}"
+
+
+# ------------------------------------------------------- rule transferral
+
+def _space_has_size_artifacts(space) -> bool:
+    """True when the discovered space carries absolute-size artifacts
+    (halo widths, block-cyclic blocks) that are only valid for the exact
+    shapes they were discovered on."""
+    for row in space.table:
+        for d in row:
+            if d.halo is not None or d.block > 1:
+                return True
+    return False
+
+
+def rule_transferable(rule: dict, rep_shapes: List[Tuple[int, ...]],
+                      eqn) -> bool:
+    """Cheap inline soundness gate before serving a representative's rule
+    to a group member (analyze layer 10 / DISC001 re-audits afterwards).
+
+    Plain (space-based) rules transfer when row count and ranks line up and
+    the space is artifact-free; rules with halos/blocks and priced
+    composite strategies transfer only between byte-identical shapes."""
+    member_shapes = eqn_tensor_shapes(eqn)
+    if rule.get("strategies") is not None:
+        return member_shapes == rep_shapes
+    space = rule.get("space")
+    if space is None:
+        return member_shapes == rep_shapes
+    if len(member_shapes) != len(rep_shapes):
+        return False
+    if any(len(m) != len(r) for m, r in zip(member_shapes, rep_shapes)):
+        return False
+    if len(space.table) != len(member_shapes):
+        return False
+    if any(len(row) != len(m)
+           for row, m in zip(space.table, member_shapes)):
+        return False
+    if _space_has_size_artifacts(space) and member_shapes != rep_shapes:
+        return False
+    return True
+
+
+# ------------------------------------------------------- persistent cache
+
+def cache_salt() -> str:
+    """Digest over everything a persisted rule's content depends on: the
+    discovery harness knobs, the cost-model knobs (composite rules embed
+    priced seconds from body ILP solves), the PerfDB mtime (measured op
+    times feed those prices), and the jax version."""
+    import jax
+
+    from easydist_tpu.runtime.perfdb import db_mtime
+
+    knobs = (
+        # discovery harness
+        "discovery_nshards", "extend_space", "allclose_rtol",
+        "allclose_atol", "discovery_max_candidates", "discovery_hint_numel",
+        "scan_max_seed_solves", "while_trip_estimate",
+        # cost model feeding composite body solves
+        "ici_bandwidth", "dcn_bandwidth", "ici_latency", "dcn_latency",
+        "hbm_bandwidth", "peak_flops", "all_to_all_punish_factor",
+        "enable_partial_pools", "solver_backend", "use_op_cost_db",
+        "predict_comm_overlap", "comm_overlap_ratio",
+        "comm_overlap_ratio_source", "comm_overlap_ratio_measured",
+        "comm_quant_dtype", "comm_quant_block", "comm_quant_min_numel",
+    )
+    parts = [CACHE_VERSION, jax.__version__, str(db_mtime())]
+    parts += [f"{k}={getattr(edconfig, k)}" for k in knobs]
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
+
+
+class DiscoveryCache:
+    """Persistent canonical-signature -> rule store.
+
+    One pickle dict per knob salt under the cache dir; loads lazily, writes
+    atomically (tempfile + os.replace, the strategy-cache idiom) after
+    merging with whatever a concurrent process persisted meanwhile.
+    Entries: {"rule": rule_dict, "shapes": row shapes the rule was
+    discovered on, "prim": primitive name}."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._mem: Optional[Dict[str, dict]] = None
+        self._dirty = False
+
+    def _read_disk(self) -> Dict[str, dict]:
+        if os.path.exists(self.path):
+            try:
+                with open(self.path, "rb") as f:
+                    loaded = pickle.load(f)
+                if isinstance(loaded, dict):
+                    return loaded
+            except Exception:
+                logger.warning("discovery cache read failed for %s",
+                               self.path)
+        return {}
+
+    def _load(self) -> None:
+        if self._mem is None:
+            self._mem = self._read_disk()
+
+    def get(self, key: str) -> Optional[dict]:
+        with self._lock:
+            self._load()
+            return self._mem.get(key)
+
+    def put(self, key: str, entry: dict) -> None:
+        with self._lock:
+            self._load()
+            self._mem[key] = entry
+            self._dirty = True
+
+    def __len__(self) -> int:
+        with self._lock:
+            self._load()
+            return len(self._mem)
+
+    def flush(self) -> None:
+        with self._lock:
+            if not self._dirty or self._mem is None:
+                return
+            merged = self._read_disk()
+            merged.update(self._mem)
+            tmp = None
+            try:
+                os.makedirs(os.path.dirname(self.path), exist_ok=True)
+                fd, tmp = tempfile.mkstemp(
+                    dir=os.path.dirname(self.path),
+                    prefix=os.path.basename(self.path) + ".",
+                    suffix=".tmp")
+                with os.fdopen(fd, "wb") as f:
+                    pickle.dump(merged, f)
+                os.replace(tmp, self.path)
+                tmp = None
+                self._mem = merged
+                self._dirty = False
+            except Exception:
+                # unpicklable entry or unwritable dir: drop persistence for
+                # this trace, keep the in-memory rules serving
+                logger.warning("discovery cache write failed for %s",
+                               self.path)
+                if tmp is not None:
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
+
+
+_caches: Dict[str, DiscoveryCache] = {}
+_caches_lock = threading.Lock()
+
+
+def get_cache() -> Optional[DiscoveryCache]:
+    """Resolve the process's DiscoveryCache for the CURRENT knob salt and
+    cache dir (tests repoint the dir / flip knobs freely — each distinct
+    path gets its own instance).  None when persistence is disabled."""
+    if not edconfig.discovery_persistent_cache:
+        return None
+    base = edconfig.discovery_cache_dir or os.path.join(
+        edconfig.compile_cache_dir, "discovery")
+    path = os.path.join(base, f"rules_{cache_salt()}.pkl")
+    with _caches_lock:
+        cache = _caches.get(path)
+        if cache is None:
+            cache = DiscoveryCache(path)
+            _caches[path] = cache
+        return cache
+
+
+def clear_cache_instances() -> None:
+    """Drop the in-process DiscoveryCache instances so the next
+    get_cache() re-reads its file from disk.  Tests and the --discovery
+    bench use this between sweeps to measure a true warm start (disk
+    round-trip) instead of hitting the instance's in-memory dict."""
+    with _caches_lock:
+        _caches.clear()

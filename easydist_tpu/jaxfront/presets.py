@@ -534,6 +534,161 @@ def _split_rule(eqn, world_size):
     return {"space": ShardSpace([row]), "recombines": recombines}
 
 
+# ------------------------------------------------------------ sort / top_k
+
+@register_preset("sort")
+def _sort_rule(eqn, world_size):
+    """Variadic lax.sort: all operands share one shape; any dim except the
+    sort dimension shards freely (the comparator only looks along
+    `dimension`), every output concats at the same dim."""
+    avals = _tensor_avals(eqn)
+    if not avals:
+        return None
+    shape = avals[0].shape
+    if any(a.shape != shape for a in avals):
+        return None
+    dim = eqn.params["dimension"]
+    n_out = len(eqn.outvars)
+    rows = [[DimSharding() for _ in shape] for _ in avals]
+    recombines = {}
+    group = 1
+    for d in range(len(shape)):
+        if d == dim:
+            continue
+        for row in rows:
+            row[d] = DimSharding(group=group)
+        recombines[group] = [_concat(d)] * n_out
+        group += 1
+    return {"space": ShardSpace(rows), "recombines": recombines}
+
+
+@register_preset("top_k")
+def _top_k_rule(eqn, world_size):
+    """lax.top_k selects along the last dim; batch dims shard freely and
+    both outputs (values, indices) concat there."""
+    (aval,) = _tensor_avals(eqn)
+    if aval.ndim == 0:
+        return None
+    row = [DimSharding() for _ in range(aval.ndim)]
+    recombines = {}
+    group = 1
+    for d in range(aval.ndim - 1):
+        row[d] = DimSharding(group=group)
+        recombines[group] = [_concat(d)] * len(eqn.outvars)
+        group += 1
+    return {"space": ShardSpace([row]), "recombines": recombines}
+
+
+# ------------------------------------------- dynamic slice / dynamic update
+
+@register_preset("dynamic_slice")
+def _dynamic_slice_rule(eqn, world_size):
+    """Dims taken WHOLE (slice_sizes[d] == shape[d]) shard freely: the
+    start index clamps to 0 there, so per-shard slices concat to the
+    global slice.  GSPMD handles the baked slice_sizes under sharding —
+    the eager harness cannot (full-size param vs shard-size operand),
+    which keeps this rule analytic-only (see _CROSSCHECK_SKIP).  Scalar
+    start-index operands ride along replicated (empty rows)."""
+    avals = _tensor_avals(eqn)
+    if not avals or avals[0].ndim == 0:
+        return None
+    operand, index_avals = avals[0], avals[1:]
+    if any(a.ndim != 0 for a in index_avals):
+        return None
+    slice_sizes = eqn.params["slice_sizes"]
+    op_row = [DimSharding() for _ in range(operand.ndim)]
+    recombines = {}
+    group = 1
+    for d in range(operand.ndim):
+        if slice_sizes[d] == operand.shape[d]:
+            op_row[d] = DimSharding(group=group)
+            recombines[group] = _concat(d)
+            group += 1
+    return {"space": ShardSpace([op_row] + [[] for _ in index_avals]),
+            "recombines": recombines}
+
+
+@register_preset("dynamic_update_slice")
+def _dynamic_update_slice_rule(eqn, world_size):
+    """Dims where the update covers the WHOLE operand dim shard freely
+    (start clamps to 0; operand and update shard together, output concats).
+    Analytic-only for the same reason as dynamic_slice."""
+    avals = _tensor_avals(eqn)
+    if len(avals) < 2 or avals[0].ndim == 0:
+        return None
+    operand, update, index_avals = avals[0], avals[1], avals[2:]
+    if update.ndim != operand.ndim or any(a.ndim != 0 for a in index_avals):
+        return None
+    op_row = [DimSharding() for _ in range(operand.ndim)]
+    upd_row = [DimSharding() for _ in range(update.ndim)]
+    recombines = {}
+    group = 1
+    for d in range(operand.ndim):
+        if update.shape[d] == operand.shape[d]:
+            op_row[d] = DimSharding(group=group)
+            upd_row[d] = DimSharding(group=group)
+            recombines[group] = _concat(d)
+            group += 1
+    return {"space": ShardSpace([op_row, upd_row] +
+                                [[] for _ in index_avals]),
+            "recombines": recombines}
+
+
+# --------------------------------------------------------------------- rng
+
+@register_preset("threefry2x32")
+def _threefry_rule(eqn, world_size):
+    """The threefry2x32 counter hash is elementwise over its broadcast
+    (k1, k2, x1, x2) operands: each output element depends only on the
+    matching key/counter elements, so counter dims shard freely and both
+    output words concat there.  Keys are usually scalar and ride along
+    replicated."""
+    avals = _tensor_avals(eqn)
+    out_aval = eqn.outvars[0].aval
+    rank = out_aval.ndim
+    if rank == 0:
+        return None
+    for a in avals:
+        if a.ndim not in (0, rank):
+            return None
+        if a.ndim == rank and any(s not in (1, out_aval.shape[d])
+                                  for d, s in enumerate(a.shape)):
+            return None
+    n_out = len(eqn.outvars)
+    table, recombines = [], {}
+    group = 1
+    dim_groups = {}
+    for d in range(rank):
+        dim_groups[d] = group
+        recombines[group] = [_concat(d)] * n_out
+        group += 1
+    for a in avals:
+        if a.ndim == 0:
+            table.append([])
+        else:
+            table.append([DimSharding(group=dim_groups[d])
+                          if a.shape[d] == out_aval.shape[d] != 1
+                          else DimSharding()
+                          for d in range(rank)])
+    live = {d.group for row in table for d in row if d.group > 0}
+    recombines = {g: fn for g, fn in recombines.items() if g in live}
+    return {"space": ShardSpace(table), "recombines": recombines}
+
+
+@register_preset("random_bits", "random_wrap", "random_unwrap",
+                 "random_seed", "random_fold_in", "random_split")
+def _random_rule(eqn, world_size):
+    """Typed-key RNG primitives stay replicated: the counter stream is a
+    function of flat element position, so a per-shard rebind would
+    regenerate the full stream, not a slice of it.  An analytic replicate
+    rule skips nshards x candidates of doomed probe executions (and the
+    key<fry> avals the eager harness cannot materialize anyway)."""
+    avals = _tensor_avals(eqn)
+    return {"space": ShardSpace([[DimSharding() for _ in a.shape]
+                                 for a in avals]),
+            "recombines": {}}
+
+
 # ------------------------------------------------------------- create ops
 
 @register_preset("iota")

@@ -26,6 +26,20 @@ from .combination import HaloHint, match_recombine
 
 logger = logging.getLogger(__name__)
 
+# process-wide probe accounting: every eager execution of an op under
+# discovery (global run, per-shard candidate run, or one batched candidate
+# bind) is one probe program.  jaxfront.discovery reads the delta around
+# each trace to report probes_compiled without a layering inversion.
+_PROBES = {"calls": 0}
+
+
+def probe_calls() -> int:
+    return _PROBES["calls"]
+
+
+def reset_probe_calls() -> None:
+    _PROBES["calls"] = 0
+
 
 class MetaOp:
 
@@ -45,6 +59,7 @@ class MetaOp:
     # ------------------------------------------------------------- execution
 
     def _call(self, flat_args):
+        _PROBES["calls"] += 1
         args, kwargs = platform.tree_unflatten(flat_args, self.args_spec)
         return self.fn(*args, **kwargs)
 
@@ -88,6 +103,13 @@ class MetaOp:
         if not shard_plans:
             raise RuntimeError(f"group {group} not present in shard space")
 
+        if edconfig.discovery_batch_probes and self.nshards > 1:
+            try:
+                return self._run_sharded_batched(shard_plans)
+            except Exception as e:
+                logger.debug("%s: batched probe fell back to the shard "
+                             "loop: %s", self.name, e)
+
         outs = []
         for s in range(self.nshards):
             shard_args = list(self.flat_args)
@@ -95,6 +117,36 @@ class MetaOp:
                 shard_args[flat_idx] = shards[s]
             outs.append(self._call(shard_args))
         return outs
+
+    def _run_sharded_batched(self, shard_plans: Dict[int, List]) -> List:
+        """Fuse the nshards per-shard executions of one candidate into a
+        single batched bind: sharded operands stack along a fresh leading
+        axis and the op runs vmapped over it (platform.batched_call).  One
+        eager dispatch per candidate instead of nshards, with bitwise-equal
+        per-shard outputs for every primitive whose batching rule is the op
+        itself over slices.  Raises on non-uniform shard shapes (halo-padded
+        edge shards) or unbatchable ops; the caller falls back to the loop."""
+        stacked = list(self.flat_args)
+        in_axes: List[Optional[int]] = [None] * len(stacked)
+        for flat_idx, shards in shard_plans.items():
+            if len({tuple(s.shape) for s in shards}) != 1:
+                raise RuntimeError("non-uniform shard shapes")
+            stacked[flat_idx] = platform.stack(shards, dim=0)
+            in_axes[flat_idx] = 0
+
+        def call_flat(*flat):
+            args, kwargs = platform.tree_unflatten(list(flat),
+                                                   self.args_spec)
+            return self.fn(*args, **kwargs)
+
+        out = platform.batched_call(call_flat, stacked, tuple(in_axes))
+        _PROBES["calls"] += 1
+        leaves, spec = platform.tree_flatten(out)
+        if any(getattr(leaf, "ndim", 0) < 1
+               or leaf.shape[0] != self.nshards for leaf in leaves):
+            raise RuntimeError("batched output lost the shard axis")
+        return [platform.tree_unflatten([leaf[s] for leaf in leaves], spec)
+                for s in range(self.nshards)]
 
     # -------------------------------------------------------------- discovery
 

@@ -17,7 +17,7 @@ _BACKEND_MOD = None
 _API = [
     "Tensor", "add", "equal", "allclose", "zeros_like", "minimum", "maximum",
     "concatenate", "chunk", "narrow", "clone", "from_numpy", "to_numpy",
-    "tree_flatten", "tree_unflatten",
+    "tree_flatten", "tree_unflatten", "stack", "batched_call",
 ]
 
 

@@ -89,6 +89,19 @@ def clone(x):
 
 
 @_maybe_cpu
+def stack(tensors, dim=0):
+    return jnp.stack(tensors, axis=dim)
+
+
+@_maybe_cpu
+def batched_call(fn, flat_args, in_axes):
+    """Run `fn(*flat_args)` vmapped over the axis-0 entries of `in_axes`:
+    one eager dispatch for all shards of a discovery candidate instead of
+    nshards sequential calls (metashard.MetaOp._run_sharded_batched)."""
+    return jax.vmap(fn, in_axes=in_axes)(*flat_args)
+
+
+@_maybe_cpu
 def from_numpy(x):
     return jnp.asarray(x)
 

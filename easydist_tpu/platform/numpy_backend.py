@@ -55,6 +55,16 @@ def clone(x):
     return np.copy(x)
 
 
+def stack(tensors, dim=0):
+    return np.stack(tensors, axis=dim)
+
+
+def batched_call(fn, flat_args, in_axes):
+    """The numpy backend has no batched execution — raising here routes
+    MetaOp back to its sequential per-shard loop (same results)."""
+    raise RuntimeError("numpy backend has no batched probe execution")
+
+
 def from_numpy(x):
     return np.asarray(x)
 

@@ -70,6 +70,30 @@ class PerfDB:
             return sum(len(v) for v in self._db.values())
 
 
+def discovery_db_path() -> str:
+    """Side-car pickle for discovery telemetry.  Kept separate from the
+    op-perf DB on purpose: the discovery rule cache's salt includes the
+    op-perf DB mtime (composite rule prices embed measured op times), so
+    writing per-compile telemetry into that file would invalidate the
+    rule cache on every compile."""
+    return edconfig.prof_db_path + ".discovery"
+
+
+def record_discovery(counters: Dict[str, Any],
+                     db: Optional[PerfDB] = None) -> None:
+    """Export one trace's discovery counters (probes_compiled,
+    rules_from_cache, rules_from_group, discovery_seconds, ...) into the
+    rolling "discovery"/"traces" history so dashboards and bench scenarios
+    read the same numbers the compile log printed.  Best-effort: a
+    read-only DB path must never fail a compile."""
+    try:
+        db = db or PerfDB(discovery_db_path())
+        db.append_history("discovery", "traces", dict(counters))
+        db.persist()
+    except Exception:
+        pass
+
+
 def db_mtime(path: Optional[str] = None) -> Optional[float]:
     """mtime of the (default) PerfDB pickle without loading it — the
     cheap staleness probe cache invalidators key on (autoflow.solver's

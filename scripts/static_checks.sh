@@ -512,5 +512,49 @@ else
     echo "static_checks: jax not importable; skipping bench.py --autoscale"
 fi
 
+# pruned-discovery gate: propagation groups + batched probes + the
+# persistent rule cache must cut execution-discovery probe compiles
+# >=5x cold and >=10x warm across the four-variant gpt recompile
+# scenario, while the discovered rules AND the solved per-axis
+# strategies stay byte-identical to the unpruned (seed-behavior) sweep
+if python -c "import jax" >/dev/null 2>&1; then
+    echo "== bench.py --discovery (pruned ShardCombine discovery gate)"
+    out=$(python bench.py --discovery 2>/dev/null) || rc=1
+    echo "$out"
+    verdict=$(python - "$out" <<'PYEOF'
+import json, sys
+try:
+    r = json.loads(sys.argv[1].strip().splitlines()[-1])
+    if "error" in r:
+        print("error: " + r["error"])
+    elif r.get("ratio_cold", 0) < 5.0:
+        print(f"cold probe reduction {r.get('ratio_cold')}x < 5x "
+              f"({r.get('probes_cold')} vs {r.get('probes_baseline')} "
+              f"baseline)")
+    elif r.get("ratio_warm", 0) < 10.0:
+        print(f"warm probe reduction {r.get('ratio_warm')}x < 10x "
+              f"({r.get('probes_warm')} vs {r.get('probes_baseline')} "
+              f"baseline)")
+    elif not r.get("rules_equal"):
+        print("pruned discovery rules diverge from the unpruned sweep")
+    elif not r.get("strategies_equal"):
+        print("pruned solver strategies diverge from the unpruned sweep")
+    elif r.get("perf_regression"):
+        print(f"committed-floor regression: {r.get('value')} is >10% below "
+              f"last-good {r.get('last_good_value')}")
+    else:
+        print("ok")
+except Exception as e:
+    print(f"unparseable: {e}")
+PYEOF
+)
+    if [ "$verdict" != "ok" ]; then
+        echo "static_checks: discovery gate failed ($verdict)"
+        rc=1
+    fi
+else
+    echo "static_checks: jax not importable; skipping bench.py --discovery"
+fi
+
 [ "$ran" = 0 ] && echo "static_checks: no external linters ran (configs still validated by CI tests)"
 exit $rc

@@ -1,0 +1,253 @@
+"""Pruned ShardCombine discovery (jaxfront/discovery.py + interpreter).
+
+The contract under test, in priority order:
+
+1. SOUNDNESS — pruning (propagation groups + persistent cache + batched
+   probes) never changes the compile result: the discovered rules and the
+   solver's chosen strategies are byte-identical with pruning on vs the
+   EASYDIST_DISCOVERY_PRUNE=0 kill switch (seed behavior).
+2. The machinery actually prunes: grouping reuses rules across same-role
+   signatures, the persistent cache makes a second trace probe-free, and
+   batched probes agree with the sequential loop.
+3. The kill switch is honored end-to-end (zero reuse when off).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from easydist_tpu import config as edconfig
+from easydist_tpu.autoflow.cost_model import MeshAxisSpec
+from easydist_tpu.jaxfront import discovery as disc
+from easydist_tpu.jaxfront.api import solve_axes
+from easydist_tpu.jaxfront.inline import inline_calls
+from easydist_tpu.jaxfront.interpreter import ShardingAnalyzer
+from easydist_tpu.metashard.metaop import probe_calls
+
+WORLD = 8
+
+
+@pytest.fixture
+def tmp_cache(tmp_path, monkeypatch):
+    """Point the persistent rule cache at an isolated directory."""
+    monkeypatch.setattr(edconfig, "discovery_persistent_cache", True)
+    monkeypatch.setattr(edconfig, "discovery_cache_dir", str(tmp_path))
+    disc.clear_cache_instances()
+    yield str(tmp_path)
+    disc.clear_cache_instances()
+
+
+def _mlp_trace():
+    def loss(w1, w2, x):
+        h = jnp.tanh(x @ w1)
+        return jnp.sum((h @ w2) ** 2)
+
+    w1 = jnp.ones((24, 40))
+    w2 = jnp.ones((40, 16))
+    x = jnp.ones((32, 24))
+    return inline_calls(jax.make_jaxpr(jax.grad(loss, argnums=(0, 1)))(
+        w1, w2, x))
+
+
+def _gpt_trace():
+    from easydist_tpu.models import gpt
+
+    cfg = gpt.GPTConfig.tiny(vocab=96, seq=32, dim=48, heads=4, layers=2)
+    params = gpt.gpt_init(cfg, jax.random.PRNGKey(0))
+    x = jax.random.randint(jax.random.PRNGKey(1), (8, cfg.seq), 0, cfg.vocab)
+    y = jax.random.randint(jax.random.PRNGKey(2), (8, cfg.seq), 0, cfg.vocab)
+    return inline_calls(jax.make_jaxpr(
+        lambda p, t, g: jax.value_and_grad(gpt.gpt_loss)(p, cfg, t, g))(
+            params, x, y))
+
+
+def _llama_trace():
+    from easydist_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny(vocab=96, seq=32, dim=48, heads=4,
+                                 kv_heads=2, layers=2)
+    params = llama.llama_init(cfg, jax.random.PRNGKey(0))
+    x = jax.random.randint(jax.random.PRNGKey(1), (4, cfg.seq), 0, cfg.vocab)
+    y = jax.random.randint(jax.random.PRNGKey(2), (4, cfg.seq), 0, cfg.vocab)
+    return inline_calls(jax.make_jaxpr(
+        lambda p, t, g: jax.value_and_grad(llama.llama_loss)(p, cfg, t, g))(
+            params, x, y))
+
+
+def _analyze(closed, **knobs):
+    """Run the sharding analyzer under temporary knob settings."""
+    saved = {k: getattr(edconfig, k) for k in knobs}
+    for k, v in knobs.items():
+        setattr(edconfig, k, v)
+    try:
+        a = ShardingAnalyzer(closed, world_size=WORLD)
+        rules, shape_info = a.run()
+        return a, rules, shape_info
+    finally:
+        for k, v in saved.items():
+            setattr(edconfig, k, v)
+
+
+def _strategies(closed, rules, shape_info, names):
+    per_axis, _ = solve_axes(closed, [MeshAxisSpec(name="d", size=WORLD)],
+                             WORLD, rules, shape_info, names)
+    return [{n: repr(s) for n, s in (chosen or {}).items()}
+            for chosen in per_axis]
+
+
+# --------------------------------------------------- strategy equivalence
+
+@pytest.mark.parametrize("make_trace", [_mlp_trace, _gpt_trace,
+                                        _llama_trace],
+                         ids=["mlp", "gpt", "llama"])
+def test_pruning_preserves_rules_and_strategies(make_trace, tmp_cache):
+    """The golden soundness gate: auto-preset rules and solved strategies
+    are byte-identical with pruning on vs the kill switch (seed
+    behavior).  Production config (presets on) on both sides."""
+    closed = make_trace()
+    a_off, rules_off, si_off = _analyze(
+        closed, discovery_prune=False, discovery_batch_probes=False,
+        discovery_persistent_cache=False)
+    a_on, rules_on, si_on = _analyze(
+        closed, discovery_prune=True, discovery_batch_probes=True)
+
+    assert repr(sorted(rules_off.items())) == repr(sorted(rules_on.items()))
+    assert (_strategies(closed, rules_off, si_off, a_off.names)
+            == _strategies(closed, rules_on, si_on, a_on.names))
+
+
+def test_kill_switch_disables_all_reuse(tmp_cache):
+    """EASYDIST_DISCOVERY_PRUNE=0 + cache off restores per-signature
+    discovery: zero group hits, zero cache hits."""
+    a, _, _ = _analyze(_mlp_trace(), discovery_prune=False,
+                       discovery_persistent_cache=False,
+                       discovery_use_presets=False)
+    assert a.counters.rules_from_group == 0
+    assert a.counters.rules_from_cache == 0
+    assert a.counters.rules_discovered > 0
+
+
+# ------------------------------------------------------ propagation groups
+
+def test_grouping_reuses_rules_across_sizes(tmp_cache):
+    """Two same-role eqns with different sizes canonicalize to one
+    signature; the second reuses the first's rule without probing."""
+    def fn(a, b, c, d):
+        return (a @ b).sum() + (c @ d).sum()
+
+    closed = inline_calls(jax.make_jaxpr(fn)(
+        jnp.ones((16, 24)), jnp.ones((24, 40)),
+        jnp.ones((64, 80)), jnp.ones((80, 56))))
+    a, rules, _ = _analyze(closed, discovery_prune=True,
+                           discovery_use_presets=False,
+                           discovery_persistent_cache=False)
+    assert a.counters.rules_from_group >= 1
+    # the transferred rule must still be a full dot_general rule (batchless
+    # matmul: out concat x2 + contraction partial = 3 groups)
+    dot_rules = [r for s, r in rules.items() if "dot_general" in s]
+    assert all(len(r["recombines"]) == 3 for r in dot_rules)
+
+
+def test_grouping_respects_divisibility_roles(tmp_cache):
+    """A dim divisible by nshards and one not must NOT share a canonical
+    class pattern — the indivisible matmul discovers its own rule."""
+    nsh = edconfig.discovery_nshards
+
+    def fn(a, b, c, d):
+        return (a @ b).sum() + (c @ d).sum()
+
+    closed = inline_calls(jax.make_jaxpr(fn)(
+        jnp.ones((16, 16 * nsh)), jnp.ones((16 * nsh, 32)),
+        jnp.ones((17, 16 * nsh + 1)), jnp.ones((16 * nsh + 1, 33))))
+    a, _, _ = _analyze(closed, discovery_prune=True,
+                       discovery_use_presets=False,
+                       discovery_persistent_cache=False)
+    sigs = {s for s in a.canon_rules if "dot_general" in s}
+    assert len(sigs) >= 2
+
+
+# ------------------------------------------------------- persistent cache
+
+def test_persistent_cache_warm_start_is_probe_free(tmp_cache):
+    """A second analyzer over the same trace (fresh cache instances, so
+    the rules round-trip the pickle on disk) compiles zero probes."""
+    closed = _mlp_trace()
+    knobs = dict(discovery_prune=True, discovery_use_presets=False)
+    a1, rules1, _ = _analyze(closed, **knobs)
+    assert a1.counters.rules_discovered > 0
+
+    disc.clear_cache_instances()
+    p0 = probe_calls()
+    a2, rules2, _ = _analyze(closed, **knobs)
+    assert probe_calls() - p0 == 0
+    assert a2.counters.rules_from_cache > 0
+    assert a2.counters.rules_discovered == 0
+    assert repr(sorted(rules1.items())) == repr(sorted(rules2.items()))
+
+
+def test_cache_salt_isolates_knob_changes(tmp_cache):
+    """Entries written under one nshards must not serve another: the salt
+    differs, so the second run discovers fresh."""
+    closed = _mlp_trace()
+    knobs = dict(discovery_prune=True, discovery_use_presets=False)
+    _analyze(closed, **knobs)
+
+    disc.clear_cache_instances()
+    saved = edconfig.discovery_nshards
+    try:
+        edconfig.discovery_nshards = saved * 2
+        a2, _, _ = _analyze(closed, **knobs)
+        assert a2.counters.rules_from_cache == 0
+        assert a2.counters.rules_discovered > 0
+    finally:
+        edconfig.discovery_nshards = saved
+        disc.clear_cache_instances()
+
+
+# --------------------------------------------------------- batched probes
+
+def test_batched_probes_match_sequential(tmp_cache):
+    """vmap-fused probe execution discovers the same rules as the
+    per-shard loop, with fewer probe compiles."""
+    closed = _mlp_trace()
+    base = dict(discovery_prune=False, discovery_persistent_cache=False,
+                discovery_use_presets=False)
+    p0 = probe_calls()
+    _, rules_seq, _ = _analyze(closed, discovery_batch_probes=False, **base)
+    probes_seq = probe_calls() - p0
+
+    p0 = probe_calls()
+    _, rules_bat, _ = _analyze(closed, discovery_batch_probes=True, **base)
+    probes_bat = probe_calls() - p0
+
+    assert repr(sorted(rules_seq.items())) == repr(sorted(rules_bat.items()))
+    assert probes_bat < probes_seq
+
+
+# ---------------------------------------------------- preset cross-check
+
+def test_crosscheck_mode_validates_presets(tmp_cache):
+    """One-shot audit mode: every checkable preset rule re-verifies
+    through the execution harness with zero mismatches."""
+    a, _, _ = _analyze(_mlp_trace(), discovery_crosscheck=True,
+                       discovery_use_presets=True)
+    assert a.counters.crosscheck_checked > 0
+    assert a.counters.crosscheck_failures == 0
+
+
+# ------------------------------------------------------------ env plumbing
+
+def test_kill_switch_env_var():
+    """EASYDIST_DISCOVERY_PRUNE=0 reaches the knob through config."""
+    import os
+    import subprocess
+    import sys
+
+    code = ("import os; os.environ.setdefault('JAX_PLATFORMS','cpu'); "
+            "from easydist_tpu import config as c; "
+            "print(c.discovery_prune, c.discovery_persistent_cache)")
+    env = dict(os.environ, EASYDIST_DISCOVERY_PRUNE="0",
+               EASYDIST_DISCOVERY_CACHE="0")
+    out = subprocess.check_output([sys.executable, "-c", code], env=env,
+                                  text=True)
+    assert out.split() == ["False", "False"]

@@ -80,6 +80,10 @@ CASES = [
                                     jnp.ones((4, 2)), jnp.ones((4, 6)))),
     ("slice_full", lambda: get_eqn(lambda x: x[:, 1:5], jnp.ones((4, 8)),
                                    prim="slice")),
+    ("sort", lambda: get_eqn(lambda x: jax.lax.sort(x, dimension=1),
+                             jnp.ones((4, 6)), prim="sort")),
+    ("top_k", lambda: get_eqn(lambda x: jax.lax.top_k(x, 2)[0],
+                              jnp.ones((4, 6)), prim="top_k")),
 ]
 
 
@@ -169,3 +173,65 @@ def test_split_rule():
     assert rule is not None
     s = strategy_set(rule["space"], rule["recombines"])
     assert ((0,), (("concat", 0, None), ("concat", 0, None))) in s
+
+
+def test_sort_rule_multi_operand():
+    # sort (keys, payload) pairs: both operands shard on the non-sort dim,
+    # both outputs concat there; the sort dim never shards
+    eqn = get_eqn(lambda k, v: jax.lax.sort((k, v), dimension=1, num_keys=1),
+                  jnp.ones((4, 6)), jnp.ones((4, 6)), prim="sort")
+    rule = preset_rule(eqn, world_size=2)
+    assert rule is not None
+    s = strategy_set(rule["space"], rule["recombines"])
+    assert ((0, 0), (("concat", 0, None), ("concat", 0, None))) in s
+    assert all(dims != (1, 1) for dims, _ in s)
+
+
+def test_top_k_rule():
+    eqn = get_eqn(lambda x: jax.lax.top_k(x, 2)[0], jnp.ones((4, 6)),
+                  prim="top_k")
+    rule = preset_rule(eqn, world_size=2)
+    assert rule is not None
+    s = strategy_set(rule["space"], rule["recombines"])
+    # batch dim shards, values AND indices concat there; last dim never
+    assert ((0,), (("concat", 0, None), ("concat", 0, None))) in s
+    assert all(dims != (1,) for dims, _ in s)
+
+
+def test_dynamic_slice_rule_whole_dims_only():
+    eqn = get_eqn(lambda x, i: jax.lax.dynamic_slice(x, (i, 0), (2, 6)),
+                  jnp.ones((4, 6)), jnp.int32(1), prim="dynamic_slice")
+    rule = preset_rule(eqn, world_size=2)
+    assert rule is not None
+    s = strategy_set(rule["space"], rule["recombines"])
+    # dim 1 is taken whole -> shardable; dim 0 is a real slice -> never
+    assert ((1, None), (("concat", 1, None),)) in s
+    assert all(dims[0] != 0 for dims, _ in s)
+
+
+def test_dynamic_update_slice_rule():
+    eqn = get_eqn(lambda x, u, i: jax.lax.dynamic_update_slice(x, u, (i, 0)),
+                  jnp.ones((4, 6)), jnp.ones((2, 6)), jnp.int32(1),
+                  prim="dynamic_update_slice")
+    rule = preset_rule(eqn, world_size=2)
+    assert rule is not None
+    s = strategy_set(rule["space"], rule["recombines"])
+    # dim 1: update covers the whole operand dim -> operand+update shard
+    assert ((1, 1, None), (("concat", 1, None),)) in s
+    assert all(dims[:2] != (0, 0) for dims, _ in s)
+
+
+def test_random_primitives_stay_replicated():
+    closed = jax.make_jaxpr(
+        lambda k: jax.random.uniform(k, (4, 6)))(jax.random.PRNGKey(0))
+    from easydist_tpu.jaxfront.inline import inline_calls
+
+    seen = set()
+    for eqn in inline_calls(closed).jaxpr.eqns:
+        if eqn.primitive.name.startswith("random_"):
+            rule = preset_rule(eqn, world_size=2)
+            assert rule is not None, eqn.primitive.name
+            assert rule["recombines"] == {}
+            assert rule["space"].max_group() == 0
+            seen.add(eqn.primitive.name)
+    assert "random_bits" in seen
