@@ -992,6 +992,31 @@ def analyze_main():
         log(f"# gpt_pp_schedule: {models['gpt_pp_schedule']} bubble "
             f"{sched['bubble_fraction']:.3f}")
 
+        # ---- layer-11 host-code donation lint, via the analyzer driver
+        # (suppressions + committed baseline applied, so the gate counts
+        # NEW errors only — legacy findings burn down via the baseline)
+        from easydist_tpu.analyze.driver import run_driver
+
+        repo_root = os.path.dirname(os.path.abspath(__file__))
+        drv = run_driver(repo_root, targets=("ast",),
+                         baseline_path=os.path.join(
+                             repo_root, "analyze_baseline.json"))
+        report.extend(f for f in drv.report.findings
+                      if f.severity != "error")
+        report.extend(drv.new_errors)
+        models["host_ast_lint"] = drv.report.counts()
+        driver_stats = {
+            "new_errors": len(drv.new_errors),
+            "baselined": drv.baselined,
+            "suppressed": drv.suppressed,
+            "n_files": drv.n_files,
+            "cache": {"hits": drv.cache_hits,
+                      "misses": drv.cache_misses},
+        }
+        log(f"# host_ast_lint: {models['host_ast_lint']} over "
+            f"{drv.n_files} files ({len(drv.new_errors)} new, "
+            f"{drv.baselined} baselined, {drv.suppressed} suppressed)")
+
         counts = report.counts()
         report.export_to_perfdb(sub_key="bench_analyze")
         from easydist_tpu.runtime.perfdb import PerfDB
@@ -1012,6 +1037,7 @@ def analyze_main():
             "models": models,
             "memory": memory,
             "schedule": sched,
+            "driver": driver_stats,
             "solver_audit_max_delta": audit_max_delta,
             # pruned-discovery counters accumulated over every compile
             # this scenario ran (ISSUE 17: compile-time observability)

@@ -72,12 +72,29 @@ A rule-based analyzer that runs after solving and before execution
            the member's shapes cannot carry (row/rank mismatch, halo
            wider than a member shard, size-sensitive rule across
            non-identical shapes); DISC002 execution discovery firing for
-           a primitive whose analytic preset declined the instance.
+           a primitive whose analytic preset declined the instance;
+  layer 11 donation/aliasing sanitizer (`audit_jaxpr_donation`,
+           `audit_donation_pairs`, `audit_host_aliases`,
+           `lint_host_donation`, analyze/alias_rules.py) — tier-1 runs
+           JAX_PLATFORMS=cpu where JAX silently IGNORES buffer
+           donation, so a use-after-donate passes every CPU test
+           bitwise and corrupts HBM on real TPUs: ALIAS001 a donated
+           invar read after its consuming dispatch (jaxpr form and the
+           `ast` host-code lint over retained Python references),
+           ALIAS002 one buffer donated through two positions / two
+           state outputs claiming one donated input, ALIAS003 a
+           donation XLA cannot honor (shape/dtype mismatch with every
+           output — the silent-copy case), ALIAS004 a donated device
+           buffer still reachable from a live host reference across a
+           step boundary (snapshots, hot-page exports, trie-held rows).
 
-Surfaced via `CompiledFunction.analyze()`, `bench.py --analyze`, and the
-dryrun gate; findings export through the runtime PerfDB under
-`("analyze_stats", <sub_key>)`.  Error-severity findings raise by default
-(`EASYDIST_ANALYZE_RAISE=0` is the escape hatch); rule catalog in
+Surfaced via `CompiledFunction.analyze()`, `bench.py --analyze`, the
+dryrun gate, and the analyzer driver (`python -m easydist_tpu.analyze`:
+inline suppressions, committed baseline, SARIF/JSON export, incremental
+result cache — analyze/driver.py); findings export through the runtime
+PerfDB under `("analyze_stats", <sub_key>)`.  Error-severity findings
+raise by default (`EASYDIST_ANALYZE_RAISE=0` is the escape hatch;
+`EASYDIST_ANALYZE=0` skips every layer); rule catalog in
 docs/ANALYZE.md.
 """
 
@@ -85,8 +102,12 @@ from __future__ import annotations
 
 import logging
 
-from .findings import (RULES, SEV_INFO, AnalysisError, AnalysisReport,
-                       Finding, make_finding)
+from .alias_rules import (audit_donation_pairs, audit_host_aliases,
+                          audit_jaxpr_donation, lint_file_donation,
+                          lint_host_donation)
+from .findings import (LAYERS, RULES, SEV_INFO, AnalysisError,
+                       AnalysisReport, Finding, layer_of, make_finding,
+                       rule_index_rows)
 from .fleet_rules import (audit_drained_session, audit_page_handoff,
                           audit_resume, audit_routing)
 from .jaxpr_rules import lint_bucket_plan, lint_fn, lint_jaxpr
@@ -134,7 +155,19 @@ __all__ = [
     "audit_prediction", "audit_scale_decisions",
     "check_sim_prediction", "check_sim_autoscale",
     "audit_rule_transfer",
+    "audit_jaxpr_donation", "audit_donation_pairs",
+    "audit_host_aliases", "lint_host_donation", "lint_file_donation",
+    "check_donation_pairs", "check_host_aliases",
+    "LAYERS", "layer_of", "rule_index_rows",
 ]
+
+
+def _enabled() -> bool:
+    """The layer kill switch (EASYDIST_ANALYZE=0): every check_* hook
+    returns empty without computing anything when analysis is off."""
+    from easydist_tpu import config as edconfig
+
+    return edconfig.enable_analyze
 
 
 def check_bucket_plan(leaves, buckets) -> None:
@@ -142,6 +175,8 @@ def check_bucket_plan(leaves, buckets) -> None:
     raise (or log, with the escape hatch) on error findings."""
     from easydist_tpu import config as edconfig
 
+    if not edconfig.enable_analyze:
+        return
     findings = lint_bucket_plan(leaves, buckets)
     if not findings:
         return
@@ -159,6 +194,8 @@ def check_overlap_plan(leaves, order, buckets=None) -> None:
     ORDERED leaves when `buckets` is given."""
     from easydist_tpu import config as edconfig
 
+    if not edconfig.enable_analyze:
+        return
     findings = lint_overlap_plan(leaves, order, buckets)
     if not findings:
         return
@@ -178,6 +215,8 @@ def check_schedule_tables(tables, n_stages: int, n_virtual: int,
     Warning/info findings (the SCHED003 bubble report) only log."""
     from easydist_tpu import config as edconfig
 
+    if not edconfig.enable_analyze:
+        return
     findings = verify_schedule_tables(tables, n_stages, n_virtual,
                                       n_microbatches, fwd_only=fwd_only,
                                       node=node)
@@ -197,6 +236,8 @@ def check_decode_donation(result, cache_arg: int = 0,
     compiled decode step's cache donation (SERVE001, warning severity —
     logs, never raises; a non-donated cache is slow, not wrong).
     Returns the findings so callers/tests can assert on them."""
+    if not _enabled():
+        return []
     findings = audit_decode_donation(result, cache_arg=cache_arg,
                                      node=node)
     for f in findings:
@@ -213,6 +254,8 @@ def check_chunked_prefill(result, cache_arg: int = 0,
     Returns the findings so callers/tests can assert on them."""
     from easydist_tpu import config as edconfig
 
+    if not edconfig.enable_analyze:
+        return []
     findings = audit_chunked_prefill(result, cache_arg=cache_arg,
                                      node=node)
     report = AnalysisReport(findings)
@@ -238,6 +281,8 @@ def check_speculative_rewind(result=None, *, cache_arg: int = 0,
     the findings so callers/tests can assert on them."""
     from easydist_tpu import config as edconfig
 
+    if not edconfig.enable_analyze:
+        return []
     findings = audit_speculative_rewind(
         result, cache_arg=cache_arg, node=node, draft=draft,
         target=target, n_accepted=n_accepted, pool=pool, table=table,
@@ -257,6 +302,8 @@ def check_prefix_cache(trie, node: str = "prefix_cache"):
     pinned chunk under a live slot.  Returns the findings."""
     from easydist_tpu import config as edconfig
 
+    if not edconfig.enable_analyze:
+        return []
     findings = audit_prefix_cache(trie, node=node)
     report = AnalysisReport(findings)
     if report.errors() and edconfig.analyze_raise:
@@ -275,6 +322,8 @@ def check_page_table(pool, table, trie=None, node: str = "kv"):
     assert on them."""
     from easydist_tpu import config as edconfig
 
+    if not edconfig.enable_analyze:
+        return []
     findings = audit_page_table(pool, table, trie=trie, node=node)
     report = AnalysisReport(findings)
     if report.errors() and edconfig.analyze_raise:
@@ -290,6 +339,8 @@ def check_fleet_routing(decisions, node: str = "fleet"):
     Returns the findings."""
     from easydist_tpu import config as edconfig
 
+    if not edconfig.enable_analyze:
+        return []
     findings = audit_routing(decisions, node=node)
     report = AnalysisReport(findings)
     if report.errors() and edconfig.analyze_raise:
@@ -306,6 +357,8 @@ def check_page_handoff(manifest, path, node: str = "handoff"):
     sharing the prefix.  Returns the findings."""
     from easydist_tpu import config as edconfig
 
+    if not edconfig.enable_analyze:
+        return []
     findings = audit_page_handoff(manifest, path, node=node)
     report = AnalysisReport(findings)
     if report.errors() and edconfig.analyze_raise:
@@ -319,6 +372,8 @@ def check_fleet_drain(session, node: str = "drain"):
     """Drain-time self-check hook for the fleet router: FLEET003
     (orphaned pinned pages / trie bookkeeping drift on a drained
     session) — warning severity, logs and returns the findings."""
+    if not _enabled():
+        return []
     findings = audit_drained_session(session, node=node)
     for f in findings:
         logger.warning("[analyze] %s", f)
@@ -333,6 +388,8 @@ def check_reshard_plan(plan, node: str = "reshard"):
     device.  Returns the findings."""
     from easydist_tpu import config as edconfig
 
+    if not edconfig.enable_analyze:
+        return []
     findings = audit_reshard_plan(plan, node=node)
     report = AnalysisReport(findings)
     if report.errors() and edconfig.analyze_raise:
@@ -350,6 +407,8 @@ def check_restored_state(restored, template, node: str = "restore"):
     step.  Returns the findings."""
     from easydist_tpu import config as edconfig
 
+    if not edconfig.enable_analyze:
+        return []
     findings = audit_restored_state(restored, template, node=node)
     report = AnalysisReport(findings)
     if report.errors() and edconfig.analyze_raise:
@@ -368,6 +427,8 @@ def check_resume_descriptor(descriptor, resume_prompt=None,
     fails loudly instead.  Returns the findings."""
     from easydist_tpu import config as edconfig
 
+    if not edconfig.enable_analyze:
+        return []
     findings = audit_resume(descriptor, resume_prompt, node=node)
     report = AnalysisReport(findings)
     if report.errors() and edconfig.analyze_raise:
@@ -384,6 +445,8 @@ def check_sim_prediction(rows, bound=None, node: str = "sim"):
     failure the simulator gate exists to catch.  Returns the findings."""
     from easydist_tpu import config as edconfig
 
+    if not edconfig.enable_analyze:
+        return []
     findings = audit_prediction(rows, bound=bound, node=node)
     report = AnalysisReport(findings)
     if report.errors() and edconfig.analyze_raise:
@@ -400,7 +463,48 @@ def check_sim_autoscale(decisions, window=None, node: str = "autoscale"):
     Returns the findings."""
     from easydist_tpu import config as edconfig
 
+    if not edconfig.enable_analyze:
+        return []
     findings = audit_scale_decisions(decisions, window=window, node=node)
+    report = AnalysisReport(findings)
+    if report.errors() and edconfig.analyze_raise:
+        report.raise_on_errors()
+    for f in findings:
+        logger.warning("[analyze] %s", f)
+    return findings
+
+
+def check_donation_pairs(result, node: str = "state-io"):
+    """Compile-time self-check hook for the layer-11 donation-pair
+    audit (ALIAS002 two outputs claiming one donated input, ALIAS003 a
+    declared donation XLA cannot honor — the silent-copy case).  Error
+    findings raise under `analyze_raise`; returns the findings so
+    callers/tests can assert on them."""
+    from easydist_tpu import config as edconfig
+
+    if not edconfig.enable_analyze:
+        return []
+    findings = audit_donation_pairs(result, node=node)
+    report = AnalysisReport(findings)
+    if report.errors() and edconfig.analyze_raise:
+        report.raise_on_errors()
+    for f in findings:
+        logger.warning("[analyze] %s", f)
+    return findings
+
+
+def check_host_aliases(donated, holders, node: str = "session"):
+    """Step-boundary self-check hook for `serve.generation` (ALIAS004):
+    identity overlap between the buffers the next dispatch donates
+    (cache/staging/arena) and host-held references that outlive the
+    step (snapshots, hot-page exports, trie-held rows).  Error findings
+    raise under `analyze_raise`; returns the findings so callers/tests
+    can assert on them."""
+    from easydist_tpu import config as edconfig
+
+    if not edconfig.enable_analyze:
+        return []
+    findings = audit_host_aliases(donated, holders, node=node)
     report = AnalysisReport(findings)
     if report.errors() and edconfig.analyze_raise:
         report.raise_on_errors()

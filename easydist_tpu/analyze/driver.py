@@ -1,0 +1,474 @@
+"""Analyzer driver: one entry point over the whole rule stack.
+
+`python -m easydist_tpu.analyze` wraps the eleven analyze layers behind
+a single CLI with the shared infrastructure the per-layer hooks never
+had (the Automap argument: compile-time analysis scales only when the
+machinery — suppressions, baselines, artifact export, caching — is
+shared, arXiv:2112.02958):
+
+* **targets** — `ast` runs the layer-11 host-code donation lint over
+  `easydist_tpu/` + `examples/`; `presets` compiles a small auto-solved
+  preset and runs the full `CompileResult.analyze()` stack (strategy,
+  program lint, memory plan, donation pairs) over it.  `bench.py
+  --analyze` remains the heavyweight preset gate.
+* **inline suppressions** — `# easydist: disable=ALIAS001` (comma list
+  for several rules) on the flagged line silences a finding; a
+  suppression that silences nothing is itself reported (DRV001) so
+  stale escapes burn down instead of accreting.
+* **baseline** — a committed JSON of finding fingerprints
+  (`Finding.fingerprint()`: rule|path|node, message and line excluded
+  so rewording and unrelated edits don't churn it).  Baselined findings
+  still report but do not gate; NEW findings fail the run.
+  `--refresh-baseline` rewrites the file from the current report.
+* **SARIF + JSON export** — `--sarif`/`--json` emit CI artifacts
+  (SARIF 2.1.0 minimal profile).
+* **incremental cache** — results are cached under
+  `<compile_cache_dir>/analyze/` keyed on (artifact content hash,
+  rule-module version): per source file for the `ast` target, per
+  package-source snapshot for `presets`.  A warm rerun on unchanged
+  artifacts skips the lint/compile and replays the stored findings
+  byte-identically; editing any rule module invalidates everything.
+
+`EASYDIST_ANALYZE=0` skips every target (the driver reports
+`skipped`); `EASYDIST_ANALYZE_RAISE` is irrelevant here — the driver
+never raises, it exit-codes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .findings import (RULES, SEV_ERROR, AnalysisReport, Finding,
+                       make_finding)
+
+# bump when the driver's own semantics change in a way that must
+# invalidate cached results (cache keys include it alongside the rule
+# module hashes)
+DRIVER_VERSION = 1
+
+_SUPPRESS_RE = re.compile(r"#\s*easydist:\s*disable=([A-Za-z0-9_, ]+)")
+
+# the modules whose source forms the "rule version" half of every cache
+# key: any edit to a rule (or to this driver) re-runs every layer
+_RULE_MODULE_FILES = (
+    "findings.py", "alias_rules.py", "strategy_rules.py",
+    "jaxpr_rules.py", "overlap_rules.py", "memory_rules.py",
+    "schedule_rules.py", "resilience_rules.py", "serve_rules.py",
+    "fleet_rules.py", "kv_rules.py", "reshard_rules.py", "sim_rules.py",
+    "discovery_rules.py", "driver.py",
+)
+
+
+def rule_version() -> str:
+    """Content hash of every rule module + the driver itself."""
+    h = hashlib.sha256(str(DRIVER_VERSION).encode())
+    base = os.path.dirname(os.path.abspath(__file__))
+    for name in _RULE_MODULE_FILES:
+        path = os.path.join(base, name)
+        try:
+            with open(path, "rb") as f:
+                h.update(name.encode())
+                h.update(f.read())
+        except OSError:
+            h.update(f"missing:{name}".encode())
+    return h.hexdigest()[:16]
+
+
+def finding_to_dict(f: Finding) -> Dict[str, object]:
+    return {"rule_id": f.rule_id, "severity": f.severity, "node": f.node,
+            "message": f.message, "path": f.path, "line": f.line}
+
+
+def finding_from_dict(d: Dict[str, object]) -> Finding:
+    return Finding(str(d["rule_id"]), str(d["severity"]), str(d["node"]),
+                   str(d["message"]), path=d.get("path"),
+                   line=d.get("line"))
+
+
+# ------------------------------------------------------------ suppressions
+
+
+def collect_suppressions(source: str) -> Dict[int, Set[str]]:
+    """{1-based line -> rule ids} for every `# easydist: disable=...`
+    comment — real COMMENT tokens only (a docstring that *mentions* the
+    syntax is not a suppression).  Unknown rule ids are kept (they still
+    mark the suppression as present, and DRV001 will flag them as
+    unused)."""
+    import io
+    import tokenize
+
+    out: Dict[int, Set[str]] = {}
+    try:
+        tokens = list(tokenize.generate_tokens(
+            io.StringIO(source).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return out
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            if rules:
+                out.setdefault(tok.start[0], set()).update(rules)
+    return out
+
+
+def apply_suppressions(findings: List[Finding],
+                       suppressions: Dict[int, Set[str]],
+                       rel_path: str) -> Tuple[List[Finding], int]:
+    """Drop findings whose (line, rule) is suppressed; append one DRV001
+    per suppression entry that silenced nothing.  Returns
+    (kept + DRV001 findings, n_suppressed)."""
+    used: Set[Tuple[int, str]] = set()
+    kept: List[Finding] = []
+    n_suppressed = 0
+    for f in findings:
+        rules = suppressions.get(f.line or -1, ())
+        if f.rule_id in rules:
+            used.add((f.line, f.rule_id))
+            n_suppressed += 1
+        else:
+            kept.append(f)
+    for line, rules in sorted(suppressions.items()):
+        for rule in sorted(rules):
+            if (line, rule) not in used:
+                kept.append(make_finding(
+                    "DRV001", f"{rel_path}:{line}",
+                    f"suppression for {rule} silences nothing on this "
+                    f"line — remove it (stale escapes hide future "
+                    f"regressions)", path=rel_path, line=line))
+    return kept, n_suppressed
+
+
+# ---------------------------------------------------------------- baseline
+
+
+def load_baseline(path: Optional[str]) -> Set[str]:
+    """Fingerprints from a committed baseline file; {} when absent."""
+    if not path or not os.path.exists(path):
+        return set()
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        return {str(e["fingerprint"]) for e in data.get("findings", [])}
+    except (OSError, ValueError, KeyError, TypeError):
+        return set()
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    """Rewrite the baseline from the current (post-suppression) report.
+    Entries keep human-readable context next to the fingerprint so a
+    reviewer can see WHAT was baselined, and are sorted for stable
+    diffs."""
+    entries = sorted(
+        ({"fingerprint": f.fingerprint(), "rule_id": f.rule_id,
+          "path": f.path, "node": f.node, "message": f.message}
+         for f in findings),
+        key=lambda e: (e["fingerprint"], e["message"]))
+    seen: Set[str] = set()
+    unique = [e for e in entries
+              if not (e["fingerprint"] in seen or seen.add(e["fingerprint"]))]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "comment":
+                   "legacy analyzer findings; new findings gate. "
+                   "Refresh: python -m easydist_tpu.analyze "
+                   "--refresh-baseline (see README).",
+                   "findings": unique}, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+# ------------------------------------------------------------------- cache
+
+
+class ResultCache:
+    """Incremental result store: one JSON file per (unit key) under
+    `<compile_cache_dir>/analyze/`.  Keys embed the artifact content
+    hash AND the rule version, so both an artifact edit and a rule edit
+    miss cleanly; stale entries are just dead files."""
+
+    def __init__(self, cache_dir: Optional[str] = None,
+                 enabled: bool = True):
+        from easydist_tpu import config as edconfig
+
+        self.dir = cache_dir or os.path.join(edconfig.compile_cache_dir,
+                                             "analyze")
+        self.enabled = enabled
+        self.hits = 0
+        self.misses = 0
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.dir, f"{key}.json")
+
+    def get(self, key: str) -> Optional[Dict[str, object]]:
+        if not self.enabled:
+            return None
+        try:
+            with open(self._path(key), "r", encoding="utf-8") as f:
+                payload = json.load(f)
+            self.hits += 1
+            return payload
+        except (OSError, ValueError):
+            self.misses += 1
+            return None
+
+    def put(self, key: str, payload: Dict[str, object]) -> None:
+        if not self.enabled:
+            return
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            tmp = self._path(key) + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(payload, f)
+            os.replace(tmp, self._path(key))
+        except OSError:  # a read-only cache dir must not break analysis
+            pass
+
+
+def _sha(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()[:24]
+
+
+# ----------------------------------------------------------------- targets
+
+
+def run_ast_target(root: str, cache: ResultCache,
+                   rules_ver: str) -> Tuple[List[Finding], int, int]:
+    """Layer-11 AST lint over the repo, file by file, each file's
+    (post-suppression) result cached on its content hash.  Returns
+    (findings, n_files, n_suppressed)."""
+    from .alias_rules import lint_file_donation
+
+    findings: List[Finding] = []
+    n_files = 0
+    n_suppressed = 0
+    for sub in ("easydist_tpu", "examples"):
+        base = os.path.join(root, sub)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, root)
+                try:
+                    with open(full, "rb") as f:
+                        raw = f.read()
+                except OSError:
+                    continue
+                n_files += 1
+                key = f"ast-{_sha(raw + rules_ver.encode())}"
+                hit = cache.get(key)
+                if hit is not None:
+                    findings.extend(finding_from_dict(d)
+                                    for d in hit["findings"])
+                    n_suppressed += int(hit.get("suppressed", 0))
+                    continue
+                source = raw.decode("utf-8", errors="replace")
+                raw_findings = lint_file_donation(full, rel=rel,
+                                                  source=source)
+                kept, n_sup = apply_suppressions(
+                    raw_findings, collect_suppressions(source), rel)
+                cache.put(key, {"findings": [finding_to_dict(f)
+                                             for f in kept],
+                                "suppressed": n_sup})
+                findings.extend(kept)
+                n_suppressed += n_sup
+    return findings, n_files, n_suppressed
+
+
+def _package_hash(root: str) -> str:
+    """Content hash of every .py under easydist_tpu/ — the `presets`
+    target's artifact identity (unchanged source => identical compile
+    => replay the cached report)."""
+    h = hashlib.sha256()
+    base = os.path.join(root, "easydist_tpu")
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                full = os.path.join(dirpath, fn)
+                try:
+                    with open(full, "rb") as f:
+                        h.update(os.path.relpath(full, root).encode())
+                        h.update(f.read())
+                except OSError:
+                    pass
+    return h.hexdigest()[:24]
+
+
+def run_presets_target(root: str, cache: ResultCache,
+                       rules_ver: str) -> List[Finding]:
+    """Compile a small auto-solved MLP train step and run the full
+    `CompileResult.analyze()` stack over it (layers 1-3 + the layer-11
+    donation-pair audit ride the same report).  Cached on the package
+    source hash: a warm rerun skips the solver+trace entirely."""
+    key = f"preset-mlp-{_sha((_package_hash(root) + rules_ver).encode())}"
+    hit = cache.get(key)
+    if hit is not None:
+        return [finding_from_dict(d) for d in hit["findings"]]
+
+    import jax
+    import jax.numpy as jnp
+
+    from easydist_tpu.jaxfront import easydist_compile, make_device_mesh
+    from easydist_tpu.models import mlp_apply, mlp_init
+
+    n_dev = len(jax.devices())
+    if n_dev >= 2 and n_dev % 2 == 0:
+        mesh = make_device_mesh((n_dev // 2, 2), ("dp", "tp"))
+    else:
+        mesh = make_device_mesh((n_dev,), ("dp",))
+    params = mlp_init(jax.random.PRNGKey(0), sizes=(64, 128, 64))
+    x = jax.random.normal(jax.random.PRNGKey(1), (8 * max(1, n_dev), 64))
+    y = jax.random.normal(jax.random.PRNGKey(2), (8 * max(1, n_dev), 64))
+
+    def step(p, xb, yb):
+        def loss_fn(p):
+            return jnp.mean((mlp_apply(p, xb) - yb) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        return jax.tree_util.tree_map(
+            lambda a, g: a - 0.05 * g, p, grads), loss
+
+    compiled = easydist_compile(step, mesh=mesh, compile_only=True)
+    compiled(params, x, y)
+    report = compiled.analyze(raise_on_error=False, export=False)
+    findings = [Finding(f.rule_id, f.severity, f.node, f.message,
+                        path=getattr(f, "path", None),
+                        line=getattr(f, "line", None))
+                for f in report.findings]
+    cache.put(key, {"findings": [finding_to_dict(f) for f in findings]})
+    return findings
+
+
+# ------------------------------------------------------------------ driver
+
+
+@dataclass
+class DriverResult:
+    report: AnalysisReport
+    new_errors: List[Finding] = field(default_factory=list)
+    baselined: int = 0
+    suppressed: int = 0
+    skipped: bool = False
+    targets: Tuple[str, ...] = ()
+    n_files: int = 0
+    cache_hits: int = 0
+    cache_misses: int = 0
+    wall_s: float = 0.0
+
+    def to_json(self) -> Dict[str, object]:
+        return {
+            "skipped": self.skipped,
+            "targets": list(self.targets),
+            "counts": self.report.counts(),
+            "rules": self.report.rule_counts(),
+            "new_errors": [finding_to_dict(f) for f in self.new_errors],
+            "baselined": self.baselined,
+            "suppressed": self.suppressed,
+            "n_files": self.n_files,
+            "cache": {"hits": self.cache_hits,
+                      "misses": self.cache_misses},
+            "findings": [finding_to_dict(f)
+                         for f in self.report.findings],
+            "wall_s": round(self.wall_s, 3),
+        }
+
+
+def run_driver(root: str, targets: Iterable[str] = ("ast", "presets"),
+               baseline_path: Optional[str] = None,
+               use_cache: bool = True,
+               cache_dir: Optional[str] = None) -> DriverResult:
+    """Run the requested targets, apply the baseline, and return the
+    aggregated result.  Never raises on findings — the exit decision
+    (gate on `new_errors`) belongs to the caller."""
+    from easydist_tpu import config as edconfig
+
+    t0 = time.perf_counter()
+    targets = tuple(targets)
+    if not edconfig.enable_analyze:
+        return DriverResult(report=AnalysisReport(), skipped=True,
+                            targets=targets,
+                            wall_s=time.perf_counter() - t0)
+    cache = ResultCache(cache_dir=cache_dir, enabled=use_cache)
+    rules_ver = rule_version()
+    report = AnalysisReport()
+    n_files = 0
+    n_suppressed = 0
+    for target in targets:
+        if target == "ast":
+            fs, n_files, n_sup = run_ast_target(root, cache, rules_ver)
+            report.extend(fs)
+            n_suppressed += n_sup
+        elif target == "presets":
+            report.extend(run_presets_target(root, cache, rules_ver))
+        else:
+            raise ValueError(f"unknown analyze target {target!r} "
+                             f"(expected 'ast' or 'presets')")
+    baseline = load_baseline(baseline_path)
+    errors = report.errors()
+    new_errors = [f for f in errors if f.fingerprint() not in baseline]
+    baselined = len(errors) - len(new_errors)
+    return DriverResult(report=report, new_errors=new_errors,
+                        baselined=baselined, suppressed=n_suppressed,
+                        targets=targets, n_files=n_files,
+                        cache_hits=cache.hits,
+                        cache_misses=cache.misses,
+                        wall_s=time.perf_counter() - t0)
+
+
+# ------------------------------------------------------------------- SARIF
+
+_SARIF_LEVEL = {"error": "error", "warning": "warning", "info": "note"}
+
+
+def export_sarif(findings: Iterable[Finding]) -> Dict[str, object]:
+    """Minimal SARIF 2.1.0 document over the findings (rule metadata
+    from the registry; findings without source coordinates anchor to
+    their artifact node in the message only)."""
+    findings = list(findings)
+    used_rules = sorted({f.rule_id for f in findings})
+    results = []
+    for f in findings:
+        res: Dict[str, object] = {
+            "ruleId": f.rule_id,
+            "level": _SARIF_LEVEL.get(f.severity, "warning"),
+            "message": {"text": f"{f.node}: {f.message}"},
+        }
+        if f.path:
+            res["locations"] = [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": int(f.line or 1)},
+                }}]
+        results.append(res)
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "easydist-analyze",
+                "informationUri":
+                    "https://github.com/alibaba/easydist",
+                "rules": [{"id": rid,
+                           "shortDescription": {"text": RULES[rid][1]},
+                           "defaultConfiguration":
+                               {"level": _SARIF_LEVEL.get(RULES[rid][0],
+                                                          "warning")}}
+                          for rid in used_rules],
+            }},
+            "results": results,
+        }],
+    }

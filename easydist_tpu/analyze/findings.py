@@ -208,17 +208,94 @@ RULES: Dict[str, tuple] = {
                 "analytic preset — the preset declined this instance, so "
                 "the compile pays the probe harness for an op the preset "
                 "bank claims to cover"),
+    # ---- layer 11: donation/aliasing sanitizer (analyze/alias_rules.py)
+    "ALIAS001": (SEV_ERROR,
+                 "donated invar used after its consuming dispatch: a "
+                 "later equation (or the program output) reads a buffer "
+                 "XLA is free to overwrite in place — bitwise-correct on "
+                 "CPU (donation ignored) and silently corrupt on TPU"),
+    "ALIAS002": (SEV_ERROR,
+                 "double donation: two donated invars alias one "
+                 "underlying buffer (or one output claims two donated "
+                 "inputs) — XLA reuses the storage twice and one write "
+                 "clobbers the other"),
+    "ALIAS003": (SEV_ERROR,
+                 "donation declared but unhonorable: the donated input "
+                 "matches no output's shape/dtype/sharding, so XLA "
+                 "silently copies instead of updating in place — the "
+                 "in-place win the donation was written for never "
+                 "happens"),
+    "ALIAS004": (SEV_ERROR,
+                 "donated device buffer reachable from a live host "
+                 "reference across a step boundary (snapshot, hot-page "
+                 "export, trie-held staging row): the next donating "
+                 "dispatch invalidates storage the host still reads"),
+    # ---- analyzer driver (analyze/driver.py)
+    "DRV001": (SEV_WARNING,
+               "unused inline suppression: an `# easydist: disable=...` "
+               "comment names a rule that produced no finding on that "
+               "line — stale suppressions hide future regressions"),
 }
+
+# layer index: (layer label, ordering key, rule-id prefixes, escape hatch).
+# docs/ANALYZE.md's per-rule index table is generated from RULES + this
+# table (tests/test_analyze/test_docs_drift.py keeps them in sync).
+KILL_SWITCH = "EASYDIST_ANALYZE=0"
+RAISE_SWITCH = "EASYDIST_ANALYZE_RAISE=0"
+
+LAYERS: List[tuple] = [
+    ("1 strategy", ("STRAT",)),
+    ("2 collectives", ("COLL",)),
+    ("2b overlap", ("OVL",)),
+    ("3a memory", ("MEM",)),
+    ("3b schedule", ("SCHED",)),
+    ("4 resilience", ("RES",)),
+    ("5 serving", ("SERVE",)),
+    ("6 fleet", ("FLEET",)),
+    ("7 paged KV", ("KV",)),
+    ("8 reshard", ("RESHARD",)),
+    ("9 simulator", ("SIM",)),
+    ("10 discovery", ("DISC",)),
+    ("11 aliasing", ("ALIAS",)),
+    ("driver", ("DRV",)),
+]
+
+
+def layer_of(rule_id: str) -> str:
+    """Layer label for a rule id (longest matching registered prefix)."""
+    best = ""
+    label = "?"
+    for name, prefixes in LAYERS:
+        for p in prefixes:
+            if rule_id.startswith(p) and len(p) > len(best):
+                best, label = p, name
+    return label
+
+
+def rule_index_rows() -> List[tuple]:
+    """(layer, rule_id, severity, escape hatch) rows for every registered
+    rule, in catalog order — the docs/ANALYZE.md index table's source."""
+    rows = []
+    for rule_id, (sev, _title) in RULES.items():
+        hatch = KILL_SWITCH if sev != SEV_ERROR else (
+            f"{KILL_SWITCH} / {RAISE_SWITCH}")
+        rows.append((layer_of(rule_id), rule_id, sev, hatch))
+    return rows
 
 
 @dataclass(frozen=True)
 class Finding:
-    """One rule violation at one graph/jaxpr location."""
+    """One rule violation at one graph/jaxpr location.  `path`/`line`
+    are optional source coordinates (the AST lint and the driver's
+    suppression/SARIF machinery use them; artifact-level rules leave
+    them unset)."""
 
     rule_id: str
     severity: str
     node: str
     message: str
+    path: Optional[str] = None
+    line: Optional[int] = None
 
     def __post_init__(self):
         if self.rule_id not in RULES:
@@ -227,13 +304,25 @@ class Finding:
             raise ValueError(f"bad severity {self.severity!r}")
 
     def __str__(self) -> str:
-        return f"[{self.rule_id}:{self.severity}] {self.node}: {self.message}"
+        where = f"{self.path}:{self.line}: " if self.path else ""
+        return (f"[{self.rule_id}:{self.severity}] {where}{self.node}: "
+                f"{self.message}")
+
+    def fingerprint(self) -> str:
+        """Stable identity for baselining: rule + location + node.  The
+        message is EXCLUDED so a reworded diagnostic doesn't churn the
+        baseline, and the line number is excluded so unrelated edits
+        above a legacy finding don't un-baseline it."""
+        return f"{self.rule_id}|{self.path or ''}|{self.node}"
 
 
 def make_finding(rule_id: str, node: str, message: str,
-                 severity: Optional[str] = None) -> Finding:
+                 severity: Optional[str] = None,
+                 path: Optional[str] = None,
+                 line: Optional[int] = None) -> Finding:
     """Finding with the rule's registered default severity."""
-    return Finding(rule_id, severity or RULES[rule_id][0], node, message)
+    return Finding(rule_id, severity or RULES[rule_id][0], node, message,
+                   path=path, line=line)
 
 
 class AnalysisError(RuntimeError):
@@ -306,9 +395,12 @@ class AnalysisReport:
             "counts": self.counts(),
             "rules": self.rule_counts(),
             # cap the stored detail: the counts are the gate, the first
-            # findings are the debugging breadcrumb
+            # findings are the debugging breadcrumb — findings_truncated
+            # records how many fell off the cap so a capped export can't
+            # masquerade as the full list
             "findings": [(f.rule_id, f.severity, f.node, f.message)
                          for f in self.findings[:50]],
+            "findings_truncated": max(0, len(self.findings) - 50),
         }
         db = db or PerfDB()
         db.record_op_perf("analyze_stats", sub_key, payload)

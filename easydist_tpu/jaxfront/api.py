@@ -421,6 +421,12 @@ class CompileResult:
         # per-axis solver-objective audit records (set by _finish_compile)
         self.analysis_findings: List[object] = []
         self.solver_audits: List[Dict[str, float]] = []
+        # state threading declaration {flat out idx -> flat in idx} and
+        # the donated flat input indices (set by _finish_compile) — the
+        # layer-11 donation/aliasing audit surface
+        self.state_pairs: Dict[int, int] = {}
+        self.donated_invars: tuple = ()
+        self.donated_args: tuple = ()
         # set by _finish_compile for the memory analyzer (layer 3)
         self.closed_jaxpr = None
         self.remat_plan = None
@@ -435,11 +441,16 @@ class CompileResult:
         sharded function re-traced on abstract values — partial-region
         fences and comm collectives included, no device execution), plus,
         when `include_memory`, the layer-3 memory verifier (graph memory
-        plan audit, HBM budget gate, remat-rewrite audit).
+        plan audit, HBM budget gate, remat-rewrite audit), plus the
+        layer-11 donation/aliasing sanitizer (ALIAS001/002 over the
+        traced program's donating dispatches, ALIAS002/003 over the
+        declared state pairs — the silent-copy and double-claim cases).
         Returns an AnalysisReport; raising is the CALLER's decision
         (CompiledFunction.analyze gates it on `edconfig.analyze_raise`)."""
-        from easydist_tpu.analyze import (AnalysisReport, lint_jaxpr,
-                                          make_finding)
+        from easydist_tpu.analyze import (AnalysisReport,
+                                          audit_donation_pairs,
+                                          audit_jaxpr_donation,
+                                          lint_jaxpr, make_finding)
 
         report = AnalysisReport(self.analysis_findings)
         traced = None
@@ -454,6 +465,13 @@ class CompileResult:
                     "COLL000", "emitted-program",
                     f"program lint skipped: retrace failed "
                     f"({type(e).__name__}: {e})"))
+            if traced is not None:
+                # honorability (ALIAS003) is audited via the state pairs
+                # below, where the out<->in context is attached
+                report.extend(audit_jaxpr_donation(
+                    traced.jaxpr, node="emitted-program",
+                    check_unhonored=False))
+            report.extend(audit_donation_pairs(self, node="state-io"))
         if include_memory:
             report.extend(self._memory_findings(traced))
         return report
@@ -1059,6 +1077,9 @@ def _finish_compile(closed_jaxpr, jaxpr, names, per_axis, graph, axis_specs,
     # pytree-native wrapper donates
     result.donated_invars = donate
     result.donated_args = tuple(donate_args)
+    # the declared state threading, for the layer-11 donation-pair audit
+    # (ALIAS002 double-claimed inputs, ALIAS003 unhonorable pairs)
+    result.state_pairs = dict(state_pairs)
     result.replicated_flops_fraction = replicated_fraction
     result.analysis_findings = list(analysis_findings or [])
     result.solver_audits = list(solver_audits or [])

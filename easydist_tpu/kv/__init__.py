@@ -35,4 +35,17 @@ from __future__ import annotations
 from .pool import PagePool
 from .table import PageTable
 
-__all__ = ["PagePool", "PageTable"]
+__all__ = ["PagePool", "PageTable", "is_page_ref"]
+
+
+def is_page_ref(kv) -> bool:
+    """True iff a trie-committed kv value is a page REFERENCE
+    (`{"page": id}`) rather than materialized arrays.
+
+    This is the paged layout's aliasing contract: the arena is donated
+    to every compiled dispatch, so host bookkeeping (trie nodes, resume
+    descriptors, transport manifests) must hold *indices into* the
+    arena, never the arena arrays themselves — a retained array
+    reference is storage the next donating dispatch invalidates
+    (analyze layer 11, ALIAS004)."""
+    return isinstance(kv, dict) and set(kv) == {"page"}

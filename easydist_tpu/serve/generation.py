@@ -1006,6 +1006,7 @@ class GenerationSession:
         if pool.bucket not in self._audited:
             self._audited.add(pool.bucket)
             self._audit_donation(result, pool.bucket)
+            self._audit_host_aliases(pool)
             if self._paged:
                 self._audit_kv(pool, "first_decode")
         t0 = time.perf_counter()
@@ -1291,6 +1292,26 @@ class GenerationSession:
         except ImportError:
             pass
 
+    def _audit_host_aliases(self, pool) -> None:
+        """ALIAS004: the buffers the next dispatch donates (cache +
+        staging, or the paged arena) must not be reachable from
+        host-held references that outlive the step — trie nodes must
+        hold `_extract` COPIES (bucketed) or page references (paged,
+        `kv.is_page_ref`), never the donated arrays themselves."""
+        try:
+            from easydist_tpu.analyze import check_host_aliases
+        except ImportError:  # analyze is an optional layer at runtime
+            return
+        if self._paged:
+            donated = {"arena": pool.arena}
+        else:
+            donated = {"cache": pool.cache, "staging": pool.staging}
+        holders = {}
+        if pool.trie is not None:
+            holders["trie"] = [node.kv for node in pool.trie._walk()]
+        check_host_aliases(donated, holders,
+                           node=f"session[bucket={pool.bucket}]")
+
     def _audit_kv(self, pool: _PagedPool, where: str) -> None:
         """KV001: page-table/refcount audit at the state transitions
         where drift would matter (first decode, every retire)."""
@@ -1390,9 +1411,11 @@ class GenerationSession:
         so exported paths are layout-agnostic on the wire."""
         import jax.numpy as jnp
 
+        from easydist_tpu.kv import is_page_ref
+
         out = []
         for key, kv in path:
-            if isinstance(kv, dict) and set(kv) == {"page"}:
+            if is_page_ref(kv):
                 kv = self._paged_c("export")(
                     pool.arena, jnp.asarray(int(kv["page"]), jnp.int32))
             out.append((key, kv))

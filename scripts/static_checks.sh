@@ -52,6 +52,27 @@ else
     echo "static_checks: jax not importable; skipping bench.py --analyze"
 fi
 
+# analyzer driver gate (docs/ANALYZE.md "Driver"): the layer-11 host
+# donation lint + the preset analyze stack behind the shared driver —
+# inline suppressions and the committed baseline (analyze_baseline.json)
+# applied, SARIF artifact emitted for CI, incremental cache warm across
+# repeat runs.  Fails on any NON-BASELINED error; refresh the baseline
+# with `python -m easydist_tpu.analyze --refresh-baseline` (see README).
+if python -c "import jax" >/dev/null 2>&1; then
+    echo "== python -m easydist_tpu.analyze (driver gate: ast + presets)"
+    mkdir -p "${EASYDIST_ARTIFACT_DIR:-/tmp/easydist_artifacts}"
+    sarif="${EASYDIST_ARTIFACT_DIR:-/tmp/easydist_artifacts}/analyze.sarif"
+    python -m easydist_tpu.analyze --targets ast,presets \
+        --sarif "$sarif" || {
+        echo "static_checks: analyzer driver reported new (non-baselined)" \
+             "error finding(s)"
+        rc=1
+    }
+    [ -s "$sarif" ] && echo "static_checks: SARIF artifact at $sarif"
+else
+    echo "static_checks: jax not importable; skipping the analyzer driver"
+fi
+
 # overlapped-collectives gate: the backward-ordered barrier-pinned flush
 # must stay bitwise-identical to the sequential one (quantization off) and
 # the emission-ordered bucket chain must expose a nonzero SCHEDULABLE

@@ -1,0 +1,75 @@
+"""RULES <-> docs/ANALYZE.md drift tripwire, both directions: the
+generated rule-index table in the doc must match a fresh
+`rule_index_rows()` regeneration line-for-line, every registered rule
+must be documented, and every rule-id-shaped token the doc mentions
+must be registered (a renamed or deleted rule cannot leave ghost docs
+behind)."""
+
+import os
+import re
+
+from easydist_tpu.analyze.findings import (KILL_SWITCH, LAYERS,
+                                           RAISE_SWITCH, RULES, layer_of,
+                                           rule_index_rows)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DOC = os.path.join(REPO, "docs", "ANALYZE.md")
+
+_BEGIN = "<!-- rule-index:begin -->"
+_END = "<!-- rule-index:end -->"
+_RULE_TOKEN = re.compile(r"\b([A-Z]{2,10}\d{3})\b")
+
+
+def _doc_text():
+    with open(DOC, encoding="utf-8") as f:
+        return f.read()
+
+
+def _expected_table_lines():
+    lines = ["| layer | id | sev | escape hatch |", "|---|---|---|---|"]
+    for layer, rid, sev, hatch in rule_index_rows():
+        hatch_md = " / ".join(f"`{part}`" for part in hatch.split(" / "))
+        lines.append(f"| {layer} | {rid} | {sev} | {hatch_md} |")
+    return lines
+
+
+def test_index_table_matches_regeneration_exactly():
+    text = _doc_text()
+    assert _BEGIN in text and _END in text, \
+        "docs/ANALYZE.md lost its generated rule-index markers"
+    block = text.split(_BEGIN, 1)[1].split(_END, 1)[0]
+    got = [ln for ln in block.strip().splitlines() if ln.strip()]
+    assert got == _expected_table_lines(), (
+        "docs/ANALYZE.md rule index drifted from findings.py — "
+        "regenerate the block between the rule-index markers from "
+        "rule_index_rows()")
+
+
+def test_every_registered_rule_is_documented():
+    text = _doc_text()
+    missing = [rid for rid in RULES if rid not in text]
+    assert not missing, f"rules missing from docs/ANALYZE.md: {missing}"
+
+
+def test_every_documented_rule_id_is_registered():
+    # tokens shaped like rule ids (PREFIX + 3 digits) anywhere in the
+    # doc must resolve to the registry — ghost docs for renamed rules
+    # are drift too
+    ghosts = sorted({tok for tok in _RULE_TOKEN.findall(_doc_text())
+                     if tok not in RULES})
+    assert not ghosts, f"docs/ANALYZE.md mentions unregistered: {ghosts}"
+
+
+def test_every_rule_maps_to_a_layer():
+    prefixes = {p for _, ps in LAYERS for p in ps}
+    for rid in RULES:
+        assert layer_of(rid) != "?", f"{rid} matches no layer prefix"
+        assert any(rid.startswith(p) for p in prefixes)
+
+
+def test_escape_hatches_documented():
+    text = _doc_text()
+    assert KILL_SWITCH in text and RAISE_SWITCH in text
+    assert "# easydist: disable=" in text
+    assert "analyze_baseline.json" in text

@@ -1,0 +1,251 @@
+"""Analyzer driver: suppressions (+ DRV001 on stale ones), the
+fingerprint baseline gate, SARIF export, the incremental cache (warm
+rerun replays an identical report), the perfdb truncation counter, and
+the `python -m easydist_tpu.analyze` CLI's exit-code contract."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from easydist_tpu import config as edconfig
+from easydist_tpu.analyze.driver import (ResultCache, apply_suppressions,
+                                         collect_suppressions,
+                                         export_sarif, finding_to_dict,
+                                         load_baseline, rule_version,
+                                         run_driver, write_baseline)
+from easydist_tpu.analyze.findings import (AnalysisReport, Finding,
+                                           make_finding)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+BAD_SRC = (
+    "def step(self, pool):\n"
+    "    tok = self._decode_c(pool.cache, 3)\n"
+    "    return export(pool.cache)\n")
+
+
+def _mini_repo(tmp_path, source=BAD_SRC):
+    """A throwaway repo root with one lintable package file."""
+    pkg = tmp_path / "easydist_tpu"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "mod.py").write_text(source)
+    return str(tmp_path)
+
+
+def _run(root, tmp_path, **kw):
+    kw.setdefault("targets", ("ast",))
+    kw.setdefault("cache_dir", str(tmp_path / "cache"))
+    return run_driver(root, **kw)
+
+
+# ------------------------------------------------------- suppressions
+
+
+class TestSuppressions:
+    def test_comment_tokens_only(self):
+        src = ('"""docs mention # easydist: disable=ALIAS001 syntax"""\n'
+               "x = 1  # easydist: disable=ALIAS002, DRV001\n")
+        sup = collect_suppressions(src)
+        assert sup == {2: {"ALIAS002", "DRV001"}}
+
+    def test_used_suppression_drops_finding(self):
+        f = make_finding("ALIAS001", "n", "m", path="p.py", line=3)
+        kept, n_sup = apply_suppressions([f], {3: {"ALIAS001"}}, "p.py")
+        assert kept == [] and n_sup == 1
+
+    def test_unused_suppression_fires_drv001(self):
+        kept, n_sup = apply_suppressions([], {7: {"ALIAS001"}}, "p.py")
+        assert [f.rule_id for f in kept] == ["DRV001"]
+        assert kept[0].line == 7 and n_sup == 0
+
+    def test_inline_suppression_end_to_end(self, tmp_path):
+        src = BAD_SRC.replace(
+            "    return export(pool.cache)",
+            "    return export(pool.cache)  # easydist: disable=ALIAS001")
+        root = _mini_repo(tmp_path, src)
+        res = _run(root, tmp_path)
+        assert res.report.findings == []
+        assert res.suppressed == 1 and res.new_errors == []
+
+
+# ----------------------------------------------------------- baseline
+
+
+class TestBaseline:
+    def test_round_trip(self, tmp_path):
+        f = make_finding("ALIAS001", "n", "m", path="p.py", line=3)
+        path = str(tmp_path / "baseline.json")
+        write_baseline(path, [f])
+        assert load_baseline(path) == {f.fingerprint()}
+        # absent / corrupt files degrade to an empty baseline
+        assert load_baseline(str(tmp_path / "missing.json")) == set()
+
+    def test_fingerprint_survives_line_and_message_drift(self):
+        a = make_finding("ALIAS001", "n", "old msg", path="p.py", line=3)
+        b = make_finding("ALIAS001", "n", "new msg", path="p.py", line=9)
+        assert a.fingerprint() == b.fingerprint()
+
+    def test_baselined_errors_do_not_gate(self, tmp_path):
+        root = _mini_repo(tmp_path)
+        first = _run(root, tmp_path)
+        assert [f.rule_id for f in first.new_errors] == ["ALIAS001"]
+        baseline = str(tmp_path / "baseline.json")
+        write_baseline(baseline, first.report.errors())
+        second = _run(root, tmp_path, baseline_path=baseline)
+        assert second.new_errors == [] and second.baselined == 1
+        # the finding still REPORTS — baselining hides nothing
+        assert [f.rule_id for f in second.report.findings] == ["ALIAS001"]
+
+    def test_committed_baseline_is_valid_and_empty(self):
+        path = os.path.join(REPO, "analyze_baseline.json")
+        with open(path) as f:
+            data = json.load(f)
+        assert data["version"] == 1
+        assert data["findings"] == []  # no legacy debt: keep it that way
+
+
+# ------------------------------------------------------- incremental cache
+
+
+class TestCache:
+    def test_warm_rerun_is_identical_and_cached(self, tmp_path):
+        root = _mini_repo(tmp_path)
+        cold = _run(root, tmp_path)
+        assert cold.cache_hits == 0 and cold.cache_misses > 0
+        warm = _run(root, tmp_path)
+        assert warm.cache_hits == cold.cache_misses
+        assert warm.cache_misses == 0
+        assert ([finding_to_dict(f) for f in warm.report.findings]
+                == [finding_to_dict(f) for f in cold.report.findings])
+        assert warm.suppressed == cold.suppressed
+
+    def test_source_edit_invalidates_one_file(self, tmp_path):
+        root = _mini_repo(tmp_path)
+        _run(root, tmp_path)
+        (tmp_path / "easydist_tpu" / "mod.py").write_text(
+            BAD_SRC.replace("export(pool.cache)", "export(None)"))
+        res = _run(root, tmp_path)
+        assert res.cache_misses == 1 and res.report.findings == []
+
+    def test_rule_version_is_content_hash(self):
+        v = rule_version()
+        assert isinstance(v, str) and len(v) == 16
+        assert v == rule_version()
+
+    def test_no_cache_flag(self, tmp_path):
+        root = _mini_repo(tmp_path)
+        res = _run(root, tmp_path, use_cache=False)
+        res2 = _run(root, tmp_path, use_cache=False)
+        assert res2.cache_hits == 0 == res.cache_hits
+
+    def test_readonly_cache_dir_does_not_break(self, tmp_path):
+        cache = ResultCache(cache_dir="/proc/nonexistent/analyze")
+        cache.put("k", {"findings": []})
+        assert cache.get("k") is None
+
+
+# ----------------------------------------------------------- kill switch
+
+
+def test_driver_skips_under_kill_switch(tmp_path, monkeypatch):
+    monkeypatch.setattr(edconfig, "enable_analyze", False)
+    res = _run(_mini_repo(tmp_path), tmp_path)
+    assert res.skipped and res.report.findings == []
+    assert res.new_errors == []
+
+
+# ---------------------------------------------------------------- SARIF
+
+
+class TestSarif:
+    def test_sarif_document_shape(self):
+        fs = [make_finding("ALIAS001", "n", "m", path="p.py", line=3),
+              make_finding("DRV001", "n2", "m2")]
+        doc = export_sarif(fs)
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        rules = {r["id"]: r for r in run["tool"]["driver"]["rules"]}
+        assert set(rules) == {"ALIAS001", "DRV001"}
+        assert rules["ALIAS001"]["defaultConfiguration"]["level"] == "error"
+        assert rules["DRV001"]["defaultConfiguration"]["level"] == "warning"
+        with_loc, without_loc = run["results"]
+        assert with_loc["locations"][0]["physicalLocation"][
+            "artifactLocation"]["uri"] == "p.py"
+        assert with_loc["locations"][0]["physicalLocation"][
+            "region"]["startLine"] == 3
+        assert "locations" not in without_loc
+
+    def test_info_maps_to_note(self):
+        doc = export_sarif([make_finding("MEM000", "n", "m")])
+        assert doc["runs"][0]["results"][0]["level"] == "note"
+
+
+# -------------------------------------------------- perfdb truncation
+
+
+class _StubDB:
+    def __init__(self):
+        self.recorded = None
+
+    def record_op_perf(self, kind, key, payload):
+        self.recorded = payload
+
+    def persist(self):
+        pass
+
+
+class TestPerfdbTruncation:
+    def test_truncated_count_over_cap(self):
+        report = AnalysisReport(
+            make_finding("DRV001", f"n{i}", "m") for i in range(60))
+        db = _StubDB()
+        payload = report.export_to_perfdb(db=db)
+        assert db.recorded is payload
+        assert len(payload["findings"]) == 50
+        assert payload["findings_truncated"] == 10
+
+    def test_zero_when_under_cap(self):
+        payload = AnalysisReport(
+            [make_finding("DRV001", "n", "m")]).export_to_perfdb(
+                db=_StubDB())
+        assert payload["findings_truncated"] == 0
+
+
+# ------------------------------------------------------------------ CLI
+
+
+class TestCli:
+    def _cli(self, tmp_path, *args):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=REPO)
+        return subprocess.run(
+            [sys.executable, "-m", "easydist_tpu.analyze",
+             "--targets", "ast", "--cache-dir",
+             str(tmp_path / "clicache"), *args],
+            capture_output=True, text=True, env=env, cwd=REPO)
+
+    def test_gate_then_refresh_then_pass(self, tmp_path):
+        root = _mini_repo(tmp_path)
+        baseline = str(tmp_path / "baseline.json")
+        out_json = str(tmp_path / "report.json")
+        sarif = str(tmp_path / "report.sarif")
+        # 1: new error gates
+        proc = self._cli(tmp_path, "--root", root, "--baseline",
+                         baseline, "--json", out_json, "--sarif", sarif)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "ALIAS001" in proc.stdout
+        data = json.load(open(out_json))
+        assert [f["rule_id"] for f in data["new_errors"]] == ["ALIAS001"]
+        assert json.load(open(sarif))["version"] == "2.1.0"
+        # 2: refresh the baseline, exit 0
+        proc = self._cli(tmp_path, "--root", root, "--baseline",
+                         baseline, "--refresh-baseline")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert load_baseline(baseline)
+        # 3: baselined error no longer gates
+        proc = self._cli(tmp_path, "--root", root, "--baseline", baseline)
+        assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,99 @@
+"""Kill-switch coverage: with EASYDIST_ANALYZE=0 in the environment,
+every check_* hook across layers 2-11 must return empty WITHOUT
+touching its arguments (junk sentinels would explode inside any rule
+body — the guard has to fire first), and the analyzer driver must
+report skipped.  With EASYDIST_ANALYZE_RAISE=0, error findings demote
+to returned-and-logged instead of raising.  Both run as subprocesses so
+the env var takes the real config-parsing path, not a monkeypatch."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_KILL_SCRIPT = r"""
+import easydist_tpu.analyze as an
+from easydist_tpu import config as edconfig
+from easydist_tpu.analyze.driver import run_driver
+
+assert edconfig.enable_analyze is False
+
+class Junk:  # any attribute/iteration access inside a rule body raises
+    def __getattr__(self, name):
+        raise AssertionError(f"hook touched .{name} with analyze off")
+    def __iter__(self):
+        raise AssertionError("hook iterated args with analyze off")
+
+j = Junk()
+# every argument-taking self-check hook, one per call signature
+assert an.check_bucket_plan(j, j) is None
+assert an.check_overlap_plan(j, j, j) is None
+assert an.check_schedule_tables(j, 2, 1, 4) is None
+assert an.check_decode_donation(j) == []
+assert an.check_chunked_prefill(j) == []
+assert an.check_speculative_rewind(j, draft=j, target=j) == []
+assert an.check_prefix_cache(j) == []
+assert an.check_page_table(j, j, trie=j) == []
+assert an.check_fleet_routing(j) == []
+assert an.check_page_handoff(j, j) == []
+assert an.check_fleet_drain(j) == []
+assert an.check_reshard_plan(j) == []
+assert an.check_restored_state(j, j) == []
+assert an.check_resume_descriptor(j, j) == []
+assert an.check_sim_prediction(j) == []
+assert an.check_sim_autoscale(j) == []
+assert an.check_donation_pairs(j) == []
+assert an.check_host_aliases(j, j) == []
+
+res = run_driver(".", targets=("ast", "presets"))
+assert res.skipped and res.report.findings == []
+print("KILLSWITCH_OK")
+"""
+
+_DEMOTE_SCRIPT = r"""
+import numpy as np
+from easydist_tpu import config as edconfig
+import easydist_tpu.analyze as an
+from easydist_tpu.kv import PagePool, PageTable
+
+assert edconfig.enable_analyze is True
+assert edconfig.analyze_raise is False
+
+# layer 11: a live host alias is an error finding — demoted, returned
+arr = np.zeros((2, 2), np.float32)
+fs = an.check_host_aliases({"cache": arr}, {"snapshot": arr})
+assert [f.rule_id for f in fs] == ["ALIAS004"], fs
+
+# layer 7: two table rows on a single refcount — demoted, returned
+pool = PagePool(4, 4, page_bytes=64)
+table = PageTable(2, 2, 4)
+pid = pool.alloc()
+table.map(0, 0, pid)
+table.map(1, 0, pid)
+fs = an.check_page_table(pool, table)
+assert any(f.rule_id == "KV001" for f in fs), fs
+print("DEMOTE_OK")
+"""
+
+
+def _run(script, env_extra):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               **env_extra)
+    return subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, env=env,
+                          cwd=REPO)
+
+
+def test_analyze_off_skips_every_hook_and_the_driver():
+    proc = _run(_KILL_SCRIPT, {"EASYDIST_ANALYZE": "0"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "KILLSWITCH_OK" in proc.stdout
+
+
+def test_raise_off_demotes_error_findings():
+    proc = _run(_DEMOTE_SCRIPT, {"EASYDIST_ANALYZE": "1",
+                                 "EASYDIST_ANALYZE_RAISE": "0"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "DEMOTE_OK" in proc.stdout
