@@ -1443,9 +1443,22 @@ def elastic_chaos_main():
         findings = sum(int(r.get("reshard_findings", 0))
                        for r in reports.values())
 
+        # layer-12 conformance: each restore's recorded attempt trail
+        # replays through the ResumeSpec-side validator — every OOM must
+        # be followed by exactly one halving, and "landed" must be the
+        # single terminal attempt (PROTO003 on drift)
+        from easydist_tpu.analyze.modelcheck import replay_restore_attempts
+        proto_findings = []
+        for name, r in reports.items():
+            attempts = r.get("attempts") or []
+            if attempts:
+                proto_findings.extend(replay_restore_attempts(
+                    attempts, node=f"drill:elastic_chaos:{name}"))
+
         ok = bool(final_bitwise and loss_bitwise and preempted
                   and unfired_total == 0 and shifts_seen == 2
-                  and peak_ok and findings == 0 and replayed)
+                  and peak_ok and findings == 0 and replayed
+                  and not proto_findings)
         result.update({
             "value": float(ok),
             "final_state_bitwise": final_bitwise,
@@ -1457,6 +1470,7 @@ def elastic_chaos_main():
             "topology_shifts_detected": int(shifts_seen),
             "restore_peak_within_bound": peak_ok,
             "reshard_findings": int(findings),
+            "proto_findings": len(proto_findings),
             "restores": reports,
             "mesh_cycle": [8, 4, 8],
             "n_chips": 8,
@@ -2138,6 +2152,27 @@ def fleet_chaos_main():
             ["counters"].get("verify_steps", 0)
             for rep in router.stats()["replicas"])
         routing_findings = audit_routing(router.decision_log)
+        # layer-12 conformance: the drill's recorded transitions()
+        # streams replay through the protocol spec automata (PROTO003
+        # fires on any event the spec does not admit).  Skipped only if
+        # the bounded protocol log overflowed — replaying a truncated
+        # stream would report false drift.
+        if router.protocol_events_dropped == 0:
+            from easydist_tpu.analyze.modelcheck import (
+                replay_health_events, replay_router_protocol,
+                replay_transport_commits)
+            proto_findings = (
+                replay_router_protocol(
+                    router.transitions(),
+                    node="drill:fleet_chaos:router")
+                + replay_health_events(
+                    router.health.transitions(),
+                    node="drill:fleet_chaos:health")
+                + replay_transport_commits(
+                    router.transport.transitions(),
+                    node="drill:fleet_chaos:transport"))
+        else:
+            proto_findings = []
         chaos_p99 = merged_ttft_p99_ms(router)
         inflation = chaos_p99 / calm_p99 if calm_p99 > 0 else 1.0
 
@@ -2151,7 +2186,8 @@ def fleet_chaos_main():
 
         ok = (parity and dropped == 0 and recovered > 0
               and crashes == 2 and unfired_total == 0
-              and not routing_findings and inflation <= p99_bound
+              and not routing_findings and not proto_findings
+              and inflation <= p99_bound
               and verify_total > 0)
         result.update(
             value=round(clean / n_req, 4),
@@ -2163,6 +2199,8 @@ def fleet_chaos_main():
             crash_targets=crash_targets,
             fault_plan_unfired=int(unfired_total),
             routing_findings=len(routing_findings),
+            proto_findings=len(proto_findings),
+            protocol_events=len(router.transitions()),
             speculate_k=3,
             verify_steps=int(verify_total),
             handoff_fallbacks=int(router.metrics.counter(

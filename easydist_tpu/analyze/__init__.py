@@ -86,7 +86,27 @@ A rule-based analyzer that runs after solving and before execution
            donation XLA cannot honor (shape/dtype mismatch with every
            output — the silent-copy case), ALIAS004 a donated device
            buffer still reachable from a live host reference across a
-           step boundary (snapshots, hot-page exports, trie-held rows).
+           step boundary (snapshots, hot-page exports, trie-held rows);
+  layer 12 fleet protocol model checker + concurrency sanitizer
+           (`audit_spec`, `check_protocol_specs`,
+           `check_protocol_conformance`, analyze/modelcheck.py +
+           analyze/protocol_rules.py) — an explicit-state explorer over
+           deterministic specs of the four fleet protocols
+           (HealthMonitor ALIVE/SUSPECT/DEAD, FleetRouter
+           drain/handoff/failover, ResumeDescriptor token-position
+           commit, KVTransport chunked idempotent retry) enumerating
+           EVERY interleaving of crash/duplicate/reorder/stall at small
+           committed scope: PROTO001 a safety violation (false DEAD,
+           double completion, double-commit) with the shortest
+           counterexample trace attached, PROTO002 a reachable stuck
+           state from which the goal is unreachable, PROTO003 drift
+           between a live component's recorded `transitions()` stream
+           (fleet/elastic drill logs replayed in CI) and the spec's
+           admitted behavior; plus the host-code concurrency lint —
+           PROTO004 a read of private fleet state across an object
+           boundary, PROTO005 a mutation of a shared fleet structure
+           outside its owning class (observers must consume snapshot
+           surfaces; single-writer is what keeps the specs faithful).
 
 Surfaced via `CompiledFunction.analyze()`, `bench.py --analyze`, the
 dryrun gate, and the analyzer driver (`python -m easydist_tpu.analyze`:
@@ -112,6 +132,13 @@ from .fleet_rules import (audit_drained_session, audit_page_handoff,
                           audit_resume, audit_routing)
 from .jaxpr_rules import lint_bucket_plan, lint_fn, lint_jaxpr
 from .kv_rules import audit_page_table
+from .modelcheck import (ALL_SPECS, COMMITTED_STATES, HealthSpec,
+                         ResumeSpec, RouterSpec, Spec, TransportSpec,
+                         audit_spec, explore, replay_health_events,
+                         replay_restore_attempts,
+                         replay_router_protocol,
+                         replay_transport_commits)
+from .protocol_rules import lint_file_concurrency, lint_host_concurrency
 from .memory_rules import (audit_remat_plan, check_hbm_budget,
                            recompute_liveness, remat_advisory,
                            resolve_hbm_budget, verify_memory_plan)
@@ -158,6 +185,12 @@ __all__ = [
     "audit_jaxpr_donation", "audit_donation_pairs",
     "audit_host_aliases", "lint_host_donation", "lint_file_donation",
     "check_donation_pairs", "check_host_aliases",
+    "Spec", "HealthSpec", "RouterSpec", "ResumeSpec", "TransportSpec",
+    "ALL_SPECS", "COMMITTED_STATES", "explore", "audit_spec",
+    "replay_health_events", "replay_router_protocol",
+    "replay_transport_commits", "replay_restore_attempts",
+    "lint_file_concurrency", "lint_host_concurrency",
+    "check_protocol_specs", "check_protocol_conformance",
     "LAYERS", "layer_of", "rule_index_rows",
 ]
 
@@ -485,6 +518,70 @@ def check_donation_pairs(result, node: str = "state-io"):
     if not edconfig.enable_analyze:
         return []
     findings = audit_donation_pairs(result, node=node)
+    report = AnalysisReport(findings)
+    if report.errors() and edconfig.analyze_raise:
+        report.raise_on_errors()
+    for f in findings:
+        logger.warning("[analyze] %s", f)
+    return findings
+
+
+def check_protocol_specs(specs=None, max_states: int = None,
+                         node: str = None):
+    """Layer-12a self-check hook: exhaustively explore the protocol
+    specs (default: the four shipped fleet protocols at committed
+    scope) and convert violations to findings — PROTO001 a safety
+    violation with the shortest counterexample interleaving, PROTO002 a
+    reachable stuck state.  Error findings raise under `analyze_raise`;
+    returns the findings so callers/tests can assert on them."""
+    from easydist_tpu import config as edconfig
+
+    if not edconfig.enable_analyze:
+        return []
+    from .modelcheck import MAX_STATES_DEFAULT
+
+    findings = []
+    for spec in (specs if specs is not None else ALL_SPECS()):
+        fs, _res = audit_spec(
+            spec, node=node,
+            max_states=max_states or MAX_STATES_DEFAULT)
+        findings.extend(fs)
+    report = AnalysisReport(findings)
+    if report.errors() and edconfig.analyze_raise:
+        report.raise_on_errors()
+    for f in findings:
+        logger.warning("[analyze] %s", f)
+    return findings
+
+
+def check_protocol_conformance(router=None, health=None, transport=None,
+                               restore_attempts=None,
+                               node: str = "drill"):
+    """Layer-12b conformance hook: replay live components' recorded
+    `transitions()` streams (and an elastic restore's attempt trail)
+    through the spec automata — PROTO003 fires on any event the spec
+    does not admit (a dropped completion, an illegal health edge, a
+    double KV commit, a restore halving that skipped a step).  The
+    fleet/elastic chaos drills call this after every run, so every CI
+    drill log doubles as a conformance trace.  Error findings raise
+    under `analyze_raise`; returns the findings."""
+    from easydist_tpu import config as edconfig
+
+    if not edconfig.enable_analyze:
+        return []
+    findings = []
+    if router is not None:
+        findings.extend(replay_router_protocol(
+            router.transitions(), node=f"{node}:router"))
+    if health is not None:
+        findings.extend(replay_health_events(
+            health.transitions(), node=f"{node}:health"))
+    if transport is not None:
+        findings.extend(replay_transport_commits(
+            transport.transitions(), node=f"{node}:transport"))
+    if restore_attempts is not None:
+        findings.extend(replay_restore_attempts(
+            restore_attempts, node=f"{node}:restore"))
     report = AnalysisReport(findings)
     if report.errors() and edconfig.analyze_raise:
         report.raise_on_errors()

@@ -6,6 +6,7 @@ skips every layer and exits 0 (the kill switch must win over the gate).
 
 Examples:
     python -m easydist_tpu.analyze --targets ast
+    python -m easydist_tpu.analyze --targets protocol --json out.json
     python -m easydist_tpu.analyze --sarif analyze.sarif --json out.json
     python -m easydist_tpu.analyze --refresh-baseline
 """
@@ -24,8 +25,9 @@ def main(argv=None) -> int:
         description="easydist-tpu static analyzer driver")
     parser.add_argument("--root", default=None,
                         help="repo root (default: the package's parent)")
-    parser.add_argument("--targets", default="ast,presets",
-                        help="comma list: ast,presets (default both)")
+    parser.add_argument("--targets", default="ast,presets,protocol",
+                        help="comma list: ast,presets,protocol "
+                             "(default all three)")
     parser.add_argument("--baseline", default=None,
                         help="baseline file (default: "
                              "<root>/analyze_baseline.json)")
@@ -102,6 +104,11 @@ def main(argv=None) -> int:
                   f"{result.n_files} file(s), cache {result.cache_hits} "
                   f"hit / {result.cache_misses} miss, "
                   f"{result.wall_s:.1f}s")
+            for name, st in sorted(result.protocol.items()):
+                print(f"  protocol[{name}]: {st['states']} states, "
+                      f"{st['transitions']} transitions "
+                      f"({'exhausted' if st['exhausted'] else 'CEILING'}"
+                      f", committed {st['committed']})")
             for f_ in result.new_errors[:20]:
                 print(f"  NEW {f_}")
             for f_ in result.report.findings:

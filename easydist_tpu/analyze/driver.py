@@ -1,16 +1,20 @@
 """Analyzer driver: one entry point over the whole rule stack.
 
-`python -m easydist_tpu.analyze` wraps the eleven analyze layers behind
+`python -m easydist_tpu.analyze` wraps the twelve analyze layers behind
 a single CLI with the shared infrastructure the per-layer hooks never
 had (the Automap argument: compile-time analysis scales only when the
 machinery — suppressions, baselines, artifact export, caching — is
 shared, arXiv:2112.02958):
 
-* **targets** — `ast` runs the layer-11 host-code donation lint over
-  `easydist_tpu/` + `examples/`; `presets` compiles a small auto-solved
-  preset and runs the full `CompileResult.analyze()` stack (strategy,
-  program lint, memory plan, donation pairs) over it.  `bench.py
-  --analyze` remains the heavyweight preset gate.
+* **targets** — `ast` runs the layer-11 host-code donation lint AND the
+  layer-12 concurrency sanitizer (PROTO004/005) over `easydist_tpu/` +
+  `examples/`; `presets` compiles a small auto-solved preset and runs
+  the full `CompileResult.analyze()` stack (strategy, program lint,
+  memory plan, donation pairs) over it; `protocol` exhaustively
+  explores the four layer-12 protocol specs (health, router, resume,
+  transport — analyze/modelcheck.py) and gates on PROTO001/002 plus
+  committed state-count drift.  `bench.py --analyze` remains the
+  heavyweight preset gate.
 * **inline suppressions** — `# easydist: disable=ALIAS001` (comma list
   for several rules) on the flagged line silences a finding; a
   suppression that silences nothing is itself reported (DRV001) so
@@ -61,7 +65,8 @@ _RULE_MODULE_FILES = (
     "jaxpr_rules.py", "overlap_rules.py", "memory_rules.py",
     "schedule_rules.py", "resilience_rules.py", "serve_rules.py",
     "fleet_rules.py", "kv_rules.py", "reshard_rules.py", "sim_rules.py",
-    "discovery_rules.py", "driver.py",
+    "discovery_rules.py", "modelcheck.py", "protocol_rules.py",
+    "driver.py",
 )
 
 
@@ -152,14 +157,48 @@ def apply_suppressions(findings: List[Finding],
 
 def load_baseline(path: Optional[str]) -> Set[str]:
     """Fingerprints from a committed baseline file; {} when absent."""
+    return {str(e.get("fingerprint"))
+            for e in load_baseline_entries(path)
+            if e.get("fingerprint")}
+
+
+def load_baseline_entries(path: Optional[str]) -> List[Dict[str, object]]:
+    """The baseline file's raw entry list ([] when absent/corrupt) —
+    the DRV002 stale-entry audit needs the context fields, not just the
+    fingerprints."""
     if not path or not os.path.exists(path):
-        return set()
+        return []
     try:
         with open(path, "r", encoding="utf-8") as f:
             data = json.load(f)
-        return {str(e["fingerprint"]) for e in data.get("findings", [])}
-    except (OSError, ValueError, KeyError, TypeError):
-        return set()
+        entries = data.get("findings", [])
+        return [e for e in entries if isinstance(e, dict)]
+    except (OSError, ValueError, AttributeError, TypeError):
+        return []
+
+
+def stale_baseline_findings(baseline_path: Optional[str],
+                            findings: Iterable[Finding]) -> List[Finding]:
+    """One DRV002 warning per baseline entry whose fingerprint no
+    longer matches ANY current finding — the debt was paid (or the code
+    moved) and the escape now hides a future regression at the same
+    coordinates.  `--refresh-baseline` prunes them."""
+    entries = load_baseline_entries(baseline_path)
+    if not entries:
+        return []
+    current = {f.fingerprint() for f in findings}
+    out: List[Finding] = []
+    for e in sorted(entries, key=lambda e: str(e.get("fingerprint", ""))):
+        fp = str(e.get("fingerprint", ""))
+        if not fp or fp in current:
+            continue
+        out.append(make_finding(
+            "DRV002", f"baseline:{fp}",
+            f"baseline entry {e.get('rule_id', '?')} at "
+            f"{e.get('path') or e.get('node') or '?'} matches no "
+            f"current finding — the finding was fixed or moved; run "
+            f"--refresh-baseline to prune it"))
+    return out
 
 
 def write_baseline(path: str, findings: Iterable[Finding]) -> None:
@@ -240,10 +279,11 @@ def _sha(data: bytes) -> str:
 
 def run_ast_target(root: str, cache: ResultCache,
                    rules_ver: str) -> Tuple[List[Finding], int, int]:
-    """Layer-11 AST lint over the repo, file by file, each file's
-    (post-suppression) result cached on its content hash.  Returns
-    (findings, n_files, n_suppressed)."""
+    """Layer-11 donation lint + layer-12 concurrency sanitizer over the
+    repo, file by file, each file's (post-suppression) result cached on
+    its content hash.  Returns (findings, n_files, n_suppressed)."""
     from .alias_rules import lint_file_donation
+    from .protocol_rules import lint_file_concurrency
 
     findings: List[Finding] = []
     n_files = 0
@@ -276,6 +316,8 @@ def run_ast_target(root: str, cache: ResultCache,
                 source = raw.decode("utf-8", errors="replace")
                 raw_findings = lint_file_donation(full, rel=rel,
                                                   source=source)
+                raw_findings += lint_file_concurrency(full, rel=rel,
+                                                      source=source)
                 kept, n_sup = apply_suppressions(
                     raw_findings, collect_suppressions(source), rel)
                 cache.put(key, {"findings": [finding_to_dict(f)
@@ -352,6 +394,68 @@ def run_presets_target(root: str, cache: ResultCache,
     return findings
 
 
+def run_protocol_target(cache: ResultCache, rules_ver: str,
+                        ) -> Tuple[List[Finding],
+                                   Dict[str, Dict[str, object]]]:
+    """Layer-12a: exhaustively explore the four shipped protocol specs
+    (analyze/modelcheck.py) at their committed scope.  Findings are
+    PROTO001/002 from the explorer plus one PROTO002-severity-free
+    budget check: a spec whose exhaustive state count drifts more than
+    BUDGET_DRIFT_FRAC from its committed budget fails loudly (the spec
+    changed shape without a conscious re-commit).  Cached on the rule
+    version alone — the specs have no other input."""
+    from .modelcheck import (ALL_SPECS, BUDGET_DRIFT_FRAC,
+                             COMMITTED_STATES, audit_spec)
+
+    key = f"protocol-{_sha(rules_ver.encode())}"
+    hit = cache.get(key)
+    if hit is not None:
+        return ([finding_from_dict(d) for d in hit["findings"]],
+                dict(hit["stats"]))
+
+    findings: List[Finding] = []
+    stats: Dict[str, Dict[str, object]] = {}
+    for spec in ALL_SPECS():
+        fs, res = audit_spec(spec)
+        findings.extend(fs)
+        stats[spec.name] = res.to_json()
+        committed = COMMITTED_STATES.get(spec.name)
+        if not res.exhausted:
+            findings.append(make_finding(
+                "PROTO002", f"protocol:{spec.name}",
+                f"exploration hit the state ceiling at {res.states} "
+                f"states without exhausting — the spec no longer "
+                f"terminates at its committed scope"))
+        elif committed is not None and abs(res.states - committed) \
+                > BUDGET_DRIFT_FRAC * committed:
+            findings.append(make_finding(
+                "PROTO003", f"protocol:{spec.name}",
+                f"exhaustive state count {res.states} drifted more "
+                f"than {BUDGET_DRIFT_FRAC:.0%} from the committed "
+                f"budget {committed} — re-commit COMMITTED_STATES "
+                f"consciously if the spec change is intended"))
+    cache.put(key, {"findings": [finding_to_dict(f) for f in findings],
+                    "stats": stats})
+    return findings, stats
+
+
+def discovery_counters() -> Dict[str, object]:
+    """The latest compile's pruned-discovery telemetry out of the PerfDB
+    side-car (runtime/perfdb.py `record_discovery`), for the driver's
+    `--json` report.  {} when the side-car is absent/empty — the
+    counters are observability, never a gate."""
+    try:
+        from easydist_tpu.runtime.perfdb import PerfDB, discovery_db_path
+
+        snap = PerfDB(path=discovery_db_path()).snapshot()
+        traces = snap.get("discovery", {}).get("traces") or []
+        if not traces:
+            return {}
+        return {"traces": len(traces), "latest": dict(traces[-1])}
+    except Exception:
+        return {}
+
+
 # ------------------------------------------------------------------ driver
 
 
@@ -367,6 +471,10 @@ class DriverResult:
     cache_hits: int = 0
     cache_misses: int = 0
     wall_s: float = 0.0
+    # per-spec exploration stats from the `protocol` target ({} unless
+    # it ran) and the pruned-discovery side-car counters
+    protocol: Dict[str, Dict[str, object]] = field(default_factory=dict)
+    discovery: Dict[str, object] = field(default_factory=dict)
 
     def to_json(self) -> Dict[str, object]:
         return {
@@ -380,6 +488,8 @@ class DriverResult:
             "n_files": self.n_files,
             "cache": {"hits": self.cache_hits,
                       "misses": self.cache_misses},
+            "protocol": self.protocol,
+            "discovery": self.discovery,
             "findings": [finding_to_dict(f)
                          for f in self.report.findings],
             "wall_s": round(self.wall_s, 3),
@@ -406,6 +516,7 @@ def run_driver(root: str, targets: Iterable[str] = ("ast", "presets"),
     report = AnalysisReport()
     n_files = 0
     n_suppressed = 0
+    protocol_stats: Dict[str, Dict[str, object]] = {}
     for target in targets:
         if target == "ast":
             fs, n_files, n_sup = run_ast_target(root, cache, rules_ver)
@@ -413,9 +524,17 @@ def run_driver(root: str, targets: Iterable[str] = ("ast", "presets"),
             n_suppressed += n_sup
         elif target == "presets":
             report.extend(run_presets_target(root, cache, rules_ver))
+        elif target == "protocol":
+            fs, protocol_stats = run_protocol_target(cache, rules_ver)
+            report.extend(fs)
         else:
             raise ValueError(f"unknown analyze target {target!r} "
-                             f"(expected 'ast' or 'presets')")
+                             f"(expected 'ast', 'presets' or "
+                             f"'protocol')")
+    # stale-baseline audit BEFORE gating: DRV002 entries are warnings,
+    # so they report without flipping the exit code
+    report.extend(stale_baseline_findings(baseline_path,
+                                          report.findings))
     baseline = load_baseline(baseline_path)
     errors = report.errors()
     new_errors = [f for f in errors if f.fingerprint() not in baseline]
@@ -425,7 +544,9 @@ def run_driver(root: str, targets: Iterable[str] = ("ast", "presets"),
                         targets=targets, n_files=n_files,
                         cache_hits=cache.hits,
                         cache_misses=cache.misses,
-                        wall_s=time.perf_counter() - t0)
+                        wall_s=time.perf_counter() - t0,
+                        protocol=protocol_stats,
+                        discovery=discovery_counters())
 
 
 # ------------------------------------------------------------------- SARIF

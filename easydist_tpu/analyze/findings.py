@@ -230,11 +230,45 @@ RULES: Dict[str, tuple] = {
                  "reference across a step boundary (snapshot, hot-page "
                  "export, trie-held staging row): the next donating "
                  "dispatch invalidates storage the host still reads"),
+    # ---- layer 12: fleet protocol model checker + concurrency
+    #      sanitizer (analyze/modelcheck.py + analyze/protocol_rules.py)
+    "PROTO001": (SEV_ERROR,
+                 "protocol safety violation: exhaustive small-scope "
+                 "exploration reached a state that drops an admitted "
+                 "request, commits the same token position twice, or "
+                 "accepts a corrupt chunk — the shortest counterexample "
+                 "interleaving is attached"),
+    "PROTO002": (SEV_ERROR,
+                 "protocol stuck state: a reachable state has no path to "
+                 "the goal (no enabled action, or a livelock cycle) — an "
+                 "admitted request would wait forever instead of "
+                 "completing, failing, or quarantining loudly"),
+    "PROTO003": (SEV_ERROR,
+                 "spec drift: a transition observed in a real drill "
+                 "event log is not admitted by the protocol spec — "
+                 "either the implementation grew a behavior the model "
+                 "checker never explores, or the spec rotted into "
+                 "parallel documentation"),
+    "PROTO004": (SEV_ERROR,
+                 "private fleet state read from outside the owning "
+                 "class: observer/metrics code reaches into a router/"
+                 "replica/monitor's underscore attributes instead of a "
+                 "snapshot API — a data race the moment replicas live "
+                 "in another process"),
+    "PROTO005": (SEV_ERROR,
+                 "shared fleet structure mutated outside the owning "
+                 "class's methods: external writes to rings, in-flight "
+                 "tables, or commit maps bypass the single-writer "
+                 "protocol the model checker verifies"),
     # ---- analyzer driver (analyze/driver.py)
     "DRV001": (SEV_WARNING,
                "unused inline suppression: an `# easydist: disable=...` "
                "comment names a rule that produced no finding on that "
                "line — stale suppressions hide future regressions"),
+    "DRV002": (SEV_WARNING,
+               "stale baseline entry: analyze_baseline.json carries a "
+               "fingerprint matching no current finding — the debt was "
+               "paid (or the code moved); `--refresh-baseline` prunes it"),
 }
 
 # layer index: (layer label, ordering key, rule-id prefixes, escape hatch).
@@ -257,6 +291,7 @@ LAYERS: List[tuple] = [
     ("9 simulator", ("SIM",)),
     ("10 discovery", ("DISC",)),
     ("11 aliasing", ("ALIAS",)),
+    ("12 protocol", ("PROTO",)),
     ("driver", ("DRV",)),
 ]
 

@@ -169,3 +169,12 @@ class HealthMonitor:
     def snapshot(self) -> Dict[str, Dict[str, object]]:
         return {rid: {"state": h.state, "misses": h.misses}
                 for rid, h in self._replicas.items()}
+
+    def transitions(self) -> List[Dict[str, str]]:
+        """The state-transition event stream, oldest first — the
+        layer-12 conformance surface `analyze.modelcheck.
+        replay_health_events` validates against the HealthSpec's
+        admitted relation (PROTO003).  Every replica starts ALIVE
+        (track()), so the events alone determine each step's
+        (from, to) edge."""
+        return list(self.events)

@@ -218,6 +218,15 @@ class FleetRouter:
         self.drain_log: List[Dict[str, object]] = []
         self.crash_log: List[Dict[str, object]] = []
         self._log_cap = 1024
+        # layer-12 conformance surface: the request-lifecycle event
+        # stream `analyze.modelcheck.replay_router_protocol` replays
+        # against the RouterSpec (PROTO003).  Bounded like the other
+        # logs; `protocol_events_dropped` counts truncation so a capped
+        # log is never mistaken for a complete (and seemingly drifting)
+        # protocol history
+        self.protocol_log: List[Dict[str, object]] = []
+        self.protocol_events_dropped = 0
+        self._proto_cap = 4096
 
     # ------------------------------------------------------------ replicas
     def add_replica(self, session, role: str = "decode") -> Replica:
@@ -334,6 +343,22 @@ class FleetRouter:
         log.append(entry)
         del log[:-self._log_cap]
 
+    def _proto(self, request_id: int, event: str) -> None:
+        """One request-lifecycle protocol event (layer-12 conformance)."""
+        self.protocol_log.append(
+            {"request_id": request_id, "event": event})
+        if len(self.protocol_log) > self._proto_cap:
+            dropped = len(self.protocol_log) - self._proto_cap
+            del self.protocol_log[:dropped]
+            self.protocol_events_dropped += dropped
+
+    def transitions(self) -> List[Dict[str, object]]:
+        """The protocol event stream, oldest first — the surface
+        `replay_router_protocol` (PROTO003) validates against the
+        RouterSpec.  Check `protocol_events_dropped` before treating it
+        as a complete history."""
+        return list(self.protocol_log)
+
     # ------------------------------------------------------------ admission
     def submit(self, prompt_ids: Sequence[int], max_new_tokens: int = 16,
                eos_id: Optional[int] = None,
@@ -360,11 +385,18 @@ class FleetRouter:
                         future=Future(), deadline_t=deadline_t,
                         t_submit=time.perf_counter())
         chosen = self._route(prompt, rid)
+        # "admitted" lands only once a route exists: a submit() that
+        # raises CircuitOpenError never entered the protocol, so the
+        # zero-drop replay must not expect a terminal for it
+        self._proto(rid, "admitted")
         self._inflight[rid] = rec
         if not self._start_disaggregated(rec, chosen):
             rec.replica_id = chosen.replica_id
+            self._proto(rid, "routed")
             rec.inner = chosen.session.submit(
                 prompt, max_new_tokens=max_new_tokens, eos_id=eos_id)
+        else:
+            self._proto(rid, "handoff_started")
         self.metrics.inc("requests_submitted")
         self.metrics.set_gauge("queue_depth", self.total_queue_depth)
         self.metrics.set_gauge("router_inflight", len(self._inflight))
@@ -409,6 +441,35 @@ class FleetRouter:
     def total_queue_depth(self) -> int:
         return sum(r.session.queue_depth
                    for r in self._replicas.values()) + len(self._handoffs)
+
+    @property
+    def inflight_count(self) -> int:
+        """Router-tracked in-flight requests — the observer-safe read
+        the autoscaler's MetricsView uses (PROTO004: observers never
+        reach `_inflight` directly)."""
+        return len(self._inflight)
+
+    def live_decode_snapshot(self, eligible_only: bool = False
+                             ) -> List[Dict[str, object]]:
+        """Read-only per-replica view of the non-draining decode tier
+        for observer code (autoscaler metrics/drain planning).  This is
+        the snapshot-only-metrics contract layer 12 enforces: observers
+        consume value snapshots like this one, never the router's live
+        `_replicas`/`_inflight` structures — those become remote state
+        the moment replicas live in another process."""
+        out: List[Dict[str, object]] = []
+        for r in self._decode_replicas():
+            if r.session.is_draining:
+                continue
+            if eligible_only and not self._eligible(r):
+                continue
+            out.append({
+                "replica_id": r.replica_id,
+                "queue_depth": int(r.session.queue_depth),
+                "hot_pools": len(getattr(r.session, "_pools", None)
+                                 or ()),
+            })
+        return out
 
     # -------------------------------------------------------------- driving
     def step(self) -> int:
@@ -503,6 +564,7 @@ class FleetRouter:
         rec.resume.crashed_on.add(crashed_rid)
         if len(rec.resume.crashed_on) >= self.config.quarantine_after:
             del self._inflight[rec.request_id]
+            self._proto(rec.request_id, "quarantined")
             rec.future.set_exception(PoisonRequestError(
                 rec.request_id, rec.resume.crashed_on))
             self.metrics.inc("requests_quarantined")
@@ -511,6 +573,7 @@ class FleetRouter:
                          "replicas %s", rec.request_id,
                          sorted(rec.resume.crashed_on))
             return
+        self._proto(rec.request_id, "recovered")
         self._resubmit(rec)
         self.metrics.inc("requests_recovered")
 
@@ -526,9 +589,11 @@ class FleetRouter:
             nxt = self._route(resume_prompt, rec.request_id)
         except CircuitOpenError as e:
             self._inflight.pop(rec.request_id, None)
+            self._proto(rec.request_id, "failed")
             rec.future.set_exception(e)
             self.metrics.inc("requests_failed")
             return
+        self._proto(rec.request_id, "routed")
         rec.replica_id = nxt.replica_id
         rec.hop_base = list(desc.ids)
         rec.inner = nxt.session.submit(
@@ -557,10 +622,12 @@ class FleetRouter:
                 # only an external cancel/resolution leaves a done future
                 # tracked; the router deletes before resolving otherwise
                 del self._inflight[rid]
+                self._proto(rid, "failed")
                 self.metrics.inc("inflight_gc")
                 continue
             if rec.deadline_t is not None and now > rec.deadline_t:
                 del self._inflight[rid]
+                self._proto(rid, "failed")
                 rec.future.set_exception(DeadlineExceededError(
                     f"request {rid} exceeded its deadline in flight"))
                 self.metrics.inc("requests_timed_out")
@@ -571,6 +638,7 @@ class FleetRouter:
                     and not any(h.request_id == rid
                                 for h in self._handoffs):
                 self.metrics.inc("inflight_orphans_recovered")
+                self._proto(rid, "recovered")
                 self._resubmit(rec)
 
     def _poll_handoffs(self) -> None:
@@ -585,6 +653,7 @@ class FleetRouter:
             prompt = rec.resume.prompt
             dst = self._replicas.get(h.decode_replica)
             src = self._replicas.get(h.prefill_replica)
+            handed_off = False
             if result["finish_reason"] != "length":
                 # prefill replica was evacuated under us: nothing
                 # committed for sure — decode replica prefills from zero
@@ -604,6 +673,7 @@ class FleetRouter:
                         retries=cfg.handoff_retries,
                         backoff_s=cfg.handoff_backoff_ms / 1e3)
                     self.metrics.inc("pages_handed_off", moved)
+                    handed_off = True
                 except TransportError as e:
                     # permanent transport failure is never fatal to the
                     # REQUEST: the decode replica prefills from zero and
@@ -613,6 +683,9 @@ class FleetRouter:
                         "falling back to direct prefill",
                         h.prefill_replica, h.decode_replica, e)
                     self.metrics.inc("handoff_transport_failures")
+            self._proto(rec.request_id,
+                        "handoff_committed" if handed_off
+                        else "handoff_fallback")
             if dst is None or not self._eligible(dst):
                 # decode target crashed or started draining while
                 # prefill ran: re-route; restore == recompute keeps
@@ -621,9 +694,11 @@ class FleetRouter:
                     dst = self._route(prompt, rec.request_id)
                 except CircuitOpenError as e:
                     del self._inflight[rec.request_id]
+                    self._proto(rec.request_id, "failed")
                     rec.future.set_exception(e)
                     self.metrics.inc("requests_failed")
                     continue
+                self._proto(rec.request_id, "routed")
             rec.replica_id = dst.replica_id
             rec.inner = dst.session.submit(
                 prompt, max_new_tokens=rec.resume.max_new,
@@ -639,10 +714,12 @@ class FleetRouter:
                 # function of the prefix, so prompt+partial resumed on
                 # any replica concatenates bitwise-identically
                 rec.resume.ids = rec.hop_base + list(result["ids"])
+                self._proto(rid, "migrated")
                 self._resubmit(rec)
                 self.metrics.inc("migrations")
                 continue
             del self._inflight[rid]
+            self._proto(rid, "completed")
             rec.future.set_result({
                 "ids": rec.hop_base + list(result["ids"]),
                 "finish_reason": result["finish_reason"],
@@ -763,6 +840,8 @@ class FleetRouter:
             "crashes": list(self.crash_log),
             "health": self.health.snapshot(),
             "metrics": self.metrics.snapshot(),
+            "protocol_events": len(self.protocol_log),
+            "protocol_events_dropped": self.protocol_events_dropped,
         }
 
     def export_metrics(self, db=None, persist: bool = True):
@@ -777,6 +856,8 @@ class FleetRouter:
             "drains": list(self.drain_log),
             "crashes": list(self.crash_log),
             "health_events": list(self.health.events)[-64:],
+            "protocol_events": list(self.protocol_log)[-256:],
+            "protocol_events_dropped": self.protocol_events_dropped,
         })
         if persist:
             try:

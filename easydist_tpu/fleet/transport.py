@@ -287,6 +287,22 @@ class InProcessTransport(KVTransport):
         self.pages_moved = 0
         self.commits_deduped = 0
         self._committed: Dict[tuple, int] = {}
+        # layer-12 conformance surface: committed/deduped/rejected per
+        # manifest key, replayed through the TransportSpec's idempotence
+        # relation by `replay_transport_commits` (PROTO003)
+        self.events: List[Dict[str, object]] = []
+        self._event_cap = 512
+
+    def _event(self, event: str, key: tuple, src: str, dst: str) -> None:
+        self.events.append({"event": event, "key": key[1:],
+                            "src": src, "dst": dst})
+        del self.events[:-self._event_cap]
+
+    def transitions(self) -> List[Dict[str, object]]:
+        """The commit event stream, oldest first — the surface
+        `replay_transport_commits` (PROTO003) validates against the
+        TransportSpec."""
+        return list(self.events)
 
     def transfer(self, path: Sequence[Page], dst_session, prompt,
                  src: str = "?", dst: str = "?",
@@ -295,10 +311,26 @@ class InProcessTransport(KVTransport):
         `dst_session`'s trie for `prompt`'s decode bucket (or as hot
         pages under `bucket` when prompt is None); returns chunks present
         after import.  Verification failure raises `PageCorruptError`
-        BEFORE anything commits."""
+        BEFORE anything commits.
+
+        The idempotence lookup runs FIRST: a duplicate delivery of
+        already-committed content (the manifest key is computed over the
+        pristine source pages) is a pure no-op — it must not re-append
+        to the audit trail, burn a fault-plan occurrence, or re-run
+        verification (a duplicate damaged in flight after a successful
+        commit would otherwise turn a no-op into a spurious
+        PageCorruptError).  This is the commit-boundary idempotence the
+        TransportSpec model-checks."""
         if not path:
             return 0
         manifest = page_manifest(path, src=src, dst=dst)
+        target = (tuple(int(t) for t in prompt) if prompt is not None
+                  else ("bucket", bucket))
+        key = (id(dst_session), target, manifest_key(manifest))
+        if key in self._committed:
+            self.commits_deduped += 1
+            self._event("deduped", key, src, dst)
+            return self._committed[key]
         self.manifests = (self.manifests + [manifest])[-self.keep:]
         if faultinject.fire("fleet.transport.page_corrupt"):
             # damage on the wire: manifest was built over pristine pages,
@@ -308,21 +340,17 @@ class InProcessTransport(KVTransport):
             try:
                 self._check(manifest, path)
             except Exception as e:
+                self._event("rejected", key, src, dst)
                 raise PageCorruptError(
                     f"KV page handoff corrupt; aborted before commit "
                     f"({src}->{dst}): {e}") from e
-        target = (tuple(int(t) for t in prompt) if prompt is not None
-                  else ("bucket", bucket))
-        key = (id(dst_session), target, manifest_key(manifest))
-        if key in self._committed:
-            self.commits_deduped += 1
-            return self._committed[key]
         if prompt is not None:
             n = dst_session.import_prefix_path(prompt, path)
         else:
             n = dst_session.import_hot_pages({bucket: [path]})
         self.pages_moved += len(path)
         self._committed[key] = n
+        self._event("committed", key, src, dst)
         while len(self._committed) > self.keep_commits:
             self._committed.pop(next(iter(self._committed)))
         return n

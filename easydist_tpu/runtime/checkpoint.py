@@ -430,6 +430,10 @@ def _restore(ckpt_dir: str, like: Any,
     # budget — recovery is re-planning with a halved chunk, same dsts.
     chunk_bytes = edconfig.reshard_chunk_bytes
     findings = 0
+    # layer-12 conformance trail: one entry per plan attempt, replayed
+    # through the halve-and-replan relation by
+    # `analyze.modelcheck.replay_restore_attempts` (PROTO003)
+    attempts: List[Dict[str, Any]] = []
     while True:
         rplan = reshard_restore.plan_restore(like, meta,
                                              chunk_bytes=chunk_bytes)
@@ -444,11 +448,15 @@ def _restore(ckpt_dir: str, like: Any,
                     node=f"restore[{os.path.basename(ckpt_dir)}]"
                          f".leaf[{i}]"))
         if faultinject.fire("elastic.restore.oom"):
+            attempts.append({"chunk_bytes": int(chunk_bytes),
+                             "outcome": "oom"})
             chunk_bytes = max(1, chunk_bytes // 2)
             logger.warning(
                 "checkpoint: chunked restore exceeded its memory budget "
                 "(injected); re-planning with chunk_bytes=%d", chunk_bytes)
             continue
+        attempts.append({"chunk_bytes": int(chunk_bytes),
+                         "outcome": "landed"})
         break
 
     if rplan.topology_shift:
@@ -497,6 +505,7 @@ def _restore(ckpt_dir: str, like: Any,
     _last_restore_report = {
         "ckpt_dir": ckpt_dir, **rplan.summary(),
         "chunk_bytes": int(chunk_bytes), "reshard_findings": int(findings),
+        "attempts": list(attempts),
     }
 
     def do_restore():

@@ -121,14 +121,16 @@ class Autoscaler:
         self.router.export_metrics(db=db, persist=False)
         snap = db.snapshot().get("serving", {})
 
-        live = [r for r in self.router._decode_replicas()
-                if not r.session.is_draining]
+        # snapshot-only-metrics contract (PROTO004): the autoscaler is
+        # an observer — it reads the router's published snapshot, never
+        # its private structures
+        live = self.router.live_decode_snapshot()
         occs: List[float] = []
         ttfts: List[float] = []
         toks: List[float] = []
         marker: List[int] = []
         for rep in live:
-            hist = snap.get(f"engine[{rep.replica_id}]") or []
+            hist = snap.get(f"engine[{rep['replica_id']}]") or []
             if not hist:
                 continue
             last = hist[-1]
@@ -150,7 +152,7 @@ class Autoscaler:
             queue_depth=int(fleet_gauges.get(
                 "queue_depth", self.router.total_queue_depth)),
             inflight=int(fleet_gauges.get(
-                "router_inflight", len(self.router._inflight))),
+                "router_inflight", self.router.inflight_count)),
             marker=tuple(sorted(marker)))
         self._last_view = view
         return view
@@ -298,26 +300,23 @@ class Autoscaler:
         is zero-drop by construction: the router keeps stepping the
         leaving replica until its in-flight work retires, then migrates
         its hot pages to survivors."""
-        live = [r for r in self.router._decode_replicas()
-                if not r.session.is_draining
-                and self.router._eligible(r)]
+        live = self.router.live_decode_snapshot(eligible_only=True)
         keep = self.config.min_replicas
         n = min(n, max(0, len(live) - keep))
-        live.sort(key=lambda r: (r.session.queue_depth,
-                                 len(getattr(r.session, "_pools", {})),
-                                 r.replica_id))
+        live.sort(key=lambda r: (r["queue_depth"], r["hot_pools"],
+                                 r["replica_id"]))
         drained: List[str] = []
         for rep in live[:n]:
+            rid = rep["replica_id"]
             try:
-                self.router.drain(rep.replica_id,
-                                  mode=self.config.drain_mode)
+                self.router.drain(rid, mode=self.config.drain_mode)
             except Exception as e:
                 # the target went ineligible/away mid-decision: skip it,
                 # the next tick re-plans against the new fleet
                 logger.warning("[autoscale] drain of %s failed (%s); "
-                               "re-planning next tick", rep.replica_id, e)
+                               "re-planning next tick", rid, e)
                 continue
-            drained.append(rep.replica_id)
+            drained.append(rid)
         return drained
 
     # ------------------------------------------------------------ summary

@@ -59,10 +59,10 @@ fi
 # repeat runs.  Fails on any NON-BASELINED error; refresh the baseline
 # with `python -m easydist_tpu.analyze --refresh-baseline` (see README).
 if python -c "import jax" >/dev/null 2>&1; then
-    echo "== python -m easydist_tpu.analyze (driver gate: ast + presets)"
+    echo "== python -m easydist_tpu.analyze (driver gate: ast + presets + protocol)"
     mkdir -p "${EASYDIST_ARTIFACT_DIR:-/tmp/easydist_artifacts}"
     sarif="${EASYDIST_ARTIFACT_DIR:-/tmp/easydist_artifacts}/analyze.sarif"
-    python -m easydist_tpu.analyze --targets ast,presets \
+    python -m easydist_tpu.analyze --targets ast,presets,protocol \
         --sarif "$sarif" || {
         echo "static_checks: analyzer driver reported new (non-baselined)" \
              "error finding(s)"
@@ -71,6 +71,34 @@ if python -c "import jax" >/dev/null 2>&1; then
     [ -s "$sarif" ] && echo "static_checks: SARIF artifact at $sarif"
 else
     echo "static_checks: jax not importable; skipping the analyzer driver"
+fi
+
+# protocol model-check gate (docs/ANALYZE.md layer 12): exhaustively
+# explore the four fleet protocol specs (health, router, resume,
+# transport — analyze/modelcheck.py) over EVERY interleaving at their
+# committed scope.  Needs no jax, so it runs even in bare containers.
+# The exploration is bounded twice over: a hard wall-clock timeout here,
+# and the committed per-spec state budgets inside — exhausting more (or
+# fewer) states than COMMITTED_STATES by >20% is a PROTO003 error (the
+# spec changed shape without a conscious budget re-commit), and any
+# PROTO001 safety violation / PROTO002 stuck state fails the gate with
+# its shortest counterexample trace in the output.
+echo "== python -m easydist_tpu.analyze --targets protocol (model-check gate)"
+proto_json="${EASYDIST_ARTIFACT_DIR:-/tmp/easydist_artifacts}/protocol.json"
+mkdir -p "$(dirname "$proto_json")"
+if timeout 120 python -m easydist_tpu.analyze --targets protocol \
+        --no-cache --json "$proto_json"; then
+    python - "$proto_json" <<'PYEOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+for name, st in sorted(d.get("protocol", {}).items()):
+    print(f"static_checks: protocol[{name}] {st['states']} states "
+          f"(committed {st['committed']}, exhausted={st['exhausted']})")
+PYEOF
+else
+    echo "static_checks: protocol model-check gate FAILED (safety" \
+         "violation, stuck state, budget drift >20%, or timeout)"
+    rc=1
 fi
 
 # overlapped-collectives gate: the backward-ordered barrier-pinned flush
@@ -315,6 +343,10 @@ try:
     elif r.get("routing_findings", 1) != 0:
         print(f"routing audit raised {r.get('routing_findings')} "
               f"FLEET001/004 finding(s)")
+    elif r.get("proto_findings", 1) != 0:
+        print(f"protocol conformance replay raised "
+              f"{r.get('proto_findings')} PROTO003 finding(s) — the "
+              f"drill's transitions() streams drifted from the specs")
     elif not r.get("ttft_p99_inflation", 1e18) <= r.get("ttft_p99_bound", 0):
         print(f"ttft p99 inflated {r.get('ttft_p99_inflation')}x under "
               f"chaos (bound {r.get('ttft_p99_bound')}x)")
@@ -372,6 +404,9 @@ try:
         print("a restore plan's peak live bytes exceeded the chunked bound")
     elif r.get("reshard_findings", 1) != 0:
         print(f"{r.get('reshard_findings')} RESHARD001/002 finding(s)")
+    elif r.get("proto_findings", 1) != 0:
+        print(f"restore-attempt conformance replay raised "
+              f"{r.get('proto_findings')} PROTO003 finding(s)")
     elif not r.get("steps_replayed_after_fallback"):
         print("corrupt-checkpoint fallback replayed no step "
               "(drill tested nothing)")

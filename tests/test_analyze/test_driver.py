@@ -1,7 +1,11 @@
 """Analyzer driver: suppressions (+ DRV001 on stale ones), the
-fingerprint baseline gate, SARIF export, the incremental cache (warm
-rerun replays an identical report), the perfdb truncation counter, and
-the `python -m easydist_tpu.analyze` CLI's exit-code contract."""
+fingerprint baseline gate (+ DRV002 on stale baseline entries and the
+`--refresh-baseline` prune path), SARIF export (incl. warning-level
+mapping), the incremental cache (warm rerun replays an identical
+report), the `protocol` target's exploration stats + discovery side-car
+counters in `--json`, the perfdb truncation counter, and the
+`python -m easydist_tpu.analyze` CLI's exit-code contract (warnings
+never gate)."""
 
 import json
 import os
@@ -14,8 +18,11 @@ from easydist_tpu import config as edconfig
 from easydist_tpu.analyze.driver import (ResultCache, apply_suppressions,
                                          collect_suppressions,
                                          export_sarif, finding_to_dict,
-                                         load_baseline, rule_version,
-                                         run_driver, write_baseline)
+                                         load_baseline,
+                                         load_baseline_entries,
+                                         rule_version, run_driver,
+                                         stale_baseline_findings,
+                                         write_baseline)
 from easydist_tpu.analyze.findings import (AnalysisReport, Finding,
                                            make_finding)
 
@@ -108,6 +115,77 @@ class TestBaseline:
         assert data["findings"] == []  # no legacy debt: keep it that way
 
 
+# ------------------------------------------------ stale baseline (DRV002)
+
+
+class TestStaleBaseline:
+    def test_stale_entry_fires_one_drv002_warning(self, tmp_path):
+        baseline = str(tmp_path / "baseline.json")
+        ghost = make_finding("ALIAS001", "gone", "m", path="gone.py",
+                             line=1)
+        write_baseline(baseline, [ghost])
+        findings = stale_baseline_findings(baseline, [])
+        assert [f.rule_id for f in findings] == ["DRV002"]
+        assert findings[0].severity == "warning"
+        assert findings[0].node == f"baseline:{ghost.fingerprint()}"
+        assert "--refresh-baseline" in findings[0].message
+
+    def test_matching_entry_is_silent(self, tmp_path):
+        baseline = str(tmp_path / "baseline.json")
+        f = make_finding("ALIAS001", "n", "m", path="p.py", line=3)
+        write_baseline(baseline, [f])
+        assert stale_baseline_findings(baseline, [f]) == []
+
+    def test_absent_or_corrupt_baseline_is_silent(self, tmp_path):
+        assert stale_baseline_findings(None, []) == []
+        assert stale_baseline_findings(str(tmp_path / "nope.json"),
+                                       []) == []
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        assert load_baseline_entries(str(bad)) == []
+        assert stale_baseline_findings(str(bad), []) == []
+
+    def test_driver_reports_drv002_without_gating(self, tmp_path):
+        # a clean tree + a baseline naming a fixed finding: the run
+        # must WARN (the escape now hides a future regression) but
+        # still exit-eligible (new_errors empty)
+        root = _mini_repo(tmp_path, "x = 1\n")
+        baseline = str(tmp_path / "baseline.json")
+        write_baseline(baseline, [make_finding(
+            "ALIAS001", "gone", "m", path="gone.py", line=1)])
+        res = _run(root, tmp_path, baseline_path=baseline)
+        assert [f.rule_id for f in res.report.findings] == ["DRV002"]
+        assert res.new_errors == []
+
+    def test_refresh_baseline_prunes_stale_entries(self, tmp_path):
+        root = _mini_repo(tmp_path)
+        baseline = str(tmp_path / "baseline.json")
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+
+        def cli(*args):
+            return subprocess.run(
+                [sys.executable, "-m", "easydist_tpu.analyze",
+                 "--targets", "ast", "--root", root, "--baseline",
+                 baseline, "--cache-dir", str(tmp_path / "cache"),
+                 *args],
+                capture_output=True, text=True, env=env, cwd=REPO)
+
+        proc = cli("--refresh-baseline")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert len(load_baseline_entries(baseline)) == 1
+        # pay the debt: the lint violation disappears from the tree
+        (tmp_path / "easydist_tpu" / "mod.py").write_text("x = 1\n")
+        proc = cli()
+        assert proc.returncode == 0, proc.stdout + proc.stderr  # warns only
+        assert "DRV002" in proc.stdout
+        proc = cli("--refresh-baseline")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert load_baseline_entries(baseline) == []  # pruned
+        proc = cli()
+        assert proc.returncode == 0
+        assert "DRV002" not in proc.stdout
+
+
 # ------------------------------------------------------- incremental cache
 
 
@@ -182,6 +260,85 @@ class TestSarif:
     def test_info_maps_to_note(self):
         doc = export_sarif([make_finding("MEM000", "n", "m")])
         assert doc["runs"][0]["results"][0]["level"] == "note"
+
+    def test_warning_findings_map_to_warning_level(self):
+        doc = export_sarif([make_finding("DRV002", "baseline:x", "m"),
+                            make_finding("PROTO001", "protocol:h", "m")])
+        run = doc["runs"][0]
+        levels = {r["ruleId"]: r["level"] for r in run["results"]}
+        assert levels == {"DRV002": "warning", "PROTO001": "error"}
+        rules = {r["id"]: r for r in run["tool"]["driver"]["rules"]}
+        assert rules["DRV002"]["defaultConfiguration"][
+            "level"] == "warning"
+
+
+# ------------------------------------------------------ protocol target
+
+
+class TestProtocolTarget:
+    def test_run_driver_populates_protocol_stats(self, tmp_path):
+        from easydist_tpu.analyze.modelcheck import COMMITTED_STATES
+
+        root = _mini_repo(tmp_path, "x = 1\n")
+        res = _run(root, tmp_path, targets=("protocol",))
+        assert res.report.findings == []  # shipped protocols are clean
+        assert set(res.protocol) == set(COMMITTED_STATES)
+        for name, st in res.protocol.items():
+            assert st["exhausted"] is True
+            assert st["states"] == COMMITTED_STATES[name]
+            assert st["safety_violation"] is None
+            assert st["stuck_state"] is None
+
+    def test_protocol_and_discovery_in_json_report(self, tmp_path):
+        root = _mini_repo(tmp_path, "x = 1\n")
+        res = _run(root, tmp_path, targets=("protocol",))
+        data = json.loads(json.dumps(res.to_json()))  # must serialize
+        assert set(data["protocol"]) == {"health", "router", "resume",
+                                         "transport"}
+        # discovery side-car counters ride along ({} when the side-car
+        # is absent; {"traces", "latest"} when a compile has run)
+        assert isinstance(data["discovery"], dict)
+        if data["discovery"]:
+            assert {"traces", "latest"} <= set(data["discovery"])
+
+    def test_protocol_result_is_cached_on_rule_version(self, tmp_path):
+        from easydist_tpu.analyze.driver import run_protocol_target
+
+        cache = ResultCache(cache_dir=str(tmp_path / "cache"))
+        ver = rule_version()
+        cold_f, cold_s = run_protocol_target(cache, ver)
+        warm_f, warm_s = run_protocol_target(cache, ver)
+        assert warm_s == cold_s and warm_f == cold_f == []
+
+
+# --------------------------------------------- warnings never gate (CLI)
+
+
+class TestWarningsDoNotGate:
+    def test_warnings_only_run_exits_zero(self, tmp_path):
+        # an unused suppression is the cheapest pure-warning source:
+        # the file is clean, so the escape hatch itself fires DRV001
+        root = _mini_repo(tmp_path,
+                          "x = 1  # easydist: disable=ALIAS001\n")
+        sarif = str(tmp_path / "report.sarif")
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+        proc = subprocess.run(
+            [sys.executable, "-m", "easydist_tpu.analyze",
+             "--targets", "ast", "--root", root,
+             "--cache-dir", str(tmp_path / "cache"), "--sarif", sarif],
+            capture_output=True, text=True, env=env, cwd=REPO)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "DRV001" in proc.stdout
+        assert "1 warning(s)" in proc.stdout
+        results = json.load(open(sarif))["runs"][0]["results"]
+        assert [r["level"] for r in results] == ["warning"]
+
+    def test_run_driver_warnings_produce_no_new_errors(self, tmp_path):
+        root = _mini_repo(tmp_path,
+                          "x = 1  # easydist: disable=ALIAS001\n")
+        res = _run(root, tmp_path)
+        assert [f.rule_id for f in res.report.findings] == ["DRV001"]
+        assert res.new_errors == []
 
 
 # -------------------------------------------------- perfdb truncation

@@ -1,0 +1,325 @@
+"""Layer-12 protocol model checker: exhaustive exploration of the four
+shipped specs at committed scope (clean + exact committed state counts),
+seeded protocol bugs each firing exactly once with a shortest
+counterexample, the conformance replay validators over clean and
+hand-mutated drill logs, and the kill-switch short-circuit."""
+
+import pytest
+
+from easydist_tpu.analyze.modelcheck import (ALL_SPECS, BUDGET_DRIFT_FRAC,
+                                             COMMITTED_STATES, HealthSpec,
+                                             ResumeSpec, RouterSpec,
+                                             TransportSpec, audit_spec,
+                                             explore,
+                                             replay_health_events,
+                                             replay_restore_attempts,
+                                             replay_router_protocol,
+                                             replay_transport_commits)
+
+
+class TestCleanSpecsExhaustive:
+    """The shipped protocols are proven safe and live over EVERY
+    interleaving at committed scope — and the explored-state counts are
+    committed exactly, so a spec edit that changes the reachable space
+    must re-commit its budget consciously."""
+
+    def test_every_spec_clean_and_at_committed_budget(self):
+        for spec in ALL_SPECS():
+            findings, res = audit_spec(spec)
+            assert findings == [], (spec.name, [str(f) for f in findings])
+            assert res.exhausted, spec.name
+            assert res.states == COMMITTED_STATES[spec.name], (
+                f"{spec.name}: explored {res.states}, committed "
+                f"{COMMITTED_STATES[spec.name]} — re-commit consciously")
+            assert res.goal_states > 0, spec.name
+
+    def test_exploration_is_deterministic(self):
+        for spec_cls in (HealthSpec, RouterSpec, ResumeSpec,
+                         TransportSpec):
+            a = explore(spec_cls())
+            b = explore(spec_cls())
+            assert (a.states, a.transitions, a.goal_states) == \
+                   (b.states, b.transitions, b.goal_states)
+
+    def test_committed_budgets_have_headroom_under_drift_frac(self):
+        # the CI drift gate compares against these exact numbers; the
+        # fraction must be a real tolerance, not a no-op
+        assert 0 < BUDGET_DRIFT_FRAC < 1
+        assert set(COMMITTED_STATES) == {s.name for s in ALL_SPECS()}
+
+    def test_state_ceiling_reports_not_exhausted(self):
+        res = explore(RouterSpec(), max_states=10)
+        assert not res.exhausted
+        assert res.states == 10
+        # stuck detection needs the full relation: never reported on a
+        # truncated exploration
+        assert res.stuck is None
+
+    def test_result_to_json_shape(self):
+        res = explore(HealthSpec())
+        d = res.to_json()
+        assert d["spec"] == "health"
+        assert d["states"] == COMMITTED_STATES["health"]
+        assert d["committed"] == COMMITTED_STATES["health"]
+        assert d["exhausted"] is True
+        assert d["safety_violation"] is None
+        assert d["stuck_state"] is None
+
+
+class TestSeededProtocolBugs:
+    """Each seeded bug is a one-line protocol mutation; the explorer
+    must find it (exactly one finding, shortest counterexample)."""
+
+    def test_flap_storm_fires_proto001_false_dead(self):
+        # flap budget lifted to the miss budget: two consecutive lying
+        # probes mark a HEALTHY replica DEAD
+        findings, res = audit_spec(HealthSpec(bug="flap_storm"))
+        assert [f.rule_id for f in findings] == ["PROTO001"]
+        assert "declared DEAD while healthy" in findings[0].message
+        trace, _msgs = res.safety
+        # shortest counterexample: miss_budget lying probes on one
+        # replica, nothing else
+        assert len(trace) == 2
+        assert all(a.startswith("probe_flap") for a in trace)
+
+    def test_dropped_handoff_fires_proto002_stuck(self):
+        # a prefill crash mid-handoff loses the request instead of
+        # falling back: the goal (every request terminal) is unreachable
+        findings, res = audit_spec(RouterSpec(bug="dropped_handoff"))
+        assert [f.rule_id for f in findings] == ["PROTO002"]
+        assert res.stuck is not None
+        trace, _kind = res.stuck
+        assert any(a.startswith("crash") for a in trace)
+
+    def test_stale_resume_fires_proto001_double_delivery(self):
+        # crash-resume re-emits from the stale base: one token position
+        # delivered to the client twice
+        findings, res = audit_spec(ResumeSpec(bug="stale_resume"))
+        assert [f.rule_id for f in findings] == ["PROTO001"]
+        assert "delivered" in findings[0].message
+        trace, _ = res.safety
+        assert "crash_resume" in trace
+
+    def test_nonidempotent_commit_fires_proto001_double_commit(self):
+        # duplicate delivery after a successful commit re-commits
+        findings, res = audit_spec(TransportSpec(
+            bug="nonidempotent_commit"))
+        assert [f.rule_id for f in findings] == ["PROTO001"]
+        assert "idempotent retry broken" in findings[0].message
+        trace, _ = res.safety
+        # two ok deliveries of the same path, however the copies got
+        # into flight (retry or network duplicate)
+        assert sum(1 for a in trace if a.startswith("deliver[")) == 2
+
+    def test_each_bug_fires_exactly_once(self):
+        for spec in (HealthSpec(bug="flap_storm"),
+                     RouterSpec(bug="dropped_handoff"),
+                     ResumeSpec(bug="stale_resume"),
+                     TransportSpec(bug="nonidempotent_commit")):
+            findings, _res = audit_spec(spec)
+            assert len(findings) == 1, (spec.name,
+                                        [str(f) for f in findings])
+
+
+class TestHealthReplay:
+    def test_clean_log_replays_clean(self):
+        events = [
+            {"replica_id": "r0", "state": "suspect",
+             "reason": "missed probe"},
+            {"replica_id": "r0", "state": "alive",
+             "reason": "progress resumed"},
+            {"replica_id": "r1", "state": "dead", "reason": "crash"},
+            {"replica_id": "r1", "state": "alive", "reason": "revived"},
+        ]
+        assert replay_health_events(events) == []
+
+    def test_illegal_edge_fires_once(self):
+        # DEAD -> SUSPECT has no edge in the spec (revive resets to
+        # ALIVE; nothing probes a dead replica)
+        events = [
+            {"replica_id": "r0", "state": "dead", "reason": "crash"},
+            {"replica_id": "r0", "state": "suspect", "reason": "?"},
+        ]
+        findings = replay_health_events(events)
+        assert len(findings) == 1
+        assert findings[0].rule_id == "PROTO003"
+        assert "dead -> suspect" in findings[0].message
+
+    def test_unknown_state_is_drift(self):
+        findings = replay_health_events(
+            [{"replica_id": "r0", "state": "zombie", "reason": "?"}])
+        assert len(findings) == 1
+        assert "unknown health state" in findings[0].message
+
+
+class TestRouterReplay:
+    CLEAN = [
+        {"request_id": "q0", "event": "admitted"},
+        {"request_id": "q0", "event": "handoff_started"},
+        {"request_id": "q1", "event": "admitted"},
+        {"request_id": "q1", "event": "routed"},
+        {"request_id": "q0", "event": "handoff_committed"},
+        {"request_id": "q1", "event": "recovered"},
+        {"request_id": "q1", "event": "routed"},
+        {"request_id": "q0", "event": "completed"},
+        {"request_id": "q1", "event": "completed"},
+    ]
+
+    def test_clean_log_replays_clean(self):
+        assert replay_router_protocol(self.CLEAN) == []
+
+    def test_hand_mutated_dropped_completion_fires_exactly_once(self):
+        # the golden drill-log mutation: drop q1's terminal event — the
+        # request was admitted, worked on, and silently vanished
+        mutated = [ev for ev in self.CLEAN
+                   if not (ev["request_id"] == "q1"
+                           and ev["event"] == "completed")]
+        findings = replay_router_protocol(mutated)
+        assert len(findings) == 1
+        assert findings[0].rule_id == "PROTO003"
+        assert "dropped completion" in findings[0].message
+        assert "q1" in findings[0].message
+
+    def test_event_after_terminal_is_drift(self):
+        events = self.CLEAN + [{"request_id": "q0", "event": "routed"}]
+        findings = replay_router_protocol(events)
+        assert len(findings) == 1
+        assert "after its terminal" in findings[0].message
+
+    def test_double_completion_is_drift(self):
+        events = self.CLEAN + [{"request_id": "q0",
+                                "event": "completed"}]
+        findings = replay_router_protocol(events)
+        assert len(findings) == 1
+
+    def test_handoff_close_required_before_routing(self):
+        events = [
+            {"request_id": "q0", "event": "admitted"},
+            {"request_id": "q0", "event": "handoff_started"},
+            {"request_id": "q0", "event": "routed"},  # no close first
+            {"request_id": "q0", "event": "completed"},
+        ]
+        findings = replay_router_protocol(events)
+        assert len(findings) == 1
+        assert "handoff in flight" in findings[0].message
+
+    def test_open_requests_tolerated_without_expect_terminal(self):
+        events = [{"request_id": "q0", "event": "admitted"},
+                  {"request_id": "q0", "event": "routed"}]
+        assert replay_router_protocol(events,
+                                      expect_terminal=False) == []
+        assert len(replay_router_protocol(events)) == 1
+
+
+class TestTransportReplay:
+    def test_commit_then_dedup_is_clean(self):
+        events = [{"event": "committed", "key": "k1"},
+                  {"event": "deduped", "key": "k1"},
+                  {"event": "rejected", "key": "k2"},
+                  {"event": "committed", "key": "k2"}]
+        assert replay_transport_commits(events) == []
+
+    def test_double_commit_fires(self):
+        events = [{"event": "committed", "key": "k1"},
+                  {"event": "committed", "key": "k1"}]
+        findings = replay_transport_commits(events)
+        assert len(findings) == 1
+        assert "idempotent commit broken" in findings[0].message
+
+    def test_dedup_without_commit_fires(self):
+        findings = replay_transport_commits(
+            [{"event": "deduped", "key": "k9"}])
+        assert len(findings) == 1
+        assert "no prior commit" in findings[0].message
+
+
+class TestRestoreReplay:
+    def test_oom_halving_trail_is_clean(self):
+        attempts = [{"chunk_bytes": 4096, "outcome": "oom"},
+                    {"chunk_bytes": 2048, "outcome": "oom"},
+                    {"chunk_bytes": 1024, "outcome": "landed"}]
+        assert replay_restore_attempts(attempts) == []
+
+    def test_skipped_halving_fires(self):
+        attempts = [{"chunk_bytes": 4096, "outcome": "oom"},
+                    {"chunk_bytes": 4096, "outcome": "landed"}]
+        findings = replay_restore_attempts(attempts)
+        assert len(findings) == 1
+        assert "expected half" in findings[0].message
+
+    def test_empty_trail_fires(self):
+        findings = replay_restore_attempts([])
+        assert len(findings) == 1
+
+    def test_landed_must_be_terminal(self):
+        attempts = [{"chunk_bytes": 4096, "outcome": "landed"},
+                    {"chunk_bytes": 2048, "outcome": "oom"}]
+        findings = replay_restore_attempts(attempts)
+        assert len(findings) == 2  # early land + trailing unreplanned oom
+
+
+class TestHooks:
+    def test_check_protocol_specs_kill_switch(self, monkeypatch):
+        from easydist_tpu import config as edconfig
+        from easydist_tpu.analyze import check_protocol_specs
+
+        monkeypatch.setattr(edconfig, "enable_analyze", False)
+        # a buggy spec that WOULD fire: the kill switch must short-
+        # circuit before any exploration
+        assert check_protocol_specs(
+            [HealthSpec(bug="flap_storm")]) == []
+
+    def test_check_protocol_specs_raises_on_seeded_bug(self, monkeypatch):
+        from easydist_tpu import config as edconfig
+        from easydist_tpu.analyze import (AnalysisError,
+                                          check_protocol_specs)
+
+        monkeypatch.setattr(edconfig, "enable_analyze", True)
+        monkeypatch.setattr(edconfig, "analyze_raise", True)
+        with pytest.raises(AnalysisError, match="PROTO001"):
+            check_protocol_specs([ResumeSpec(bug="stale_resume")])
+
+    def test_check_protocol_specs_clean_on_shipped(self, monkeypatch):
+        from easydist_tpu import config as edconfig
+        from easydist_tpu.analyze import check_protocol_specs
+
+        monkeypatch.setattr(edconfig, "enable_analyze", True)
+        assert check_protocol_specs() == []
+
+    def test_check_protocol_conformance_routes_all_surfaces(
+            self, monkeypatch):
+        from easydist_tpu import config as edconfig
+        from easydist_tpu.analyze import check_protocol_conformance
+
+        monkeypatch.setattr(edconfig, "enable_analyze", True)
+        monkeypatch.setattr(edconfig, "analyze_raise", False)
+
+        class _Rec:
+            def __init__(self, events):
+                self._e = events
+
+            def transitions(self):
+                return self._e
+
+        findings = check_protocol_conformance(
+            router=_Rec([{"request_id": "q", "event": "routed"}]),
+            health=_Rec([{"replica_id": "r", "state": "zombie"}]),
+            transport=_Rec([{"event": "deduped", "key": "k"}]),
+            restore_attempts=[])
+        # one finding per drifting surface, each node-tagged
+        assert len(findings) == 5  # router: pre-admit + dropped
+        nodes = {f.node for f in findings}
+        assert {"drill:router", "drill:health", "drill:transport",
+                "drill:restore"} <= nodes
+
+    def test_check_protocol_conformance_kill_switch(self, monkeypatch):
+        from easydist_tpu import config as edconfig
+        from easydist_tpu.analyze import check_protocol_conformance
+
+        monkeypatch.setattr(edconfig, "enable_analyze", False)
+
+        class _Boom:
+            def transitions(self):  # must never be called
+                raise AssertionError("kill switch did not short-circuit")
+
+        assert check_protocol_conformance(router=_Boom()) == []

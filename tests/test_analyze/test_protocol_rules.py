@@ -1,0 +1,161 @@
+"""Layer-12c concurrency sanitizer (PROTO004/005): the old pre-PR-16
+autoscaler idiom fires both rules, snapshot-consuming observers and the
+owning class itself stay clean, every mutation shape is classified as a
+write, and the shipped tree is lint-clean repo-wide with an EMPTY
+committed baseline."""
+
+import os
+
+from easydist_tpu.analyze.protocol_rules import (lint_file_concurrency,
+                                                 lint_host_concurrency)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _lint(source: str):
+    return lint_file_concurrency("fixture.py", rel="fixture.py",
+                                 source=source)
+
+
+# the exact reach-into-router idiom the pre-snapshot Autoscaler used;
+# kept as a fixture so the lint provably catches the thing it was
+# built to end
+_OLD_AUTOSCALE_IDIOM = """
+class Autoscaler:
+    def observe(self):
+        n = len(self.router._decode_replicas)
+        depth = sum(len(q) for q in self.router._inflight.values())
+        ok = self.router._eligible
+        return n, depth, ok
+
+    def actuate(self, router, rep):
+        router._inflight.pop(rep, None)
+        self.router._next_request_id += 1
+        del router._handoffs[rep]
+        router._ring[0] = rep
+"""
+
+
+class TestProto004Reads:
+    def test_old_autoscale_reads_fire(self):
+        findings = _lint(_OLD_AUTOSCALE_IDIOM)
+        reads = [f for f in findings if f.rule_id == "PROTO004"]
+        chains = {f.message.split("`")[1] for f in reads}
+        assert chains == {"self.router._decode_replicas",
+                          "self.router._inflight",
+                          "self.router._eligible"}
+        for f in reads:
+            assert "snapshot" in f.message
+
+    def test_own_state_never_flags(self):
+        assert _lint("""
+class FleetRouter:
+    def _route(self, req):
+        self._inflight[req.id] = req
+        self._next_request_id += 1
+        return self._ring[0]
+""") == []
+
+    def test_cls_access_never_flags(self):
+        assert _lint("""
+class M:
+    @classmethod
+    def f(cls):
+        return cls._replicas
+""") == []
+
+    def test_non_fleet_private_out_of_scope(self):
+        # private attributes in unrelated subsystems: neither a shared
+        # fleet structure nor a fleet-named receiver
+        assert _lint("""
+def walk(node, assignment):
+    pool = node._pool_cache
+    dev = assignment._device_assignment
+    assignment._device_assignment = dev
+""") == []
+
+    def test_fleet_receiver_flags_any_private_attr(self):
+        # the attribute is NOT in the curated set, but the receiver is
+        # fleet vocabulary — one hop past the boundary still flags
+        findings = _lint("x = monitor._secret_state\n")
+        assert [f.rule_id for f in findings] == ["PROTO004"]
+
+    def test_shared_attr_flags_any_receiver(self):
+        findings = _lint("x = scheduler._inflight\n")
+        assert [f.rule_id for f in findings] == ["PROTO004"]
+
+    def test_dunder_never_flags(self):
+        assert _lint("x = router.__dict__\n") == []
+
+    def test_one_finding_per_site(self):
+        # same chain on one line: a single finding, not one per hop
+        findings = _lint("a = router._inflight or router._inflight\n")
+        assert len(findings) == 1
+
+
+class TestProto005Writes:
+    def test_mutator_call(self):
+        findings = _lint("router._inflight.pop('r0', None)\n")
+        assert [f.rule_id for f in findings] == ["PROTO005"]
+        assert "mutator call" in findings[0].message
+        assert "single-writer" in findings[0].message
+
+    def test_attribute_assignment(self):
+        findings = _lint("router._eligible = []\n")
+        assert [f.rule_id for f in findings] == ["PROTO005"]
+        assert "assignment target" in findings[0].message
+
+    def test_subscript_store(self):
+        findings = _lint("router._ring[0] = rep\n")
+        assert [f.rule_id for f in findings] == ["PROTO005"]
+        assert "subscript store" in findings[0].message
+
+    def test_augassign(self):
+        findings = _lint("fleet._next_request_id += 1\n")
+        assert [f.rule_id for f in findings] == ["PROTO005"]
+
+    def test_del_statement(self):
+        findings = _lint("del router._handoffs['r0']\n")
+        assert [f.rule_id for f in findings] == ["PROTO005"]
+
+    def test_tuple_unpack_target(self):
+        findings = _lint("router._eligible, y = [], 1\n")
+        assert [f.rule_id for f in findings] == ["PROTO005"]
+
+    def test_old_autoscale_writes_fire(self):
+        findings = _lint(_OLD_AUTOSCALE_IDIOM)
+        writes = [f for f in findings if f.rule_id == "PROTO005"]
+        assert len(writes) == 4  # pop, +=, del, subscript store
+
+    def test_nonmutator_call_is_a_read(self):
+        # .keys() does not mutate: the reach is flagged, but as a read
+        findings = _lint("ks = router._inflight.keys()\n")
+        assert [f.rule_id for f in findings] == ["PROTO004"]
+
+    def test_mutator_args_still_visited(self):
+        findings = _lint(
+            "items.append(router._inflight)\n")
+        assert [f.rule_id for f in findings] == ["PROTO004"]
+
+
+class TestRobustness:
+    def test_syntax_error_returns_empty(self):
+        assert _lint("def broken(:\n") == []
+
+    def test_missing_file_returns_empty(self):
+        assert lint_file_concurrency("/nonexistent/zz.py") == []
+
+    def test_findings_carry_path_and_line(self):
+        findings = _lint("\n\nx = router._inflight\n")
+        assert findings[0].path == "fixture.py"
+        assert findings[0].line == 3
+        assert findings[0].node == "fixture.py:3"
+
+
+class TestRepoIsClean:
+    def test_repo_wide_zero_findings(self):
+        # the acceptance bar: the shipped tree consumes snapshot
+        # surfaces everywhere — no baselined exceptions
+        findings = lint_host_concurrency(REPO_ROOT)
+        assert findings == [], [str(f) for f in findings]

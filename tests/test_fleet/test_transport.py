@@ -131,6 +131,32 @@ class TestInProcessTransport:
         tp.transfer(path, dst, prompt + [10])
         assert len(dst.imported) == 2
 
+    def test_duplicate_of_committed_transfer_survives_damage(self):
+        # GOLDEN (layer 12): a late duplicate of an ALREADY-COMMITTED
+        # final chunk arrives damaged.  The idempotence lookup must
+        # resolve before verification even looks at the payload — the
+        # duplicate is a pure no-op, not a spurious PageCorruptError.
+        # The armed corrupt occurrence staying unfired proves the
+        # duplicate never re-entered the verify-then-commit path.
+        tp = InProcessTransport()
+        dst = _FakeSession()
+        path = _path()
+        prompt = [0, 1, 2, 3, 4, 5, 6, 7, 9]
+        n1 = tp.transfer(path, dst, prompt)
+        with faultinject.fault_plan("fleet.transport.page_corrupt@1"):
+            n2 = tp.transfer(path, dst, prompt)  # damaged duplicate
+            assert faultinject.unfired() == [
+                ("fleet.transport.page_corrupt", 1)]
+        assert n1 == n2 == 2
+        assert tp.commits_deduped == 1
+        assert len(dst.imported) == 1        # trie touched exactly once
+        assert tp.pages_moved == 2           # duplicate moved nothing
+        assert len(tp.manifests) == 1        # and left no audit residue
+        # the conformance stream shows exactly commit-then-dedup — the
+        # shape replay_transport_commits (PROTO003) accepts
+        assert [e["event"] for e in tp.transitions()] == [
+            "committed", "deduped"]
+
     def test_commit_memory_bounded(self):
         tp = InProcessTransport(keep_commits=3)
         dst = _FakeSession()
