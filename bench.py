@@ -2151,6 +2151,45 @@ def fleet_chaos_main():
             router.replica(rep).session.metrics.snapshot()
             ["counters"].get("verify_steps", 0)
             for rep in router.stats()["replicas"])
+
+        # int8 wave: one more crash drill over a QUANTIZED paged fleet
+        # (kv_quant_dtype="int8").  Crash recovery re-prefills prompt +
+        # committed ids on a surviving replica; rint quantization is
+        # deterministic, so the rebuilt int8 pages — and every token
+        # after them — must match a calm single-session int8 reference
+        # bitwise.
+        def mk_q(rid):
+            sq = ServeConfig(decode_buckets=(seq,), max_decode_slots=4,
+                             prefill_chunk=chunk, prefill_batch=4,
+                             kv_layout="paged", kv_quant_dtype="int8")
+            return GenerationSession.for_gpt(params, cfg, config=sq,
+                                             replica_id=rid)
+
+        qref = mk_q("qref")
+        qf = [qref.submit(p, max_new_tokens=max_new)
+              for p in prompts[:n_req // 2]]
+        qref.run_until_drained()
+        q_want = [f.result(timeout=5)["ids"] for f in qf]
+
+        qrouter = FleetRouter([mk_q("q0"), mk_q("q1")],
+                              transport=InProcessTransport(),
+                              config=FleetConfig(seed=0))
+        qf = [qrouter.submit(p, max_new_tokens=max_new)
+              for p in prompts[:n_req // 2]]
+        q_target = qrouter.decision_log[0]["replica_id"]
+        q_order = list(qrouter.stats()["replicas"])
+        q_occ = 2 * len(q_order) + q_order.index(q_target) + 1
+        with faultinject.fault_plan(f"fleet.replica.crash@{q_occ}"):
+            qrouter.run_until_drained()
+            q_unfired = len(faultinject.unfired())
+            db = faultinject.export_stats(db=db)
+        q_out = [f.result(timeout=5) for f in qf]
+        q_parity = [o["ids"] for o in q_out] == q_want
+        q_dropped = sum(o["finish_reason"] not in ("length", "eos")
+                        for o in q_out)
+        q_recovered = qrouter.metrics.counter("requests_recovered")
+        q_crashes = qrouter.metrics.counter("replica_crashes")
+
         routing_findings = audit_routing(router.decision_log)
         # layer-12 conformance: the drill's recorded transitions()
         # streams replay through the protocol spec automata (PROTO003
@@ -2182,13 +2221,16 @@ def fleet_chaos_main():
         log(f"# fleet chaos: killed {crash_targets}, recovered "
             f"{recovered} request(s), dropped {dropped}, parity="
             f"{parity}, ttft p99 {chaos_p99:.0f}ms vs calm "
-            f"{calm_p99:.0f}ms ({inflation:.1f}x)")
+            f"{calm_p99:.0f}ms ({inflation:.1f}x); int8 wave killed "
+            f"{q_target}, recovered {q_recovered}, parity={q_parity}")
 
         ok = (parity and dropped == 0 and recovered > 0
               and crashes == 2 and unfired_total == 0
               and not routing_findings and not proto_findings
               and inflation <= p99_bound
-              and verify_total > 0)
+              and verify_total > 0
+              and q_parity and q_dropped == 0 and q_recovered > 0
+              and q_crashes == 1 and q_unfired == 0)
         result.update(
             value=round(clean / n_req, 4),
             parity_bitwise=bool(parity),
@@ -2203,6 +2245,12 @@ def fleet_chaos_main():
             protocol_events=len(router.transitions()),
             speculate_k=3,
             verify_steps=int(verify_total),
+            int8_wave_parity=bool(q_parity),
+            int8_wave_dropped=int(q_dropped),
+            int8_wave_recovered=int(q_recovered),
+            int8_wave_crashes=int(q_crashes),
+            int8_wave_unfired=int(q_unfired),
+            int8_wave_crash_target=q_target,
             handoff_fallbacks=int(router.metrics.counter(
                 "handoff_fallbacks")),
             prefill_handoffs=int(router.metrics.counter(
@@ -3094,6 +3142,236 @@ def autoscale_main():
     print(json.dumps(result), flush=True)
 
 
+def kv_scale_main():
+    """KV memory-scaling scenario (`--kv-scale`): the quantized +
+    host-tiered paged KV economics, three arms over one tiny GPT:
+
+      * exact arm — paged layout with quantization OFF must stay
+        bitwise against the bucketed session (the pre-quant contract)
+        with a scale-free {"k","v"} arena and no int8 anywhere in the
+        compiled decode (the jaxpr-identical purity guarantee);
+      * int8 arm — block-scaled int8 pages (kv_quant_dtype="int8").
+        Headline value: admissible sequences per HBM byte vs the exact
+        arm (page_bytes ratio through a fixed budget), gated >= 1.8x.
+        Quality gates: free-running greedy agreement AND a
+        teacher-forced A/B over the exact arm's sequences through
+        `gpt_verify_step_paged` (argmax agreement >= 0.995, max
+        absolute logit drift bounded);
+      * tier arm — int8 + host tier at a ~10x-HBM trie working set:
+        two passes of prefix-sharing traffic, second pass must restore
+        >= 0.9 of its prefix tokens from promoted host pages with zero
+        manifest failures; then the two kv.tier fault points drill
+        live (`fetch_corrupt` caught+refetched by the sha256 manifest,
+        `host_oom` pausing demotion without dropping a request), every
+        scheduled fault firing.
+
+    Forced to CPU — the gate is storage density + numerics, not device
+    peak."""
+    result = {"metric": "kv_slots_per_hbm_ratio", "value": 0.0,
+              "unit": "x"}
+    ratio_floor, match_floor, drift_bound, hit_floor = 1.8, 0.995, 0.5, 0.9
+    try:
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import jax
+        import jax.numpy as jnp
+
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+
+        from easydist_tpu.models.gpt import (GPTConfig, gpt_init,
+                                             gpt_verify_step_paged,
+                                             init_kv_pages)
+        from easydist_tpu.resilience import faultinject
+        from easydist_tpu.serve import GenerationSession, ServeConfig
+
+        seq, chunk, max_new, n_req = 64, 8, 6, 8
+        # vocab 64, not 256: the density/drift gates want a model whose
+        # top-logit gap dwarfs int8 rounding noise, and a random-init
+        # model's top-1/top-2 gap grows as the vocab shrinks — 256 iid
+        # logits sit in near-ties that flip on ~1e-3 drift and measure
+        # tie-breaking, not quantization quality
+        cfg = GPTConfig(vocab=64, seq=seq, dim=64, heads=4, layers=2,
+                        dtype="float32")
+        params = gpt_init(cfg, jax.random.PRNGKey(0))
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, cfg.vocab, size=9 + i % 6).tolist()
+                   for i in range(n_req)]
+
+        def sc(**kw):
+            kw.setdefault("kv_layout", "paged")
+            kw.setdefault("max_decode_slots", 4)
+            return ServeConfig(decode_buckets=(seq,), prefill_chunk=chunk,
+                               prefill_batch=2, **kw)
+
+        def run(sess, reqs, n_new=max_new):
+            futs = [sess.submit(p, max_new_tokens=n_new) for p in reqs]
+            sess.run_until_drained()
+            return [f.result(timeout=5)["ids"] for f in futs]
+
+        # bucketed exact reference: the bitwise target for the exact arm
+        want = run(GenerationSession.for_gpt(
+            params, cfg, config=sc(kv_layout="bucketed")), prompts)
+
+        # ---- exact arm: bitwise + scale-free purity
+        exact = GenerationSession.for_gpt(params, cfg, config=sc())
+        exact_ids = run(exact, prompts)
+        epool = next(iter(exact._pools.values()))
+        exact_pure = sorted(epool.arena) == ["k", "v"] and not any(
+            np.dtype(epool.arena[k].dtype) == np.int8 for k in epool.arena)
+        exact_bitwise = exact_ids == want
+
+        # ---- int8 arm: density + greedy agreement
+        q = GenerationSession.for_gpt(
+            params, cfg, config=sc(kv_quant_dtype="int8"))
+        q_ids = run(q, prompts)
+        qpool = next(iter(q._pools.values()))
+        pages_per_seq = seq // chunk
+        budget = 1 << 30  # any budget >> page_bytes: ratio is the gate
+        slots_exact = budget // (pages_per_seq * epool.page_bytes)
+        slots_int8 = budget // (pages_per_seq * qpool.page_bytes)
+        ratio = slots_int8 / slots_exact if slots_exact else 0.0
+        gen_pos = matched = 0
+        for a, b in zip(q_ids, want):
+            gen_pos += len(b)
+            matched += sum(x == y for x, y in zip(a, b))
+        greedy_match = matched / gen_pos if gen_pos else 0.0
+
+        # ---- teacher-forced A/B: score the exact arm's sequences
+        # through the paged verify path in both precisions (identity
+        # table, one row per sequence) and compare per-position argmax
+        # + raw logit drift on the generated span
+        def tf_logits(quant):
+            per = []
+            for p, g in zip(prompts, want):
+                s_full = list(p) + list(g)
+                pad = (-len(s_full)) % chunk
+                toks = jnp.asarray([s_full + [0] * pad], jnp.int32)
+                n_pg = toks.shape[1] // chunk
+                pages = init_kv_pages(cfg, n_pg, chunk, quant_dtype=quant)
+                tbl = jnp.arange(n_pg, dtype=jnp.int32)[None, :]
+                _, lg = gpt_verify_step_paged(
+                    params, cfg, pages, tbl, toks,
+                    jnp.zeros((1,), jnp.int32))
+                per.append(np.asarray(lg)[0, :len(s_full)])
+            return per
+
+        lg_exact, lg_int8 = tf_logits(None), tf_logits("int8")
+        tf_pos = tf_matched = 0
+        drift = 0.0
+        for pi, (p, g) in enumerate(zip(prompts, want)):
+            lo, hi = len(p) - 1, len(p) + len(g) - 1
+            a = lg_exact[pi][lo:hi].argmax(-1)
+            b = lg_int8[pi][lo:hi].argmax(-1)
+            tf_matched += int((a == b).sum())
+            tf_pos += hi - lo
+            drift = max(drift, float(
+                np.abs(lg_int8[pi][lo:hi] - lg_exact[pi][lo:hi]).max()))
+        tf_match = tf_matched / tf_pos if tf_pos else 0.0
+
+        # ---- tier arm: int8 + host tier at a 10x working set
+        n_pfx, pfx_pages, arena_pages = 48, 5, 24
+        pfx = [rng.randint(0, cfg.vocab,
+                           size=pfx_pages * chunk).tolist()
+               for _ in range(n_pfx)]
+        tier_prompts = [pfx[i] + rng.randint(0, cfg.vocab,
+                                             size=3).tolist()
+                        for i in range(n_pfx)]
+        tsess = GenerationSession.for_gpt(params, cfg, config=sc(
+            kv_quant_dtype="int8", kv_arena_pages=arena_pages,
+            max_decode_slots=2, kv_host_tier_bytes=64 * 2**20))
+        pass1 = run(tsess, tier_prompts, n_new=4)
+        tpool = next(iter(tsess._pools.values()))
+        before = tsess.metrics.snapshot()["counters"]
+        pass2 = run(tsess, tier_prompts, n_new=4)
+        after = tsess.metrics.snapshot()["counters"]
+        reused = after.get("prefix_tokens_reused", 0) \
+            - before.get("prefix_tokens_reused", 0)
+        total = after.get("prefix_tokens_total", 0) \
+            - before.get("prefix_tokens_total", 0)
+        hit_rate = reused / total if total else 0.0
+        tier = tpool.tier.stats()
+        working_set_x = (n_pfx * pfx_pages) / arena_pages
+        tier_bitwise = pass1 == pass2
+        tier_clean = (tpool.tier.check_invariants() == []
+                      and tpool.trie.check_invariants() == [])
+
+        # ---- fault drills: both kv.tier points, every fault must fire
+        drill_prompts = [rng.randint(0, cfg.vocab,
+                                     size=pfx_pages * chunk + 3).tolist()
+                         for _ in range(6)]
+        with faultinject.fault_plan("kv.tier.fetch_corrupt@1"):
+            run(tsess, drill_prompts[:3], n_new=2)
+            corrupt_unfired = len(faultinject.unfired())
+        retries = tpool.tier.stats()["fetch_retries"]
+        with faultinject.fault_plan("kv.tier.host_oom@1"):
+            oom_ids = run(tsess, drill_prompts[3:], n_new=2)
+            oom_unfired = len(faultinject.unfired())
+        oom_paused = tpool.tier.paused
+        tpool.tier.resume()
+        drills_ok = (corrupt_unfired == 0 and oom_unfired == 0
+                     and retries >= 1 and oom_paused
+                     and not tpool.tier.paused
+                     and tpool.tier.stats()["manifest_failures"] == 0
+                     and len(oom_ids) == 3)
+
+        log(f"# kv-scale: density {ratio:.2f}x "
+            f"({epool.page_bytes}B -> {qpool.page_bytes}B/page), greedy "
+            f"{greedy_match:.4f}, tf {tf_match:.4f} (drift {drift:.3g}), "
+            f"tier hit {hit_rate:.3f} @ {working_set_x:.1f}x HBM "
+            f"({tier['demotions']} demote / {tier['promotions']} promote)")
+
+        ok = (exact_bitwise and exact_pure
+              and ratio >= ratio_floor
+              and greedy_match >= match_floor
+              and tf_match >= match_floor and drift <= drift_bound
+              and tier_bitwise and tier_clean
+              and hit_rate >= hit_floor and working_set_x >= 10.0
+              and tier["manifest_failures"] == 0
+              and tier["demotions"] > 0 and tier["promotions"] > 0
+              and drills_ok)
+        result.update(
+            value=round(ratio, 4),
+            ratio_floor=ratio_floor,
+            page_bytes_exact=int(epool.page_bytes),
+            page_bytes_int8=int(qpool.page_bytes),
+            slots_per_gib_exact=int(slots_exact),
+            slots_per_gib_int8=int(slots_int8),
+            exact_bitwise=bool(exact_bitwise),
+            exact_scale_free=bool(exact_pure),
+            greedy_match=round(greedy_match, 4),
+            teacher_forced_match=round(tf_match, 4),
+            match_floor=match_floor,
+            logit_drift_max=round(drift, 6),
+            logit_drift_bound=drift_bound,
+            tier_hit_rate=round(hit_rate, 4),
+            tier_hit_floor=hit_floor,
+            tier_working_set_x=round(working_set_x, 2),
+            tier_pass_bitwise=bool(tier_bitwise),
+            tier_invariants_clean=bool(tier_clean),
+            tier_demotions=int(tier["demotions"]),
+            tier_promotions=int(tier["promotions"]),
+            tier_manifest_failures=int(tier["manifest_failures"]),
+            tier_fetch_retries=int(retries),
+            drill_fetch_corrupt_unfired=int(corrupt_unfired),
+            drill_host_oom_unfired=int(oom_unfired),
+            drill_host_oom_paused=bool(oom_paused),
+            quant_bytes_saved_gauge=int(
+                q.metrics.snapshot()["gauges"].get(
+                    "kv_quant_bytes_saved", 0)),
+            device=jax.devices()[0].device_kind,
+            seq=seq, page_tokens=chunk, n_requests=n_req,
+            verdict="ok" if ok else "regression")
+        faultinject.export_stats(persist=True)
+    except Exception as e:  # always land the JSON line
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        result["error"] = f"{type(e).__name__}: {e}"
+        result["verdict"] = "error"
+    _annotate_vs_last_good(result)
+    _maybe_update_last_good(result)
+    print(json.dumps(result), flush=True)
+
+
 if __name__ == "__main__":
     if "--serve" in sys.argv:
         serve_main()
@@ -3111,6 +3389,8 @@ if __name__ == "__main__":
         prefill_main()
     elif "--fleet-chaos" in sys.argv:
         fleet_chaos_main()
+    elif "--kv-scale" in sys.argv:
+        kv_scale_main()
     elif "--elastic-chaos" in sys.argv:
         elastic_chaos_main()
     elif "--simulate" in sys.argv:

@@ -106,7 +106,18 @@ A rule-based analyzer that runs after solving and before execution
            PROTO004 a read of private fleet state across an object
            boundary, PROTO005 a mutation of a shared fleet structure
            outside its owning class (observers must consume snapshot
-           surfaces; single-writer is what keeps the specs faithful).
+           surfaces; single-writer is what keeps the specs faithful);
+  layer 13 quantized/tiered-KV sanitizer (`audit_quant_arena`,
+           `audit_quant_program`, `audit_tier_roundtrip`,
+           analyze/kv_quant_rules.py) — KVQ001 a block-scaled int8
+           arena whose scale leaves are missing, mis-typed, or do not
+           block-partition their payload (dequant would broadcast the
+           wrong scales, bitwise-silently), KVQ002 a compiled paged
+           step feeding int8 K/V into a `dot_general` without the
+           dequant convert (logits off by the per-block scale), KVQ003
+           a host-tier entry whose stored bytes fail their sha256
+           manifest or whose byte accounting drifted (promotion would
+           serve corrupt K/V).
 
 Surfaced via `CompiledFunction.analyze()`, `bench.py --analyze`, the
 dryrun gate, and the analyzer driver (`python -m easydist_tpu.analyze`:
@@ -131,6 +142,8 @@ from .findings import (LAYERS, RULES, SEV_INFO, AnalysisError,
 from .fleet_rules import (audit_drained_session, audit_page_handoff,
                           audit_resume, audit_routing)
 from .jaxpr_rules import lint_bucket_plan, lint_fn, lint_jaxpr
+from .kv_quant_rules import (audit_quant_arena, audit_quant_program,
+                             audit_tier_roundtrip)
 from .kv_rules import audit_page_table
 from .modelcheck import (ALL_SPECS, COMMITTED_STATES, HealthSpec,
                          ResumeSpec, RouterSpec, Spec, TransportSpec,
@@ -177,6 +190,8 @@ __all__ = [
     "check_fleet_routing", "check_page_handoff", "check_fleet_drain",
     "check_resume_descriptor",
     "audit_page_table", "check_page_table",
+    "audit_quant_arena", "audit_quant_program", "audit_tier_roundtrip",
+    "check_quant_arena", "check_quant_program", "check_tier_roundtrip",
     "audit_reshard_plan", "audit_restored_state",
     "check_reshard_plan", "check_restored_state",
     "audit_prediction", "audit_scale_decisions",
@@ -358,6 +373,59 @@ def check_page_table(pool, table, trie=None, node: str = "kv"):
     if not edconfig.enable_analyze:
         return []
     findings = audit_page_table(pool, table, trie=trie, node=node)
+    report = AnalysisReport(findings)
+    if report.errors() and edconfig.analyze_raise:
+        report.raise_on_errors()
+    for f in findings:
+        logger.warning("[analyze] %s", f)
+    return findings
+
+
+def check_quant_arena(arena, node: str = "kv.quant"):
+    """Runtime self-check hook for the quantized paged arena (KVQ001):
+    payload/scale structural consistency.  Raises (or logs, with the
+    escape hatch) on error findings — a desynced scale arena
+    dequantizes pages into garbage, bitwise-silently.  Returns the
+    findings."""
+    from easydist_tpu import config as edconfig
+
+    if not edconfig.enable_analyze:
+        return []
+    findings = audit_quant_arena(arena, node=node)
+    report = AnalysisReport(findings)
+    if report.errors() and edconfig.analyze_raise:
+        report.raise_on_errors()
+    for f in findings:
+        logger.warning("[analyze] %s", f)
+    return findings
+
+
+def check_quant_program(result, node: str = "decode.quant"):
+    """Compile-time self-check hook for quantized paged steps (KVQ002):
+    lint the program for int8 operands reaching a dot_general (the
+    missing-dequant bug).  Returns the findings."""
+    from easydist_tpu import config as edconfig
+
+    if not edconfig.enable_analyze:
+        return []
+    findings = audit_quant_program(result, node=node)
+    report = AnalysisReport(findings)
+    if report.errors() and edconfig.analyze_raise:
+        report.raise_on_errors()
+    for f in findings:
+        logger.warning("[analyze] %s", f)
+    return findings
+
+
+def check_tier_roundtrip(tier, node: str = "kv.tier"):
+    """Runtime self-check hook for the host KV tier (KVQ003): manifest
+    re-verification + byte accounting over every stored entry.  Returns
+    the findings."""
+    from easydist_tpu import config as edconfig
+
+    if not edconfig.enable_analyze:
+        return []
+    findings = audit_tier_roundtrip(tier, node=node)
     report = AnalysisReport(findings)
     if report.errors() and edconfig.analyze_raise:
         report.raise_on_errors()

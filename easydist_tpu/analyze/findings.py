@@ -260,6 +260,26 @@ RULES: Dict[str, tuple] = {
                  "class's methods: external writes to rings, in-flight "
                  "tables, or commit maps bypass the single-writer "
                  "protocol the model checker verifies"),
+    # ---- layer 13: quantized/tiered KV sanitizer
+    #      (analyze/kv_quant_rules.py)
+    "KVQ001": (SEV_ERROR,
+               "quantized arena desync: scale arena missing/mis-shaped "
+               "for its int8 payload (or scales present over a "
+               "non-quantized payload) — dequantized K/V would be "
+               "garbage at exactly the pages the shapes disagree on, "
+               "bitwise-silently"),
+    "KVQ002": (SEV_ERROR,
+               "quantized decode program feeds int8 K/V into a "
+               "dot_general without dequantizing (no int8->float "
+               "convert/scale multiply on the operand path) — logits "
+               "would be computed on raw quantized codes, off by the "
+               "per-block scale"),
+    "KVQ003": (SEV_ERROR,
+               "host-tier round-trip integrity broken: a tier entry's "
+               "stored bytes disagree with its sha256 manifest, or the "
+               "tier's byte accounting drifted from its entries — "
+               "promotion would serve corrupt K/V (or the budget gate "
+               "lies)"),
     # ---- analyzer driver (analyze/driver.py)
     "DRV001": (SEV_WARNING,
                "unused inline suppression: an `# easydist: disable=...` "
@@ -292,6 +312,7 @@ LAYERS: List[tuple] = [
     ("10 discovery", ("DISC",)),
     ("11 aliasing", ("ALIAS",)),
     ("12 protocol", ("PROTO",)),
+    ("13 kv quant", ("KVQ",)),
     ("driver", ("DRV",)),
 ]
 

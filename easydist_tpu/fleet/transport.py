@@ -106,7 +106,10 @@ def _corrupt_in_flight(path: Sequence[Page]) -> List[Page]:
 def _page_digest(tokens: Sequence[int], kv: Dict[str, object]) -> Tuple[str, int]:
     """sha256 over the page identity AND payload: token ids, then each
     array's dtype/shape/raw bytes in key order — any bit flip anywhere in
-    the page changes the digest."""
+    the page changes the digest.  Key order covers every arena leaf, so
+    quantized pages' scale planes (`k_scale`/`v_scale`) enter the digest
+    alongside the int8 payloads — a scale/payload desync across the wire
+    fails verification exactly like a flipped payload bit."""
     h = hashlib.sha256()
     for t in tokens:
         h.update(int(t).to_bytes(8, "big", signed=True))

@@ -34,8 +34,10 @@ from __future__ import annotations
 
 from .pool import PagePool
 from .table import PageTable
+from .tier import HostTier, TierError
 
-__all__ = ["PagePool", "PageTable", "is_page_ref"]
+__all__ = ["HostTier", "PagePool", "PageTable", "TierError",
+           "is_host_ref", "is_page_ref"]
 
 
 def is_page_ref(kv) -> bool:
@@ -49,3 +51,12 @@ def is_page_ref(kv) -> bool:
     reference is storage the next donating dispatch invalidates
     (analyze layer 11, ALIAS004)."""
     return isinstance(kv, dict) and set(kv) == {"page"}
+
+
+def is_host_ref(kv) -> bool:
+    """True iff a trie-committed kv value was DEMOTED to the host tier
+    (`{"host": key}`, `tier.HostTier` holding the bytes).  Host refs own
+    no arena page: the trie node charges 0 bytes against the HBM budget
+    and promotion (tier.get + arena import) swaps the value back to a
+    `{"page": id}` ref before the slot's first decode step."""
+    return isinstance(kv, dict) and set(kv) == {"host"}

@@ -1,0 +1,242 @@
+"""Host memory tier for cold prefix-trie KV pages.
+
+HBM caps the prefix trie's working set: every committed page the trie
+holds is an arena page a live sequence cannot use, so under memory
+pressure the trie evicts — and the next prompt sharing that prefix pays
+full prefill again.  This module adds the layer below HBM: cold unpinned
+trie pages DEMOTE to host numpy over the chunked, RESHARD001-audited
+`reshard.fetch_chunked` substrate (no transfer ever stages more than one
+chunk), and PROMOTE back into a freshly allocated arena page on the next
+trie hit, before the slot's first decode step.  The prefix cache's
+effective capacity becomes host-RAM-bound, not HBM-bound.
+
+Integrity contract — the same idiom as `fleet/transport.py`:
+
+  * every demoted page carries a sha256 **manifest** over its fetched
+    bytes (per leaf: name, dtype, shape, raw buffer).  The digest is
+    computed from the first clean fetch while the HBM page still exists,
+    so a corrupt host copy detected at verification time can simply be
+    **refetched** (drilled by the `kv.tier.fetch_corrupt` fault point);
+  * promotion re-verifies the manifest before any byte re-enters the
+    arena — a mismatch drops the entry and surfaces as a trie miss (and
+    an analyze KVQ003 finding), never as silently corrupt KV;
+  * demotion/promotion move EXACT bytes (quantized pages ship payload
+    AND scales), so a tier round trip is bitwise — the exact-dtype
+    serving path stays bitwise with the tier on.
+
+Degradation: a failed host allocation (`kv.tier.host_oom` fault point)
+pauses demotion hold-and-warn style — serving continues with plain trie
+eviction, losing capacity, never correctness.  The host store itself is
+LRU-evicted under `byte_budget`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from easydist_tpu.resilience import faultinject
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["HostTier", "TierError", "page_digest"]
+
+
+class TierError(RuntimeError):
+    """A tier entry failed its manifest check (callers treat as a miss)."""
+
+
+def page_digest(arrays: Dict[str, np.ndarray]) -> str:
+    """sha256 over a page's host leaves in sorted-key order (name, dtype,
+    shape, raw bytes per leaf) — the per-page manifest.  Quantized pages
+    include their scale leaves automatically, so a scale/payload desync
+    cannot verify."""
+    h = hashlib.sha256()
+    for name in sorted(arrays):
+        a = np.ascontiguousarray(arrays[name])
+        h.update(name.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+class _Entry:
+    __slots__ = ("arrays", "digest", "nbytes", "tick")
+
+    def __init__(self, arrays, digest, nbytes, tick):
+        self.arrays = arrays
+        self.digest = digest
+        self.nbytes = nbytes
+        self.tick = tick
+
+
+class HostTier:
+    """LRU host store of demoted KV pages under `byte_budget` bytes.
+
+    `put` fetches each device leaf through `reshard.fetch_chunked`
+    (chunk-bounded staging), manifests the result, and verifies the host
+    copy against the manifest while the source page still exists — a
+    corrupt copy refetches once (`kv.tier.fetch_corrupt` drill) before
+    giving up.  `get` re-verifies the manifest and raises `TierError` on
+    mismatch (the entry is dropped; the caller recomputes).  All methods
+    are thread-safe for the session's single-writer use."""
+
+    def __init__(self, byte_budget: int, chunk_bytes: Optional[int] = None):
+        if byte_budget < 0:
+            raise ValueError(f"byte_budget must be >= 0, got {byte_budget}")
+        self.byte_budget = byte_budget
+        self.chunk_bytes = chunk_bytes
+        self.paused = False
+        self._lock = threading.Lock()
+        self._entries: Dict[object, _Entry] = {}
+        self._tick = 0
+        self.bytes_used = 0
+        self.demotions = 0
+        self.promotions = 0
+        self.fetch_retries = 0
+        self.manifest_failures = 0
+        self.host_evictions = 0
+
+    # ------------------------------------------------------------- demote
+    def _fetch_leaf(self, x, label: str) -> np.ndarray:
+        """One leaf device -> host with the corrupt-fetch drill: the
+        manifest digest comes from the transfer, the verification
+        re-check catches an injected post-transfer corruption, and the
+        refetch succeeds because the HBM page is still live at demotion
+        time."""
+        from easydist_tpu.reshard import fetch_chunked
+
+        for attempt in (1, 2):
+            host = fetch_chunked(x, chunk_bytes=self.chunk_bytes,
+                                 node=f"kv.tier[{label}]")
+            digest = hashlib.sha256(
+                np.ascontiguousarray(host).tobytes()).hexdigest()
+            if faultinject.fire("kv.tier.fetch_corrupt"):
+                # simulated in-flight corruption of the host copy (bad
+                # DMA / bit rot between transfer and store)
+                host = np.array(host, copy=True)
+                flat = host.reshape(-1).view(np.uint8)
+                flat[0] ^= 0xFF
+            check = hashlib.sha256(
+                np.ascontiguousarray(host).tobytes()).hexdigest()
+            if check == digest:
+                return host
+            self.fetch_retries += 1
+            logger.warning("[kv.tier] corrupt fetch of %s caught by "
+                           "manifest (attempt %d); refetching", label,
+                           attempt)
+        raise TierError(f"leaf {label} failed manifest verification "
+                        f"twice during demotion")
+
+    def put(self, key, arrays: Dict[str, object]) -> bool:
+        """Demote one page (dict of device arrays) under `key`.  Returns
+        False without storing when the tier is paused, the budget is 0,
+        the page exceeds the whole budget, or a host allocation fails
+        (which also pauses the tier hold-and-warn style)."""
+        if self.paused or self.byte_budget == 0:
+            return False
+        try:
+            if faultinject.fire("kv.tier.host_oom"):
+                raise MemoryError("injected host allocation failure")
+            host = {name: self._fetch_leaf(arrays[name], f"{key}:{name}")
+                    for name in sorted(arrays)}
+        except MemoryError as e:
+            self.paused = True
+            logger.warning("[kv.tier] host allocation failed (%s); "
+                           "demotion PAUSED — serving continues with "
+                           "plain trie eviction", e)
+            return False
+        nbytes = sum(int(a.nbytes) for a in host.values())
+        if nbytes > self.byte_budget:
+            return False
+        with self._lock:
+            self._evict_to(self.byte_budget - nbytes)
+            if self.bytes_used + nbytes > self.byte_budget:
+                return False
+            self._tick += 1
+            self._entries[key] = _Entry(host, page_digest(host), nbytes,
+                                        self._tick)
+            self.bytes_used += nbytes
+            self.demotions += 1
+        return True
+
+    def _evict_to(self, budget: int) -> None:
+        while self.bytes_used > budget and self._entries:
+            victim = min(self._entries, key=lambda k: self._entries[k].tick)
+            self.bytes_used -= self._entries.pop(victim).nbytes
+            self.host_evictions += 1
+
+    # ------------------------------------------------------------ promote
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def get(self, key) -> Dict[str, np.ndarray]:
+        """Promote-read one page: manifest-verify and return the host
+        arrays (the entry stays until `drop`).  Raises `KeyError` for an
+        unknown key and `TierError` (after dropping the entry) when the
+        stored bytes no longer match the manifest."""
+        with self._lock:
+            entry = self._entries[key]
+            self._tick += 1
+            entry.tick = self._tick
+        if page_digest(entry.arrays) != entry.digest:
+            with self._lock:
+                if key in self._entries:
+                    self.bytes_used -= self._entries.pop(key).nbytes
+                self.manifest_failures += 1
+            raise TierError(f"tier entry {key!r} failed manifest "
+                            f"verification at promotion")
+        self.promotions += 1
+        return entry.arrays
+
+    def drop(self, key) -> None:
+        """Forget one entry (after promotion moved it back to HBM, or
+        when its trie node is evicted outright)."""
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is not None:
+                self.bytes_used -= entry.nbytes
+
+    def resume(self) -> None:
+        """Lift a `host_oom` pause (operator action after freeing RAM)."""
+        self.paused = False
+
+    # ---------------------------------------------------------- reporting
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            return {"entries": len(self._entries),
+                    "bytes_used": self.bytes_used,
+                    "byte_budget": self.byte_budget,
+                    "demotions": self.demotions,
+                    "promotions": self.promotions,
+                    "fetch_retries": self.fetch_retries,
+                    "manifest_failures": self.manifest_failures,
+                    "host_evictions": self.host_evictions,
+                    "paused": self.paused}
+
+    def check_invariants(self) -> List[str]:
+        """Byte-accounting + manifest audit (analyze KVQ003 wraps these
+        into findings)."""
+        problems: List[str] = []
+        with self._lock:
+            entries = dict(self._entries)
+            counted = self.bytes_used
+        seen = 0
+        for key, entry in entries.items():
+            seen += entry.nbytes
+            if page_digest(entry.arrays) != entry.digest:
+                problems.append(
+                    f"tier entry {key!r}: stored bytes disagree with the "
+                    f"sha256 manifest (host corruption — promotion would "
+                    f"serve wrong KV)")
+        if seen != counted:
+            problems.append(
+                f"tier byte accounting drift: counter {counted} != sum "
+                f"of entries {seen}")
+        return problems

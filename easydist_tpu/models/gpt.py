@@ -446,11 +446,18 @@ def gpt_decode_step(params, cfg: GPTConfig, cache, token, pos):
 
 
 def init_kv_pages(cfg: GPTConfig, n_pages: int, page_tokens: int,
-                  dtype=None):
+                  dtype=None, quant_dtype=None, quant_block: int = 0):
     """Zeroed page arena {"k", "v"}: [layers, n_pages, heads, page_tokens,
     head_dim].  Pages replace the batch axis of `init_kv_cache` at the
     same dim index, so `kv_cache_specs` shards heads (dim 2) on "tp"
-    identically for both layouts."""
+    identically for both layouts.
+
+    `quant_dtype="int8"` stores the payload block-scaled int8 and adds a
+    parallel scale arena {"k_scale", "v_scale"}: [layers, n_pages, heads,
+    page_tokens, head_dim // block] f32 (`quant_block` 0 = one block per
+    row).  Presence of the scale keys is the quant signal every paged
+    forward branches on — a {"k","v"}-only arena traces the exact
+    pre-quant program."""
     if n_pages < 1:
         raise ValueError(f"n_pages must be >= 1, got {n_pages}")
     if page_tokens < 1:
@@ -458,7 +465,19 @@ def init_kv_pages(cfg: GPTConfig, n_pages: int, page_tokens: int,
     hd = cfg.dim // cfg.heads
     dt = jnp.dtype(cfg.dtype if dtype in (None, "auto") else dtype)
     shape = (cfg.layers, n_pages, cfg.heads, page_tokens, hd)
-    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    if quant_dtype in (None, "none"):
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    if quant_dtype != "int8":
+        raise ValueError(f"quant_dtype must be None/'none'/'int8', "
+                         f"got {quant_dtype!r}")
+    block = quant_block or hd
+    if hd % block:
+        raise ValueError(f"quant_block {block} must divide head_dim {hd}")
+    sshape = (cfg.layers, n_pages, cfg.heads, page_tokens, hd // block)
+    return {"k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(sshape, jnp.float32),
+            "v_scale": jnp.zeros(sshape, jnp.float32)}
 
 
 def _pages_write_row(pages_layer, new, write_page, offset):
@@ -495,12 +514,14 @@ def gpt_prefill_chunk_paged(params, cfg: GPTConfig, pages, table, tokens,
     so when that length equals the bucketed window the lowered program
     matches `gpt_prefill_chunk` shape-for-shape and the logits are
     bitwise identical.  Requires tokens.shape[1] == page_tokens."""
-    from easydist_tpu.ops import chunk_attention, gather_pages
+    from easydist_tpu.ops import (chunk_attention, gather_pages,
+                                  kv_dequantize, kv_quantize)
 
     dtype = jnp.dtype(cfg.dtype)
     heads = cfg.heads
     b, c_len = tokens.shape
     pt = pages["k"].shape[3]
+    quant_nb = pages["k_scale"].shape[-1] if "k_scale" in pages else 0
     if c_len != pt:
         raise ValueError(f"paged prefill chunk {c_len} != page_tokens {pt} "
                          f"(chunks must fill exactly one page)")
@@ -514,6 +535,7 @@ def gpt_prefill_chunk_paged(params, cfg: GPTConfig, pages, table, tokens,
     x = params["wte"][tokens].astype(dtype) \
         + params["wpe"][abs_pos].astype(dtype)
     new_k, new_v = [], []
+    new_ks, new_vs = [], []
     for li, blk in enumerate(_block_list(params, cfg)):
         p_at = blk["attn"]
         h_in = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"]).astype(dtype)
@@ -523,13 +545,28 @@ def gpt_prefill_chunk_paged(params, cfg: GPTConfig, pages, table, tokens,
         q = q.reshape(b, c_len, heads, hd).transpose(0, 2, 1, 3)
         k = k.reshape(b, c_len, heads, hd).transpose(0, 2, 1, 3)
         v = v.reshape(b, c_len, heads, hd).transpose(0, 2, 1, 3)
+        if quant_nb:
+            # quantize-on-commit: the page stores block-scaled int8, the
+            # scale page rides the same write/gather indices
+            k, sk = kv_quantize(k, quant_nb)
+            v, sv = kv_quantize(v, quant_nb)
+            psk = _pages_write_chunk(pages["k_scale"][li], sk, wp)
+            psv = _pages_write_chunk(pages["v_scale"][li], sv, wp)
+            new_ks.append(psk)
+            new_vs.append(psv)
         pk = _pages_write_chunk(pages["k"][li], k, wp)
         pv = _pages_write_chunk(pages["v"][li], v, wp)
         new_k.append(pk)
         new_v.append(pv)
         # gather AFTER the write so the chunk attends its own fresh page
-        ck = gather_pages(pk, tbl)
-        cv = gather_pages(pv, tbl)
+        if quant_nb:
+            ck = kv_dequantize(gather_pages(pk, tbl),
+                               gather_pages(psk, tbl), dtype)
+            cv = kv_dequantize(gather_pages(pv, tbl),
+                               gather_pages(psv, tbl), dtype)
+        else:
+            ck = gather_pages(pk, tbl)
+            cv = gather_pages(pv, tbl)
         att = chunk_attention(q, ck.astype(dtype), cv.astype(dtype),
                               abs_pos)
         att = att.transpose(0, 2, 1, 3).reshape(b, c_len, cfg.dim)
@@ -541,6 +578,9 @@ def gpt_prefill_chunk_paged(params, cfg: GPTConfig, pages, table, tokens,
         x = x + (h @ blk["mlp"]["proj"]["w"].astype(dtype)
                  + blk["mlp"]["proj"]["b"].astype(dtype))
     pages = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    if quant_nb:
+        pages["k_scale"] = jnp.stack(new_ks)
+        pages["v_scale"] = jnp.stack(new_vs)
     x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
     rel_last = jnp.clip(lengths.astype(jnp.int32) - 1 - start, 0, c_len - 1)
     last = jnp.take_along_axis(x, rel_last[:, None, None], axis=1)[:, 0]
@@ -570,12 +610,14 @@ def gpt_verify_step_paged(params, cfg: GPTConfig, pages, table, tokens,
     have every touched window mapped (or the whole row sentinel — dead
     rows drop); rejected positions live in mapped pages until the host
     truncates the table tail past the reservation."""
-    from easydist_tpu.ops import chunk_attention, gather_pages
+    from easydist_tpu.ops import (chunk_attention, gather_pages,
+                                  kv_dequantize, kv_quantize)
 
     dtype = jnp.dtype(cfg.dtype)
     heads = cfg.heads
     b, s = tokens.shape
     pt = pages["k"].shape[3]
+    quant_nb = pages["k_scale"].shape[-1] if "k_scale" in pages else 0
     hd = cfg.dim // heads
     start = pos.astype(jnp.int32)
     tbl = table.astype(jnp.int32)
@@ -587,6 +629,7 @@ def gpt_verify_step_paged(params, cfg: GPTConfig, pages, table, tokens,
     x = params["wte"][tokens].astype(dtype) \
         + params["wpe"][abs_pos].astype(dtype)
     new_k, new_v = [], []
+    new_ks, new_vs = [], []
     for li, blk in enumerate(_block_list(params, cfg)):
         p_at = blk["attn"]
         h_in = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"]).astype(dtype)
@@ -596,12 +639,25 @@ def gpt_verify_step_paged(params, cfg: GPTConfig, pages, table, tokens,
         q = q.reshape(b, s, heads, hd).transpose(0, 2, 1, 3)
         k = k.reshape(b, s, heads, hd).transpose(0, 2, 1, 3)
         v = v.reshape(b, s, heads, hd).transpose(0, 2, 1, 3)
+        if quant_nb:
+            k, sk = kv_quantize(k, quant_nb)
+            v, sv = kv_quantize(v, quant_nb)
+            psk = _pages_write_rows(pages["k_scale"][li], sk, wp, off)
+            psv = _pages_write_rows(pages["v_scale"][li], sv, wp, off)
+            new_ks.append(psk)
+            new_vs.append(psv)
         pk = _pages_write_rows(pages["k"][li], k, wp, off)
         pv = _pages_write_rows(pages["v"][li], v, wp, off)
         new_k.append(pk)
         new_v.append(pv)
-        ck = gather_pages(pk, tbl)
-        cv = gather_pages(pv, tbl)
+        if quant_nb:
+            ck = kv_dequantize(gather_pages(pk, tbl),
+                               gather_pages(psk, tbl), dtype)
+            cv = kv_dequantize(gather_pages(pv, tbl),
+                               gather_pages(psv, tbl), dtype)
+        else:
+            ck = gather_pages(pk, tbl)
+            cv = gather_pages(pv, tbl)
         att = chunk_attention(q, ck.astype(dtype), cv.astype(dtype),
                               abs_pos)
         att = att.transpose(0, 2, 1, 3).reshape(b, s, cfg.dim)
@@ -613,6 +669,9 @@ def gpt_verify_step_paged(params, cfg: GPTConfig, pages, table, tokens,
         x = x + (h @ blk["mlp"]["proj"]["w"].astype(dtype)
                  + blk["mlp"]["proj"]["b"].astype(dtype))
     pages = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    if quant_nb:
+        pages["k_scale"] = jnp.stack(new_ks)
+        pages["v_scale"] = jnp.stack(new_vs)
     x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
     return pages, x.astype(jnp.float32) @ params["wte"].T
 
@@ -625,12 +684,13 @@ def gpt_decode_step_paged(params, cfg: GPTConfig, pages, table, token, pos):
     gather + masked dot_general elsewhere).  The table's fixed
     [batch, max_pages] shape keeps ONE compiled signature across
     arbitrary per-row lengths — the whole point of the paged pool."""
-    from easydist_tpu.ops import paged_decode_attention
+    from easydist_tpu.ops import kv_quantize, paged_decode_attention
 
     dtype = jnp.dtype(cfg.dtype)
     heads = cfg.heads
     b = token.shape[0]
     pt = pages["k"].shape[3]
+    quant_nb = pages["k_scale"].shape[-1] if "k_scale" in pages else 0
     hd = cfg.dim // heads
     pos = pos.astype(jnp.int32)
     tbl = table.astype(jnp.int32)
@@ -639,6 +699,7 @@ def gpt_decode_step_paged(params, cfg: GPTConfig, pages, table, token, pos):
     x = params["wte"][token].astype(dtype) \
         + params["wpe"][pos].astype(dtype)
     new_k, new_v = [], []
+    new_ks, new_vs = [], []
     for li, blk in enumerate(_block_list(params, cfg)):
         p_at = blk["attn"]
         h_in = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"]).astype(dtype)
@@ -646,14 +707,28 @@ def gpt_decode_step_paged(params, cfg: GPTConfig, pages, table, token, pos):
             + p_at["qkv"]["b"].astype(dtype)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(b, heads, hd)
-        pk = _pages_write_row(pages["k"][li], k.reshape(b, heads, hd),
-                              wp, off)
-        pv = _pages_write_row(pages["v"][li], v.reshape(b, heads, hd),
-                              wp, off)
+        k = k.reshape(b, heads, hd)
+        v = v.reshape(b, heads, hd)
+        if quant_nb:
+            k, sk = kv_quantize(k, quant_nb)
+            v, sv = kv_quantize(v, quant_nb)
+            psk = _pages_write_row(pages["k_scale"][li], sk, wp, off)
+            psv = _pages_write_row(pages["v_scale"][li], sv, wp, off)
+            new_ks.append(psk)
+            new_vs.append(psv)
+        pk = _pages_write_row(pages["k"][li], k, wp, off)
+        pv = _pages_write_row(pages["v"][li], v, wp, off)
         new_k.append(pk)
         new_v.append(pv)
-        att = paged_decode_attention(q, pk.astype(dtype), pv.astype(dtype),
-                                     tbl, pos + 1)
+        if quant_nb:
+            # int8 pages stream to the kernel as-is; dequantization
+            # happens inside the online-softmax loop (or post-gather in
+            # the XLA fallback)
+            att = paged_decode_attention(q, pk, pv, tbl, pos + 1,
+                                         k_scale=psk, v_scale=psv)
+        else:
+            att = paged_decode_attention(q, pk.astype(dtype),
+                                         pv.astype(dtype), tbl, pos + 1)
         x = x + (att.reshape(b, cfg.dim) @ p_at["proj"]["w"].astype(dtype)
                  + p_at["proj"]["b"].astype(dtype))
         h = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"]).astype(dtype)
@@ -662,6 +737,9 @@ def gpt_decode_step_paged(params, cfg: GPTConfig, pages, table, token, pos):
         x = x + (h @ blk["mlp"]["proj"]["w"].astype(dtype)
                  + blk["mlp"]["proj"]["b"].astype(dtype))
     pages = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    if quant_nb:
+        pages["k_scale"] = jnp.stack(new_ks)
+        pages["v_scale"] = jnp.stack(new_vs)
     x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
     return pages, x.astype(jnp.float32) @ params["wte"].T
 

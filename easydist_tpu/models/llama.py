@@ -392,9 +392,13 @@ def llama_decode_step(params, cfg: LlamaConfig, cache, token, pos):
 
 
 def init_kv_pages(cfg: LlamaConfig, n_pages: int, page_tokens: int,
-                  dtype=None):
+                  dtype=None, quant_dtype=None, quant_block: int = 0):
     """Zeroed page arena {"k", "v"}: [layers, n_pages, kv_heads,
-    page_tokens, head_dim]."""
+    page_tokens, head_dim].  `quant_dtype="int8"` stores the payload
+    block-scaled int8 plus a parallel {"k_scale", "v_scale"} f32 scale
+    arena ([..., head_dim // block] — `quant_block` 0 = one block per
+    row); presence of the scale keys is the quant signal the paged
+    forwards branch on."""
     if n_pages < 1:
         raise ValueError(f"n_pages must be >= 1, got {n_pages}")
     if page_tokens < 1:
@@ -402,7 +406,19 @@ def init_kv_pages(cfg: LlamaConfig, n_pages: int, page_tokens: int,
     hd = cfg.dim // cfg.heads
     dt = jnp.dtype(cfg.dtype if dtype in (None, "auto") else dtype)
     shape = (cfg.layers, n_pages, cfg.kv_heads, page_tokens, hd)
-    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    if quant_dtype in (None, "none"):
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    if quant_dtype != "int8":
+        raise ValueError(f"quant_dtype must be None/'none'/'int8', "
+                         f"got {quant_dtype!r}")
+    block = quant_block or hd
+    if hd % block:
+        raise ValueError(f"quant_block {block} must divide head_dim {hd}")
+    sshape = (cfg.layers, n_pages, cfg.kv_heads, page_tokens, hd // block)
+    return {"k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(sshape, jnp.float32),
+            "v_scale": jnp.zeros(sshape, jnp.float32)}
 
 
 def _pages_write_row(pages_layer, new, write_page, offset):
@@ -426,11 +442,13 @@ def llama_prefill_chunk_paged(params, cfg: LlamaConfig, pages, table,
     staging cache, no restore copy), and attention gathers the virtual
     contiguous cache through the table, GQA-repeated after the gather.
     Requires tokens.shape[1] == page_tokens."""
-    from easydist_tpu.ops import chunk_attention, gather_pages
+    from easydist_tpu.ops import (chunk_attention, gather_pages,
+                                  kv_dequantize, kv_quantize)
 
     dtype = jnp.dtype(cfg.dtype)
     b, c_len = tokens.shape
     pt = pages["k"].shape[3]
+    quant_nb = pages["k_scale"].shape[-1] if "k_scale" in pages else 0
     if c_len != pt:
         raise ValueError(f"paged prefill chunk {c_len} != page_tokens {pt} "
                          f"(chunks must fill exactly one page)")
@@ -441,6 +459,7 @@ def llama_prefill_chunk_paged(params, cfg: LlamaConfig, pages, table,
     abs_pos = start[:, None] + jnp.arange(c_len, dtype=jnp.int32)[None, :]
     x = params["wte"][tokens].astype(dtype)
     new_k, new_v = [], []
+    new_ks, new_vs = [], []
     for li, blk in enumerate(params["blocks"]):
         hx = _rmsnorm(x, blk["attn_norm"]).astype(dtype)
 
@@ -454,12 +473,30 @@ def llama_prefill_chunk_paged(params, cfg: LlamaConfig, pages, table,
                       cfg.rope_theta).astype(dtype)
         k = _rope_abs(k.astype(jnp.float32), abs_pos,
                       cfg.rope_theta).astype(dtype)
+        if quant_nb:
+            # ROPED keys quantize (rope at write time, like the exact
+            # path stores roped keys); the GQA repeat happens after the
+            # gather on BOTH payload and scales, so dequant commutes
+            k, sk = kv_quantize(k, quant_nb)
+            v, sv = kv_quantize(v, quant_nb)
+            psk = _pages_write_chunk(pages["k_scale"][li], sk, wp)
+            psv = _pages_write_chunk(pages["v_scale"][li], sv, wp)
+            new_ks.append(psk)
+            new_vs.append(psv)
         pk = _pages_write_chunk(pages["k"][li], k, wp)
         pv = _pages_write_chunk(pages["v"][li], v, wp)
         new_k.append(pk)
         new_v.append(pv)
-        kf = gather_pages(pk, tbl, n_heads=cfg.heads).astype(dtype)
-        vf = gather_pages(pv, tbl, n_heads=cfg.heads).astype(dtype)
+        if quant_nb:
+            kf = kv_dequantize(gather_pages(pk, tbl, n_heads=cfg.heads),
+                               gather_pages(psk, tbl, n_heads=cfg.heads),
+                               dtype)
+            vf = kv_dequantize(gather_pages(pv, tbl, n_heads=cfg.heads),
+                               gather_pages(psv, tbl, n_heads=cfg.heads),
+                               dtype)
+        else:
+            kf = gather_pages(pk, tbl, n_heads=cfg.heads).astype(dtype)
+            vf = gather_pages(pv, tbl, n_heads=cfg.heads).astype(dtype)
         att = chunk_attention(q, kf, vf, abs_pos)
         out = att.transpose(0, 2, 1, 3).reshape(b, c_len, cfg.heads * hd)
         x = x + out @ blk["wo"].astype(dtype)
@@ -468,6 +505,9 @@ def llama_prefill_chunk_paged(params, cfg: LlamaConfig, pages, table,
             * (hx @ blk["w_up"].astype(dtype))
         x = x + gated @ blk["w_down"].astype(dtype)
     pages = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    if quant_nb:
+        pages["k_scale"] = jnp.stack(new_ks)
+        pages["v_scale"] = jnp.stack(new_vs)
     x = _rmsnorm(x, params["norm_f"])
     rel_last = jnp.clip(lengths.astype(jnp.int32) - 1 - start, 0, c_len - 1)
     last = jnp.take_along_axis(x, rel_last[:, None, None], axis=1)[:, 0]
@@ -490,11 +530,13 @@ def llama_verify_step_paged(params, cfg: LlamaConfig, pages, table, tokens,
     contiguous cache with the GQA repeat applied after the gather —
     matching the bucketed repeat-then-attend order bitwise.  Returns
     (pages, logits [batch, s, vocab]) for all s positions."""
-    from easydist_tpu.ops import chunk_attention, gather_pages
+    from easydist_tpu.ops import (chunk_attention, gather_pages,
+                                  kv_dequantize, kv_quantize)
 
     dtype = jnp.dtype(cfg.dtype)
     b, s = tokens.shape
     pt = pages["k"].shape[3]
+    quant_nb = pages["k_scale"].shape[-1] if "k_scale" in pages else 0
     hd = cfg.dim // cfg.heads
     start = pos.astype(jnp.int32)
     tbl = table.astype(jnp.int32)
@@ -503,6 +545,7 @@ def llama_verify_step_paged(params, cfg: LlamaConfig, pages, table, tokens,
     off = abs_pos % pt
     x = params["wte"][tokens].astype(dtype)
     new_k, new_v = [], []
+    new_ks, new_vs = [], []
     for li, blk in enumerate(params["blocks"]):
         hx = _rmsnorm(x, blk["attn_norm"]).astype(dtype)
 
@@ -516,12 +559,27 @@ def llama_verify_step_paged(params, cfg: LlamaConfig, pages, table, tokens,
                       cfg.rope_theta).astype(dtype)
         k = _rope_abs(k.astype(jnp.float32), abs_pos,
                       cfg.rope_theta).astype(dtype)
+        if quant_nb:
+            k, sk = kv_quantize(k, quant_nb)
+            v, sv = kv_quantize(v, quant_nb)
+            psk = _pages_write_rows(pages["k_scale"][li], sk, wp, off)
+            psv = _pages_write_rows(pages["v_scale"][li], sv, wp, off)
+            new_ks.append(psk)
+            new_vs.append(psv)
         pk = _pages_write_rows(pages["k"][li], k, wp, off)
         pv = _pages_write_rows(pages["v"][li], v, wp, off)
         new_k.append(pk)
         new_v.append(pv)
-        kf = gather_pages(pk, tbl, n_heads=cfg.heads).astype(dtype)
-        vf = gather_pages(pv, tbl, n_heads=cfg.heads).astype(dtype)
+        if quant_nb:
+            kf = kv_dequantize(gather_pages(pk, tbl, n_heads=cfg.heads),
+                               gather_pages(psk, tbl, n_heads=cfg.heads),
+                               dtype)
+            vf = kv_dequantize(gather_pages(pv, tbl, n_heads=cfg.heads),
+                               gather_pages(psv, tbl, n_heads=cfg.heads),
+                               dtype)
+        else:
+            kf = gather_pages(pk, tbl, n_heads=cfg.heads).astype(dtype)
+            vf = gather_pages(pv, tbl, n_heads=cfg.heads).astype(dtype)
         att = chunk_attention(q, kf, vf, abs_pos)
         out = att.transpose(0, 2, 1, 3).reshape(b, s, cfg.heads * hd)
         x = x + out @ blk["wo"].astype(dtype)
@@ -530,6 +588,9 @@ def llama_verify_step_paged(params, cfg: LlamaConfig, pages, table, tokens,
             * (hx @ blk["w_up"].astype(dtype))
         x = x + gated @ blk["w_down"].astype(dtype)
     pages = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    if quant_nb:
+        pages["k_scale"] = jnp.stack(new_ks)
+        pages["v_scale"] = jnp.stack(new_vs)
     x = _rmsnorm(x, params["norm_f"])
     return pages, x.astype(jnp.float32) @ params["wte"].T
 
@@ -541,11 +602,12 @@ def llama_decode_step_paged(params, cfg: LlamaConfig, pages, table, token,
     attention runs through `ops.paged_decode_attention` (the kernel maps
     query head -> kv head in its index maps; the fallback gathers then
     GQA-repeats, bitwise-matching the bucketed repeat-then-attend)."""
-    from easydist_tpu.ops import paged_decode_attention
+    from easydist_tpu.ops import kv_quantize, paged_decode_attention
 
     dtype = jnp.dtype(cfg.dtype)
     b = token.shape[0]
     pt = pages["k"].shape[3]
+    quant_nb = pages["k_scale"].shape[-1] if "k_scale" in pages else 0
     hd = cfg.dim // cfg.heads
     pos = pos.astype(jnp.int32)
     tbl = table.astype(jnp.int32)
@@ -553,6 +615,7 @@ def llama_decode_step_paged(params, cfg: LlamaConfig, pages, table, token,
     off = pos % pt
     x = params["wte"][token].astype(dtype)
     new_k, new_v = [], []
+    new_ks, new_vs = [], []
     for li, blk in enumerate(params["blocks"]):
         hx = _rmsnorm(x, blk["attn_norm"]).astype(dtype)
         q = (hx @ blk["wq"].astype(dtype)).reshape(b, cfg.heads, hd)
@@ -560,18 +623,32 @@ def llama_decode_step_paged(params, cfg: LlamaConfig, pages, table, token,
         v = (hx @ blk["wv"].astype(dtype)).reshape(b, cfg.kv_heads, hd)
         q = _rope_at(q.astype(jnp.float32), pos, cfg.rope_theta).astype(dtype)
         k = _rope_at(k.astype(jnp.float32), pos, cfg.rope_theta).astype(dtype)
+        if quant_nb:
+            k, sk = kv_quantize(k, quant_nb)
+            v, sv = kv_quantize(v, quant_nb)
+            psk = _pages_write_row(pages["k_scale"][li], sk, wp, off)
+            psv = _pages_write_row(pages["v_scale"][li], sv, wp, off)
+            new_ks.append(psk)
+            new_vs.append(psv)
         pk = _pages_write_row(pages["k"][li], k, wp, off)
         pv = _pages_write_row(pages["v"][li], v, wp, off)
         new_k.append(pk)
         new_v.append(pv)
-        att = paged_decode_attention(q, pk.astype(dtype), pv.astype(dtype),
-                                     tbl, pos + 1)
+        if quant_nb:
+            att = paged_decode_attention(q, pk, pv, tbl, pos + 1,
+                                         k_scale=psk, v_scale=psv)
+        else:
+            att = paged_decode_attention(q, pk.astype(dtype),
+                                         pv.astype(dtype), tbl, pos + 1)
         x = x + att.reshape(b, cfg.heads * hd) @ blk["wo"].astype(dtype)
         hx = _rmsnorm(x, blk["ffn_norm"]).astype(dtype)
         gated = jax.nn.silu(hx @ blk["w_gate"].astype(dtype)) \
             * (hx @ blk["w_up"].astype(dtype))
         x = x + gated @ blk["w_down"].astype(dtype)
     pages = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    if quant_nb:
+        pages["k_scale"] = jnp.stack(new_ks)
+        pages["v_scale"] = jnp.stack(new_vs)
     x = _rmsnorm(x, params["norm_f"])
     return pages, x.astype(jnp.float32) @ params["wte"].T
 

@@ -2,5 +2,7 @@
 
 from .flash_attention import (chunk_attention, decode_attention,  # noqa: F401
                               flash_attention, flash_decode_attention,
-                              flash_paged_decode_attention, gather_pages,
+                              flash_paged_decode_attention,
+                              flash_paged_decode_quant_attention,
+                              gather_pages, kv_dequantize, kv_quantize,
                               paged_decode_attention)

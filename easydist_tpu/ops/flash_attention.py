@@ -585,6 +585,62 @@ def _paged_decode_attention_xla(q, k_pages, v_pages, table, lengths,
     return _decode_attention_xla(q, kf, vf, lengths, scale)
 
 
+# ------------------------------------------- block-scaled int8 KV pages
+#
+# The EQuARX idiom (comm/quant.py) applied to KV pages: each K/V row is
+# split into `n_blocks` equal head-dim blocks, every block carries one f32
+# scale (amax / 127), and the payload is stored int8.  `jnp.rint` is
+# round-half-to-even — deterministic, so re-prefilling the same token
+# prefix reproduces quantized pages BITWISE (the crash-resume parity the
+# int8 fleet-chaos wave gates).  Scales live in a parallel scale arena
+# ({"k_scale", "v_scale"}: [..., page_tokens, n_blocks] f32) that rides
+# the same page table indices as the payload.
+
+_KV_QMAX = 127.0
+
+
+def kv_quantize(x, n_blocks: int):
+    """Block-scaled int8 over the LAST dim of `x` [..., d] with d split
+    into `n_blocks` equal blocks.  Returns (q int8 [..., d],
+    scales f32 [..., n_blocks]); all-zero blocks get scale 1.0 so
+    dequantization is exact for them."""
+    d = x.shape[-1]
+    if d % n_blocks:
+        raise ValueError(f"head_dim {d} not a multiple of n_blocks "
+                         f"{n_blocks}")
+    block = d // n_blocks
+    xb = x.astype(jnp.float32).reshape(*x.shape[:-1], n_blocks, block)
+    amax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0.0, amax / _KV_QMAX, 1.0)
+    q = jnp.clip(jnp.rint(xb / scale), -_KV_QMAX, _KV_QMAX).astype(jnp.int8)
+    return q.reshape(x.shape), scale[..., 0]
+
+
+def kv_dequantize(q, scales, dtype=jnp.float32):
+    """Inverse of `kv_quantize`: q int8 [..., d], scales f32
+    [..., n_blocks] -> [..., d] in `dtype`."""
+    d = q.shape[-1]
+    nb = scales.shape[-1]
+    block = d // nb
+    xb = q.astype(jnp.float32).reshape(*q.shape[:-1], nb, block)
+    return (xb * scales[..., None]).reshape(q.shape).astype(dtype)
+
+
+def _paged_decode_attention_quant_xla(q, k_pages, v_pages, k_scale,
+                                      v_scale, table, lengths,
+                                      scale: float):
+    """Quantized gather-then-mask fallback: gather int8 payload AND scale
+    pages through the same table, dequantize to f32, then run the exact
+    `_decode_attention_xla` einsum — the numerical reference the quant
+    kernel is tested against."""
+    h = q.shape[1]
+    kf = kv_dequantize(gather_pages(k_pages, table, n_heads=h),
+                       gather_pages(k_scale, table, n_heads=h))
+    vf = kv_dequantize(gather_pages(v_pages, table, n_heads=h),
+                       gather_pages(v_scale, table, n_heads=h))
+    return _decode_attention_xla(q, kf, vf, lengths, scale)
+
+
 def _flash_paged_decode_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref,
                                o_ref, o_scr, m_scr, l_scr, *, scale: float,
                                page_tokens: int, n_pages_max: int):
@@ -695,15 +751,139 @@ def flash_paged_decode_attention(q, k_pages, v_pages, table, lengths,
     return out
 
 
+def _flash_paged_decode_quant_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref,
+                                     ks_ref, vs_ref, o_ref, o_scr, m_scr,
+                                     l_scr, *, scale: float,
+                                     page_tokens: int, n_pages_max: int):
+    """`_flash_paged_decode_kernel` over block-scaled int8 pages: the K/V
+    blocks arrive int8 with their per-block f32 scales riding the SAME
+    page-table index map, and dequantization happens in VMEM inside the
+    online-softmax loop — the arena stream stays int8 all the way from
+    HBM, which is the whole 2-4x bytes/seq win."""
+    bi = pl.program_id(0)
+    pi = pl.program_id(2)
+
+    @pl.when(pi == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        o_scr[...] = jnp.zeros_like(o_scr)
+
+    length = len_ref[bi]
+
+    @pl.when(pi * page_tokens < length)
+    def _compute():
+        pt, d = k_ref.shape[2], k_ref.shape[3]
+        nb = ks_ref.shape[3]
+        q = q_ref[0].astype(jnp.float32) * scale        # [1, d]
+
+        def dq(blk_ref, s_ref):
+            blk = blk_ref[0, 0].astype(jnp.float32)     # [pt, d]
+            sc = s_ref[0, 0]                            # [pt, nb]
+            if nb == 1:
+                return blk * sc
+            return (blk.reshape(pt, nb, d // nb)
+                    * sc[:, :, None]).reshape(pt, d)
+
+        k_blk = dq(k_ref, ks_ref)
+        v_blk = dq(v_ref, vs_ref)
+        s = q @ k_blk.T                                 # [1, pt]
+        k_pos = pi * page_tokens + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(k_pos < length, s, _NEG_INF)
+        m_prev = m_scr[...]
+        l_prev = l_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[...] = m_new
+        l_scr[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        o_scr[...] = o_scr[...] * alpha + p @ v_blk
+
+    @pl.when(pi == n_pages_max - 1)
+    def _write():
+        o_ref[0] = (o_scr[...] / jnp.maximum(l_scr[...], 1e-30)).astype(
+            o_ref.dtype)
+
+
+def flash_paged_decode_quant_attention(q, k_pages, v_pages, k_scale,
+                                       v_scale, table, lengths,
+                                       scale: Optional[float] = None,
+                                       interpret: Optional[bool] = None):
+    """`flash_paged_decode_attention` over a block-scaled int8 arena.
+
+    k_pages/v_pages: int8 [n_pages, kv_heads, page_tokens, head_dim];
+    k_scale/v_scale: f32 [n_pages, kv_heads, page_tokens, n_blocks].  The
+    scale pages ride the same scalar-prefetched table index map as the
+    payload (one indirection, four streams), and the kernel dequantizes
+    on-chip inside the online-softmax loop.  Returns [batch, heads,
+    head_dim] in q.dtype."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, h, d = q.shape
+    n_pages, kvh, pt, _ = k_pages.shape
+    nb = k_scale.shape[-1]
+    mp = table.shape[1]
+    if h % kvh:
+        raise ValueError(f"heads {h} not a multiple of kv_heads {kvh}")
+    rep = h // kvh
+    tbl = jnp.asarray(table, jnp.int32)
+    lens = jnp.asarray(lengths, jnp.int32)
+
+    def kv_map(bi, hi, pi, tbl_ref, len_ref):
+        last_live = jnp.maximum(
+            jax.lax.div(len_ref[bi] + pt - 1, pt) - 1, 0)
+        page = tbl_ref[bi, jnp.minimum(pi, last_live)]
+        return (jnp.clip(page, 0, n_pages - 1), hi // rep, 0, 0)
+
+    kernel = functools.partial(_flash_paged_decode_quant_kernel,
+                               scale=scale, page_tokens=pt, n_pages_max=mp)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h, mp),
+        in_specs=[
+            pl.BlockSpec((1, 1, d),
+                         lambda bi, hi, pi, tbl_ref, len_ref: (bi, hi, 0)),
+            pl.BlockSpec((1, 1, pt, d), kv_map),
+            pl.BlockSpec((1, 1, pt, d), kv_map),
+            pl.BlockSpec((1, 1, pt, nb), kv_map),
+            pl.BlockSpec((1, 1, pt, nb), kv_map),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, d), lambda bi, hi, pi, tbl_ref, len_ref: (bi, hi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, d), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        compiler_params=tpu_compiler_params(
+            pltpu,
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(tbl, lens, q, k_pages, v_pages, k_scale, v_scale)
+    return out
+
+
 def paged_decode_attention(q, k_pages, v_pages, table, lengths,
                            scale: Optional[float] = None,
-                           backend: Optional[str] = None):
+                           backend: Optional[str] = None,
+                           k_scale=None, v_scale=None):
     """Backend-dispatching paged decode attention (the models' paged
     decode steps call this): the Pallas page-gathering kernel on TPU, the
     gather + masked dot_general path elsewhere.
     `EASYDIST_DECODE_ATTENTION` forces it — "paged"/"flash" pick the
     kernel, "xla" the gather fallback — and the value rides the same
-    strategy-cache salt entry as the contiguous knob."""
+    strategy-cache salt entry as the contiguous knob.  When
+    `k_scale`/`v_scale` are given the pages are block-scaled int8 and
+    both backends dequantize before the softmax (in-VMEM for the kernel,
+    post-gather for the fallback)."""
     from easydist_tpu import config as edconfig
 
     if scale is None:
@@ -715,6 +895,20 @@ def paged_decode_attention(q, k_pages, v_pages, table, lengths,
         backend = edconfig.decode_attention_backend
     if backend == "auto":
         backend = "paged" if jax.default_backend() == "tpu" else "xla"
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be given together")
+    if k_scale is not None:
+        if backend in ("paged", "flash"):
+            return flash_paged_decode_quant_attention(
+                q, k_pages, v_pages, k_scale, v_scale, table, lengths,
+                scale=scale)
+        if backend == "xla":
+            return _paged_decode_attention_quant_xla(
+                q, k_pages, v_pages, k_scale, v_scale, table, lengths,
+                scale)
+        raise ValueError(
+            f"unknown paged decode attention backend {backend!r}; "
+            f"expected auto|paged|flash|xla")
     if backend in ("paged", "flash"):
         return flash_paged_decode_attention(q, k_pages, v_pages, table,
                                             lengths, scale=scale)

@@ -66,6 +66,9 @@ FAULT_POINTS = frozenset({
     # SLO autoscaler (sim/autoscale.py)
     "autoscale.metrics.stale",   # planner sees frozen occupancy/p99
     "autoscale.scaleup.fail",    # replica spin-up raises mid-ramp
+    # host KV tier (kv/tier.py)
+    "kv.tier.fetch_corrupt",  # demotion fetch corrupt: manifest catch+refetch
+    "kv.tier.host_oom",       # host allocation fails: hold-and-warn pause
 })
 
 

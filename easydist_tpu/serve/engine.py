@@ -127,6 +127,18 @@ class ServeConfig:
     speculate_drafter: "ngram" (zero-cost self-speculative prompt
         lookup) or "draft_model" (a second small model's cached greedy
         decode; the session must be given a drafter or draft_model).
+    kv_quant_dtype: "none" (exact storage — the bitwise path) or "int8"
+        (paged arena pages stored block-scaled int8 with a parallel f32
+        scale arena; ~4x sequences per HBM byte, greedy output gated by
+        the bounded-drift A/B harness rather than bitwise).  Paged layout
+        only, and mutually exclusive with a non-auto kv_cache_dtype.
+    kv_quant_block: head-dim elements per quantization block (one f32
+        scale each); 0 = one block per K/V row (head_dim).  Must divide
+        head_dim.
+    kv_host_tier_bytes: host-RAM byte budget for demoting cold unpinned
+        prefix-trie pages out of the HBM arena (kv/tier.py; chunked
+        fetches, sha256 manifests, promote-on-hit); 0 disables the tier.
+        Paged layout with the prefix cache enabled only.
     """
     batch_buckets: Tuple[int, ...] = (1, 2, 4, 8)
     seq_buckets: Optional[Tuple[int, ...]] = None
@@ -158,6 +170,9 @@ class ServeConfig:
         default_factory=lambda: _default_speculate_k())
     speculate_drafter: str = field(
         default_factory=lambda: _default_speculate_drafter())
+    kv_quant_dtype: str = "none"
+    kv_quant_block: int = 0
+    kv_host_tier_bytes: int = 0
 
     def __post_init__(self):
         if not self.batch_buckets:
@@ -244,6 +259,38 @@ class ServeConfig:
                     f"max decode bucket {cap} is not a multiple of "
                     f"kv_page_tokens {pt}; pages must tile the sequence "
                     f"capacity exactly")
+        if self.kv_quant_dtype not in ("none", "int8"):
+            raise ValueError(f"kv_quant_dtype must be 'none' or 'int8', "
+                             f"got {self.kv_quant_dtype!r}")
+        if self.kv_quant_block < 0:
+            raise ValueError(f"kv_quant_block must be >= 0 (0 = one block "
+                             f"per row), got {self.kv_quant_block}")
+        if self.kv_quant_dtype != "none":
+            if self.kv_layout != "paged":
+                raise ValueError(
+                    f"kv_quant_dtype {self.kv_quant_dtype!r} requires the "
+                    f"paged layout (quantize-on-commit lives in the page "
+                    f"arena), got kv_layout={self.kv_layout!r}")
+            if self.kv_cache_dtype != "auto":
+                raise ValueError(
+                    f"kv_quant_dtype {self.kv_quant_dtype!r} is mutually "
+                    f"exclusive with a non-auto kv_cache_dtype "
+                    f"({self.kv_cache_dtype!r}): the quantized arena owns "
+                    f"its storage dtype (int8 payload + f32 scales)")
+        if self.kv_host_tier_bytes < 0:
+            raise ValueError(f"kv_host_tier_bytes must be >= 0 "
+                             f"(0 disables), got {self.kv_host_tier_bytes}")
+        if self.kv_host_tier_bytes:
+            if self.kv_layout != "paged":
+                raise ValueError(
+                    f"kv_host_tier_bytes requires the paged layout (the "
+                    f"tier demotes arena pages), got "
+                    f"kv_layout={self.kv_layout!r}")
+            if not self.enable_prefix_cache or not self.prefix_cache_bytes:
+                raise ValueError(
+                    "kv_host_tier_bytes requires the prefix cache (the "
+                    "tier holds cold TRIE pages; with no trie there is "
+                    "nothing to demote)")
         if self.speculate_k < 0:
             raise ValueError(f"speculate_k must be >= 0 (0 disables "
                              f"speculation), got {self.speculate_k}")

@@ -61,7 +61,8 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from easydist_tpu.kv import PagePool, PageTable
+from easydist_tpu.kv import PagePool, PageTable, is_host_ref, is_page_ref
+from easydist_tpu.kv.tier import HostTier, TierError
 from easydist_tpu.resilience import faultinject
 
 from .admission import ReplicaDrainingError, RequestTooLargeError
@@ -180,7 +181,9 @@ class _PagedPool:
 
     def __init__(self, bucket: int, n_slots: int, init_pages,
                  n_rows: int, chunk: int, prefix_bytes: int,
-                 n_pages: int):
+                 n_pages: int, host_tier_bytes: int = 0,
+                 export_page: Optional[Callable] = None,
+                 model_itemsize: int = 0):
         self.bucket = bucket
         self.n_slots = n_slots
         self.chunk = chunk                       # page_tokens
@@ -191,8 +194,17 @@ class _PagedPool:
                 f"full-length sequence ({self.max_pages} pages)")
         self.n_rows = n_rows
         self.arena = init_pages(n_pages, chunk)
+        # size pages from the arena's STORAGE leaves — quantized arenas
+        # charge int8 payload + f32 scales, not the model dtype, which is
+        # exactly the density win the kv_quant_bytes_saved gauge reports
         self.page_bytes = sum(int(self.arena[k].nbytes) // n_pages
-                              for k in ("k", "v"))
+                              for k in self.arena)
+        # what one page's k/v payload would cost at model precision —
+        # the baseline the quant-savings gauge subtracts from
+        payload_elems = sum(int(self.arena[k].size) // n_pages
+                            for k in ("k", "v"))
+        self.model_page_bytes = payload_elems * model_itemsize \
+            if model_itemsize else self.page_bytes
         self.pool = PagePool(n_pages, chunk, page_bytes=self.page_bytes)
         self.table = PageTable(n_slots, self.max_pages, n_pages)
         self.free: List[int] = list(range(n_slots))
@@ -203,10 +215,24 @@ class _PagedPool:
             PrefixCache(chunk, prefix_bytes,
                         on_evict=self._release_evicted) \
             if prefix_bytes else None
+        # host tier (kv/tier.py): demotion target for cold trie pages;
+        # `export_page(pool, pid)` is the session's compiled single-page
+        # arena read (the same program fleet export uses)
+        self.tier: Optional[HostTier] = \
+            HostTier(host_tier_bytes) \
+            if host_tier_bytes and self.trie is not None else None
+        self._export_page = export_page
+        self._tier_seq = 0
 
     def _release_evicted(self, node) -> None:
         # trie eviction drops the trie's hold on the node's arena page;
-        # the page only frees when no live slot still maps it
+        # the page only frees when no live slot still maps it.  A node
+        # already demoted to the host tier owns no arena page — evicting
+        # it just forgets the host copy.
+        if is_host_ref(node.kv):
+            if self.tier is not None:
+                self.tier.drop(node.kv["host"])
+            return
         self.pool.release(node.kv["page"])
 
     @property
@@ -224,12 +250,47 @@ class _PagedPool:
     def make_room(self, n_pages: int) -> bool:
         """Free arena pages until `n_pages` are available, evicting
         unpinned trie nodes LRU-first (an eviction only yields a free
-        page when no live slot shares it).  Returns availability."""
+        page when no live slot shares it).  Returns availability.
+
+        With a host tier configured, demotion runs FIRST: the coldest
+        unpinned device-page node moves its bytes to host and keeps its
+        trie position (the prefix survives HBM pressure).  Only when the
+        tier refuses (paused after host_oom, budget exhausted, nothing
+        demotable) does plain eviction run — and then only against
+        device-page nodes, because evicting a host-ref node frees no
+        arena page and would pointlessly discard tiered bytes."""
         if self.trie is not None:
             while self.pool.n_free < n_pages:
-                if not self.trie.evict_lru():
+                if self.tier is not None:
+                    if not self.tier.paused and self._demote_one():
+                        continue
+                    victim = self.trie.lru_node(
+                        lambda n: not n.children and is_page_ref(n.kv))
+                    if victim is None \
+                            or not self.trie.evict_node(victim):
+                        break
+                elif not self.trie.evict_lru():
                     break
         return self.pool.n_free >= n_pages
+
+    def _demote_one(self) -> bool:
+        """Demote the LRU unpinned device-page trie node to the host
+        tier: export the page's arrays, `tier.put` (chunked fetch +
+        manifest), swap the node's kv to `{"host": key}` at 0 trie
+        bytes, release the arena page.  Returns False when nothing is
+        demotable or the tier refused the bytes (caller falls back to
+        eviction)."""
+        node = self.trie.lru_node(lambda n: is_page_ref(n.kv))
+        if node is None or self._export_page is None:
+            return False
+        pid = node.kv["page"]
+        key = ("pg", self._tier_seq)
+        self._tier_seq += 1
+        if not self.tier.put(key, self._export_page(self, pid)):
+            return False
+        self.trie.reaccount(node, 0, kv={"host": key})
+        self.pool.release(pid)
+        return True
 
     def occupancy(self):
         """(pages_in_use, real tokens held) for the kv gauges: slots
@@ -246,8 +307,10 @@ class _PagedPool:
             for job in self.jobs.values():
                 mapped.update(self.table.mapped(job.slot_idx))
             for node in self.trie._walk():
-                if node.kv["page"] not in mapped:
-                    mapped.add(node.kv["page"])
+                pid = node.kv.get("page") \
+                    if isinstance(node.kv, dict) else None
+                if pid is not None and pid not in mapped:
+                    mapped.add(pid)  # host-ref nodes hold no arena page
                     tokens += self.chunk
         return self.pool.in_use, tokens
 
@@ -437,12 +500,17 @@ class GenerationSession:
                                                token, pos)
             return arena, jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
+        # export/import iterate ALL arena keys: a quantized arena ships
+        # its scale leaves alongside the int8 payload, so fleet manifests
+        # (and host-tier manifests) cover both — a scale/payload desync
+        # cannot pass a digest check.  Exact arenas have keys {"k","v"},
+        # so the quant-off jaxpr is unchanged.
         def _page_export(arena, page):
             import jax
 
             return {k: jax.lax.dynamic_index_in_dim(
                         arena[k], page, axis=1, keepdims=False)
-                    for k in ("k", "v")}
+                    for k in arena}
 
         def _page_import(arena, chunk_kv, page):
             import jax
@@ -450,7 +518,7 @@ class GenerationSession:
             return {k: jax.lax.dynamic_update_index_in_dim(
                         arena[k], chunk_kv[k].astype(arena[k].dtype),
                         page, axis=1)
-                    for k in ("k", "v")}
+                    for k in arena}
 
         def _verify_paged(arena, params, table, tokens, pos):
             import jax.numpy as jnp
@@ -606,7 +674,10 @@ class GenerationSession:
                     n_rows=cfg.prefill_batch, chunk=chunk,
                     prefix_bytes=(cfg.prefix_cache_bytes
                                   if cfg.enable_prefix_cache else 0),
-                    n_pages=n_pages)
+                    n_pages=n_pages,
+                    host_tier_bytes=cfg.kv_host_tier_bytes,
+                    export_page=self._export_arena_page,
+                    model_itemsize=self._model_itemsize())
             elif self._chunked:
                 pool = _BucketPool(
                     bucket, cfg.max_decode_slots, self._cache_factory,
@@ -626,9 +697,32 @@ class GenerationSession:
                                 None if dtype == "auto" else dtype)
 
     def _pages_factory(self, n_pages: int, page_tokens: int):
-        dtype = self.config.kv_cache_dtype
-        return self._init_pages(n_pages, page_tokens,
-                                None if dtype == "auto" else dtype)
+        cfg = self.config
+        dtype = None if cfg.kv_cache_dtype == "auto" else cfg.kv_cache_dtype
+        if cfg.kv_quant_dtype != "none":
+            # quant kwargs only when armed, so custom init_pages lambdas
+            # predating the knob keep working for quant-off sessions
+            return self._init_pages(n_pages, page_tokens, dtype,
+                                    quant_dtype=cfg.kv_quant_dtype,
+                                    quant_block=cfg.kv_quant_block)
+        return self._init_pages(n_pages, page_tokens, dtype)
+
+    def _model_itemsize(self) -> int:
+        """Bytes per element at model precision (first param leaf) — the
+        baseline kv_quant_bytes_saved subtracts the arena's actual
+        storage cost from."""
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(self.params)
+        return int(np.dtype(leaves[0].dtype).itemsize) if leaves else 0
+
+    def _export_arena_page(self, pool, pid: int):
+        """Compiled single-page arena read (the fleet-export program) —
+        the host tier's demotion source."""
+        import jax.numpy as jnp
+
+        return self._paged_c("export")(pool.arena,
+                                       jnp.asarray(int(pid), jnp.int32))
 
     def _prefill_pad(self, plen: int, bucket: int) -> int:
         """Legacy one-shot path: smallest power of two >= plen (floor 8),
@@ -722,6 +816,11 @@ class GenerationSession:
             prefix_len, nodes = pool.trie.match(
                 prompt, max_tokens=len(prompt) - 1)
             pool.trie.pin(nodes)  # survive make_room's evictions
+            if pool.tier is not None:
+                # BEFORE the slot's first decode step: demoted nodes on
+                # the matched path come back into arena pages (manifest
+                # verified); a tier miss truncates the usable prefix
+                nodes, prefix_len = self._promote_path(pool, nodes)
         n_need = pool.pages_needed(len(prompt), max_new)
         if not pool.make_room(n_need - len(nodes)):
             if pool.trie is not None:
@@ -754,6 +853,39 @@ class GenerationSession:
             start=prefix_len, prefix_nodes=nodes, t_submit=t_submit)
         self._next_request_id += 1
         return True
+
+    def _promote_path(self, pool: _PagedPool, nodes):
+        """Promote host-tier refs along a matched (pinned) path back
+        into arena pages: `tier.get` manifest-verifies the host bytes,
+        the compiled import program uploads them into a fresh page, and
+        the node's kv swaps back to `{"page": id}` at full byte cost.
+        The round trip moves exact storage bytes (payload AND scales),
+        so it is bitwise.  A missing/corrupt entry truncates the usable
+        prefix at that node — the tail unpins and prefill recomputes it
+        (never serves unverified KV).  Returns (nodes, prefix_len)."""
+        import jax.numpy as jnp
+
+        for j, node in enumerate(nodes):
+            if is_page_ref(node.kv):
+                continue
+            key = node.kv["host"]
+            try:
+                host_kv = pool.tier.get(key)
+            except (KeyError, TierError) as e:
+                logger.warning("[kv.tier] promotion of %r failed (%s); "
+                               "prefix truncated, chunk recomputes", key, e)
+                host_kv = None
+            if host_kv is None or not pool.make_room(1):
+                pool.trie.unpin(nodes[j:])
+                return nodes[:j], j * pool.chunk
+            pid = pool.pool.alloc()
+            pool.arena = self._paged_c("import")(
+                pool.arena,
+                {k: jnp.asarray(v) for k, v in host_kv.items()},
+                jnp.asarray(pid, jnp.int32))
+            pool.tier.drop(key)
+            pool.trie.reaccount(node, pool.page_bytes, kv={"page": pid})
+        return nodes, len(nodes) * pool.chunk
 
     # ----------------------------------------------------- chunked prefill
     def _prefill_round(self, pool, max_chunks: int) -> int:
@@ -867,6 +999,17 @@ class GenerationSession:
                 chunk_toks = job.prompt[j * pool.chunk:
                                         (j + 1) * pool.chunk]
                 node = pool.trie.lookup_node(nodes, chunk_toks)
+                if node is not None and is_host_ref(node.kv):
+                    # heal: this prefill just rewrote the chunk's bytes
+                    # into a fresh page, so re-point the demoted node at
+                    # it (free re-promotion; also recovers nodes whose
+                    # tier entry was lost to host LRU eviction)
+                    pid = int(pool.table.array[job.slot_idx, j])
+                    pool.pool.share(pid)
+                    if pool.tier is not None:
+                        pool.tier.drop(node.kv["host"])
+                    pool.trie.reaccount(node, pool.page_bytes,
+                                        kv={"page": pid})
                 if node is None:
                     pid = int(pool.table.array[job.slot_idx, j])
                     pool.pool.share(pid)       # the trie's hold
@@ -1009,6 +1152,8 @@ class GenerationSession:
             self._audit_host_aliases(pool)
             if self._paged:
                 self._audit_kv(pool, "first_decode")
+                if "k_scale" in pool.arena:
+                    self._audit_quant_program(result, "first_decode")
         t0 = time.perf_counter()
         if self._paged:
             pool.arena, nxt = result.tree_jitted(*args)
@@ -1025,7 +1170,10 @@ class GenerationSession:
         self.metrics.record_decode_step(len(live), pool.n_slots, dt)
         if self._paged:
             in_use, held = pool.occupancy()
-            self.metrics.record_kv_pool(in_use, held, pool.chunk)
+            self.metrics.record_kv_pool(
+                in_use, held, pool.chunk,
+                quant_bytes_saved=(pool.model_page_bytes
+                                   - pool.page_bytes) * in_use)
 
     # ------------------------------------------------ speculative decoding
     def _spec_round(self, pool) -> bool:
@@ -1179,7 +1327,10 @@ class GenerationSession:
             proposed, accepted, committed, len(eligible), pool.n_slots,
             dt, pages_released=released)
         in_use, held = pool.occupancy()
-        self.metrics.record_kv_pool(in_use, held, pool.chunk)
+        self.metrics.record_kv_pool(
+            in_use, held, pool.chunk,
+            quant_bytes_saved=(pool.model_page_bytes
+                               - pool.page_bytes) * in_use)
         if rest:
             self._decode_round(pool, only=set(rest))
         return True
@@ -1314,13 +1465,32 @@ class GenerationSession:
 
     def _audit_kv(self, pool: _PagedPool, where: str) -> None:
         """KV001: page-table/refcount audit at the state transitions
-        where drift would matter (first decode, every retire)."""
+        where drift would matter (first decode, every retire).  Layer 13
+        rides along: KVQ001 (scale/payload desync) when the arena is
+        quantized, KVQ003 (manifest round trip) when a tier is up."""
         try:
-            from easydist_tpu.analyze import check_page_table
+            from easydist_tpu.analyze import (check_page_table,
+                                              check_quant_arena,
+                                              check_tier_roundtrip)
 
             check_page_table(pool.pool, pool.table, trie=pool.trie,
                              node=f"kv[{where}]")
+            if "k_scale" in pool.arena:
+                check_quant_arena(pool.arena, node=f"kv.quant[{where}]")
+            if pool.tier is not None:
+                check_tier_roundtrip(pool.tier, node=f"kv.tier[{where}]")
         except ImportError:  # analyze is an optional layer at runtime
+            pass
+
+    def _audit_quant_program(self, result, where: str) -> None:
+        """KVQ002: the compiled quant step must never feed int8 K/V into
+        a dot_general undequantized — run once per program, where the
+        donation audit already runs."""
+        try:
+            from easydist_tpu.analyze import check_quant_program
+
+            check_quant_program(result, node=f"decode.quant[{where}]")
+        except ImportError:
             pass
 
     # ------------------------------------------------------------- driving
@@ -1411,13 +1581,22 @@ class GenerationSession:
         so exported paths are layout-agnostic on the wire."""
         import jax.numpy as jnp
 
-        from easydist_tpu.kv import is_page_ref
-
         out = []
         for key, kv in path:
             if is_page_ref(kv):
                 kv = self._paged_c("export")(
                     pool.arena, jnp.asarray(int(kv["page"]), jnp.int32))
+            elif is_host_ref(kv):
+                # demoted chunk: serve the manifest-verified host copy
+                # (tier entry stays — this is an export, not a promotion)
+                try:
+                    host_kv = pool.tier.get(kv["host"]) \
+                        if pool.tier is not None else None
+                except (KeyError, TierError):
+                    host_kv = None
+                if host_kv is None:
+                    break  # keep the exportable prefix contiguous
+                kv = {k: jnp.asarray(v) for k, v in host_kv.items()}
             out.append((key, kv))
         return out
 
@@ -1433,6 +1612,11 @@ class GenerationSession:
         for key, kv in path:
             node = pool.trie.lookup_node(nodes, key)
             if node is None:
+                if set(kv) != set(pool.arena):
+                    # precision/layout mismatch (e.g. a quantized page
+                    # offered to an exact arena): recompute locally
+                    # rather than coerce payload without its scales
+                    break
                 if not pool.make_room(1):
                     break
                 pid = pool.pool.alloc()
@@ -1676,8 +1860,8 @@ class GenerationSession:
                 gpt.gpt_prefill_chunk_paged(p, cfg, pg, tb, t, s, l),
             model_decode_paged=lambda p, pg, tb, t, pos:
                 gpt.gpt_decode_step_paged(p, cfg, pg, tb, t, pos),
-            init_pages=lambda n, t, dt=None: gpt.init_kv_pages(
-                cfg, n, t, dtype=dt),
+            init_pages=lambda n, t, dt=None, **qkw: gpt.init_kv_pages(
+                cfg, n, t, dtype=dt, **qkw),
             model_verify=lambda p, c, t, pos: gpt.gpt_verify_step(
                 p, cfg, c, t, pos),
             model_verify_paged=lambda p, pg, tb, t, pos:
@@ -1713,8 +1897,8 @@ class GenerationSession:
                 llama.llama_prefill_chunk_paged(p, cfg, pg, tb, t, s, l),
             model_decode_paged=lambda p, pg, tb, t, pos:
                 llama.llama_decode_step_paged(p, cfg, pg, tb, t, pos),
-            init_pages=lambda n, t, dt=None: llama.init_kv_pages(
-                cfg, n, t, dtype=dt),
+            init_pages=lambda n, t, dt=None, **qkw: llama.init_kv_pages(
+                cfg, n, t, dtype=dt, **qkw),
             model_verify=lambda p, c, t, pos: llama.llama_verify_step(
                 p, cfg, c, t, pos),
             model_verify_paged=lambda p, pg, tb, t, pos:

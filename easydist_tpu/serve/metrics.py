@@ -97,6 +97,7 @@ class ServeMetrics:
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {}
         self._gauges: Dict[str, float] = {}
+        self._prompt_hist: Dict[int, int] = {}  # prompt_len -> admissions
         self.queue_wait = LatencyHistogram()
         self.execute = LatencyHistogram()
         self.e2e = LatencyHistogram()
@@ -183,6 +184,11 @@ class ServeMetrics:
         with self._lock:
             self._counters["prefills"] = \
                 self._counters.get("prefills", 0) + 1
+            # prompt-length histogram (exact counts per length) — what
+            # sim/capacity.py::TrafficSpec.from_metrics reconstructs its
+            # prompt distribution from
+            self._prompt_hist[prompt_len] = \
+                self._prompt_hist.get(prompt_len, 0) + 1
             self._counters["prefill_tokens_real"] = \
                 self._counters.get("prefill_tokens_real", 0) \
                 + (prompt_len - prefix_len)
@@ -210,18 +216,25 @@ class ServeMetrics:
             self.execute.observe(chunk_s)
 
     def record_kv_pool(self, pages_in_use: int, mapped_tokens: int,
-                       page_tokens: int) -> None:
+                       page_tokens: int,
+                       quant_bytes_saved: Optional[int] = None) -> None:
         """Paged-KV pool occupancy: `pages_in_use` arena pages are live
         (slot-mapped or trie-held) holding `mapped_tokens` real tokens of
         `pages_in_use * page_tokens` capacity.  `kv_page_utilization` is
         the intra-page fill fraction — 1.0 means zero fragmentation, and
         (1 - it) is the only padding waste the paged layout CAN have
-        (the bucketed pool pads every row to the bucket instead)."""
+        (the bucketed pool pads every row to the bucket instead).
+        `quant_bytes_saved` is HBM the live pages did NOT spend versus
+        model-precision storage (block-scaled int8 payload + scales vs
+        model dtype) — the quantized arena's density win, exported to
+        the PerfDB with every snapshot."""
         with self._lock:
             self._gauges["kv_pages_in_use"] = pages_in_use
             cap = pages_in_use * page_tokens
             self._gauges["kv_page_utilization"] = \
                 (mapped_tokens / cap) if cap else 1.0
+            if quant_bytes_saved is not None:
+                self._gauges["kv_quant_bytes_saved"] = quant_bytes_saved
 
     def record_copy_on_restore_saved(self, nbytes: int) -> None:
         """A prefix restore mapped `nbytes` of committed pages into a
@@ -270,6 +283,7 @@ class ServeMetrics:
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
+            prompt_hist = dict(self._prompt_hist)
             hists = {"queue_wait": self.queue_wait.snapshot(),
                      "execute": self.execute.snapshot(),
                      "e2e": self.e2e.snapshot(),
@@ -277,6 +291,7 @@ class ServeMetrics:
                      "ttft": self.ttft.snapshot()}
         return {"replica_id": self.replica_id,
                 "counters": counters, "gauges": gauges,
+                "prompt_hist": prompt_hist,
                 "latency": hists,
                 "batch_occupancy": self.batch_occupancy(),
                 "compile_cache_hit_rate": self.compile_cache_hit_rate(),

@@ -212,6 +212,22 @@ class PrefixCache:
         self._evict_to(self.bytes_used - 1)
         return self.n_nodes < before
 
+    def evict_node(self, node: _Node) -> bool:
+        """Evict one SPECIFIC unpinned childless node.  `evict_lru`'s
+        byte-driven walk cannot express "only victims holding a device
+        page", which the host tier's eviction fallback needs (evicting a
+        demoted node frees no arena page), so the tier picks its victim
+        via `lru_node` and unlinks it here."""
+        if node.children or node.refcount > 0:
+            return False
+        del node.parent.children[node.key]
+        self.bytes_used -= node.nbytes
+        self.n_nodes -= 1
+        self.evictions += 1
+        if self.on_evict is not None:
+            self.on_evict(node)
+        return True
+
     def _walk(self):
         stack = list(self._root.children.values())
         while stack:
@@ -286,6 +302,35 @@ class PrefixCache:
     def unpin(self, nodes: Sequence[_Node]) -> None:
         for node in nodes:
             node.refcount -= 1
+
+    # ---------------------------------------------------------- tier hooks
+    def lru_node(self, predicate=None) -> Optional[_Node]:
+        """Least-recently-used unpinned node matching `predicate`,
+        INTERIOR nodes included — the host tier's demotion victim
+        selector.  Unlike eviction (which must unlink childless nodes to
+        keep the trie connected), demotion swaps a node's kv in place and
+        leaves it in the trie, so any unpinned node still holding a
+        device page is fair game even when its descendants do too."""
+        victim = None
+        for node in self._walk():
+            if node.refcount > 0:
+                continue
+            if predicate is not None and not predicate(node):
+                continue
+            if victim is None or node.last_used < victim.last_used:
+                victim = node
+        return victim
+
+    def reaccount(self, node: _Node, nbytes: int, kv=None) -> None:
+        """Atomically swap a node's kv value and re-charge its byte cost
+        (demotion: `{"page": id}` -> `{"host": key}` at 0 bytes;
+        promotion: back to the arena page's bytes).  Keeping `node.nbytes`
+        and `self.bytes_used` in one motion is what keeps
+        `check_invariants`' byte audit sound across tier moves."""
+        self.bytes_used += nbytes - node.nbytes
+        node.nbytes = nbytes
+        if kv is not None:
+            node.kv = kv
 
     # ----------------------------------------------------------- reporting
     def stats(self) -> Dict[str, int]:

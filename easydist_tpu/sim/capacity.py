@@ -40,6 +40,49 @@ class TrafficSpec:
     output_weights: Tuple[float, ...] = ()
     prefix_reuse: float = 0.0
 
+    @classmethod
+    def from_metrics(cls, snapshot: Dict[str, Any],
+                     elapsed_s: float) -> "TrafficSpec":
+        """Estimate a TrafficSpec from a live replica's
+        `ServeMetrics.snapshot()` over an `elapsed_s` observation window —
+        closing the loop from admission counters back into the planner
+        (ROADMAP: feed `sim.capacity` from serving telemetry instead of
+        hand-written specs).
+
+          * arrival rate: admissions (`prefills`) / elapsed_s;
+          * prompt distribution: the exact per-length admission histogram
+            (`prompt_hist`), lengths as choices, counts as weights;
+          * output length: mean tokens generated per completed request
+            (one choice — the planner's queueing replay only needs the
+            service-time mass, not the tail shape);
+          * prefix_reuse: restored-token fraction
+            (`prefix_tokens_reused / prefix_tokens_total`), the same
+            quantity the prefix_cache_hit_rate gauge tracks.
+        """
+        if elapsed_s <= 0.0:
+            raise ValueError(f"elapsed_s must be positive, got {elapsed_s}")
+        counters = snapshot.get("counters", {})
+        prefills = int(counters.get("prefills", 0))
+        if prefills < 1:
+            raise ValueError("snapshot has no admissions to estimate from")
+        hist = {int(k): int(v)
+                for k, v in (snapshot.get("prompt_hist") or {}).items()}
+        if not hist:
+            raise ValueError("snapshot carries no prompt_hist (admissions "
+                             "predate the histogram, or a non-serving "
+                             "snapshot was passed)")
+        lens = tuple(sorted(hist))
+        weights = tuple(float(hist[l]) for l in lens)
+        completed = int(counters.get("requests_completed", 0)) or prefills
+        generated = int(counters.get("tokens_generated", 0))
+        out_mean = max(1, round(generated / completed)) if generated else 16
+        total = int(counters.get("prefix_tokens_total", 0))
+        reused = int(counters.get("prefix_tokens_reused", 0))
+        return cls(req_per_s=prefills / elapsed_s,
+                   prompt_lens=lens, prompt_weights=weights,
+                   output_lens=(int(out_mean),),
+                   prefix_reuse=(reused / total) if total else 0.0)
+
     def sample(self, n: int, seed: int = 0
                ) -> List[Tuple[float, int, int, bool]]:
         """Deterministic trace of `n` arrivals:

@@ -352,6 +352,19 @@ try:
               f"chaos (bound {r.get('ttft_p99_bound')}x)")
     elif not r.get("verify_steps", 0) > 0:
         print("no speculative verify round was in flight during the drill")
+    elif not r.get("int8_wave_parity"):
+        print("int8 wave: quantized crash-resume diverged from the "
+              "single-session int8 reference (re-prefilled pages must "
+              "rebuild bitwise)")
+    elif r.get("int8_wave_dropped", 1) != 0 \
+            or not r.get("int8_wave_recovered", 0) > 0 \
+            or r.get("int8_wave_crashes") != 1 \
+            or r.get("int8_wave_unfired", 1) != 0:
+        print(f"int8 wave drill incomplete (dropped="
+              f"{r.get('int8_wave_dropped')}, recovered="
+              f"{r.get('int8_wave_recovered')}, crashes="
+              f"{r.get('int8_wave_crashes')}, unfired="
+              f"{r.get('int8_wave_unfired')})")
     elif r.get("value") != 1.0:
         print(f"only {r.get('value')} of requests finished clean")
     elif r.get("perf_regression"):
@@ -369,6 +382,81 @@ PYEOF
     fi
 else
     echo "static_checks: jax not importable; skipping bench.py --fleet-chaos"
+fi
+
+# kv-scale gate: the quantized + host-tiered paged-KV economics.  The
+# int8 arm must admit >= 1.8x the sequences per HBM byte, agree with the
+# exact arm >= 0.995 (free-running greedy AND teacher-forced) under a
+# bounded logit drift; the exact arm must stay bitwise with a scale-free
+# arena (quant off is the pre-quant program); the host tier must restore
+# >= 0.9 of its prefix tokens at a 10x-HBM working set with zero sha256
+# manifest failures; and both kv.tier fault points must drill live with
+# every scheduled fault fired
+if python -c "import jax" >/dev/null 2>&1; then
+    echo "== bench.py --kv-scale (quantized + tiered KV density gate)"
+    out=$(python bench.py --kv-scale 2>/dev/null) || rc=1
+    echo "$out"
+    verdict=$(python - "$out" <<'PYEOF'
+import json, sys
+try:
+    r = json.loads(sys.argv[1].strip().splitlines()[-1])
+    if "error" in r:
+        print("error: " + r["error"])
+    elif not r.get("exact_bitwise"):
+        print("exact paged arm diverged from the bucketed session "
+              "(quant-off must stay bitwise)")
+    elif not r.get("exact_scale_free"):
+        print("exact arm's arena carries scale leaves or int8 payloads "
+              "(quant-off purity broken)")
+    elif not r.get("value", 0) >= r.get("ratio_floor", 1.8):
+        print(f"int8 density {r.get('value')}x below the "
+              f"{r.get('ratio_floor')}x slots-per-HBM-byte floor")
+    elif not r.get("greedy_match", 0) >= r.get("match_floor", 0.995) \
+            or not r.get("teacher_forced_match", 0) >= \
+            r.get("match_floor", 0.995):
+        print(f"int8 A/B agreement below floor (greedy "
+              f"{r.get('greedy_match')}, teacher-forced "
+              f"{r.get('teacher_forced_match')}, floor "
+              f"{r.get('match_floor')})")
+    elif not r.get("logit_drift_max", 1e18) <= \
+            r.get("logit_drift_bound", 0):
+        print(f"int8 logit drift {r.get('logit_drift_max')} exceeds "
+              f"bound {r.get('logit_drift_bound')}")
+    elif not r.get("tier_hit_rate", 0) >= r.get("tier_hit_floor", 0.9):
+        print(f"tier hit rate {r.get('tier_hit_rate')} below "
+              f"{r.get('tier_hit_floor')} at "
+              f"{r.get('tier_working_set_x')}x HBM working set")
+    elif r.get("tier_manifest_failures", 1) != 0:
+        print(f"{r.get('tier_manifest_failures')} tier manifest "
+              f"failure(s) — host pages round-tripped corrupt")
+    elif not r.get("tier_pass_bitwise") \
+            or not r.get("tier_invariants_clean"):
+        print("tiered pass diverged or tier/trie invariants dirty")
+    elif r.get("drill_fetch_corrupt_unfired", 1) != 0 \
+            or r.get("drill_host_oom_unfired", 1) != 0:
+        print("a scheduled kv.tier fault never fired (drill tested "
+              "nothing)")
+    elif not r.get("tier_fetch_retries", 0) >= 1 \
+            or not r.get("drill_host_oom_paused"):
+        print("kv.tier drills left no footprint (no manifest-caught "
+              "refetch, or OOM never paused demotion)")
+    elif r.get("verdict") != "ok":
+        print(f"scenario verdict {r.get('verdict')}")
+    elif r.get("perf_regression"):
+        print(f"committed-floor regression: {r.get('value')} is >10% "
+              f"below last-good {r.get('last_good_value')}")
+    else:
+        print("ok")
+except Exception as e:
+    print(f"unparseable: {e}")
+PYEOF
+)
+    if [ "$verdict" != "ok" ]; then
+        echo "static_checks: kv-scale gate failed ($verdict)"
+        rc=1
+    fi
+else
+    echo "static_checks: jax not importable; skipping bench.py --kv-scale"
 fi
 
 # elastic-chaos gate: train on 8 virtual devices, take a mesh-shrink

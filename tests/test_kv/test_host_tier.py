@@ -1,0 +1,176 @@
+"""Host KV tier (kv/tier.py): bitwise put/get round trips over the
+manifested fetch path, LRU host eviction under the byte budget,
+manifest-failure demotion-to-miss, and both catalogued fault drills
+(`kv.tier.fetch_corrupt` refetches once; `kv.tier.host_oom` pauses
+hold-and-warn and `resume()` lifts it)."""
+
+import numpy as np
+import pytest
+
+from easydist_tpu.kv.tier import HostTier, TierError, page_digest
+from easydist_tpu.resilience import faultinject
+
+
+def _page(seed=0, tokens=8, head=16, quantized=False):
+    """One trie page's arena leaves — quantized pages carry the scale
+    planes so the manifest covers them too."""
+    rng = np.random.default_rng(seed)
+    if quantized:
+        return {
+            "k": rng.integers(-127, 128, (tokens, head), dtype=np.int8),
+            "v": rng.integers(-127, 128, (tokens, head), dtype=np.int8),
+            "k_scale": rng.random((tokens, 1), dtype=np.float32),
+            "v_scale": rng.random((tokens, 1), dtype=np.float32),
+        }
+    return {"k": rng.random((tokens, head), dtype=np.float32),
+            "v": rng.random((tokens, head), dtype=np.float32)}
+
+
+def _nbytes(page):
+    return sum(a.nbytes for a in page.values())
+
+
+class TestPageDigest:
+    def test_insensitive_to_dict_order(self):
+        page = _page(0)
+        reordered = {k: page[k] for k in reversed(list(page))}
+        assert page_digest(page) == page_digest(reordered)
+
+    def test_sensitive_to_bytes_dtype_and_name(self):
+        page = _page(0)
+        base = page_digest(page)
+        flipped = {k: v.copy() for k, v in page.items()}
+        flipped["k"].reshape(-1).view(np.uint8)[0] ^= 0xFF
+        assert page_digest(flipped) != base
+        renamed = {("kk" if k == "k" else k): v for k, v in page.items()}
+        assert page_digest(renamed) != base
+        recast = dict(page, k=page["k"].astype(np.float64))
+        assert page_digest(recast) != base
+
+    def test_covers_scale_leaves(self):
+        page = _page(0, quantized=True)
+        desynced = {k: v.copy() for k, v in page.items()}
+        desynced["k_scale"][0, 0] += 1.0
+        assert page_digest(desynced) != page_digest(page)
+
+
+class TestRoundTrip:
+    def test_put_get_is_bitwise(self):
+        tier = HostTier(byte_budget=1 << 20)
+        page = _page(1)
+        assert tier.put("n1", page)
+        assert "n1" in tier
+        got = tier.get("n1")
+        assert sorted(got) == sorted(page)
+        for name in page:
+            np.testing.assert_array_equal(got[name], page[name])
+        s = tier.stats()
+        assert s["demotions"] == 1 and s["promotions"] == 1
+        assert s["bytes_used"] == _nbytes(page)
+        assert tier.check_invariants() == []
+
+    def test_quantized_page_round_trips_with_scales(self):
+        tier = HostTier(byte_budget=1 << 20)
+        page = _page(2, quantized=True)
+        assert tier.put("q", page)
+        got = tier.get("q")
+        assert got["k"].dtype == np.int8
+        assert got["k_scale"].dtype == np.float32
+        for name in page:
+            np.testing.assert_array_equal(got[name], page[name])
+
+    def test_unknown_key_raises_keyerror(self):
+        tier = HostTier(byte_budget=1 << 20)
+        with pytest.raises(KeyError):
+            tier.get("missing")
+
+    def test_drop_frees_bytes(self):
+        tier = HostTier(byte_budget=1 << 20)
+        page = _page(3)
+        tier.put("n", page)
+        tier.drop("n")
+        assert "n" not in tier
+        assert tier.bytes_used == 0
+        tier.drop("n")  # idempotent
+        assert tier.check_invariants() == []
+
+
+class TestBudget:
+    def test_negative_budget_rejected(self):
+        with pytest.raises(ValueError):
+            HostTier(byte_budget=-1)
+
+    def test_zero_budget_stores_nothing(self):
+        tier = HostTier(byte_budget=0)
+        assert not tier.put("n", _page(0))
+        assert tier.stats()["entries"] == 0
+
+    def test_oversize_page_rejected(self):
+        page = _page(0)
+        tier = HostTier(byte_budget=_nbytes(page) - 1)
+        assert not tier.put("n", page)
+        assert tier.bytes_used == 0
+
+    def test_lru_eviction_under_budget(self):
+        page = _page(0)
+        tier = HostTier(byte_budget=2 * _nbytes(page))
+        tier.put("a", _page(10))
+        tier.put("b", _page(11))
+        tier.get("a")                 # refresh "a" -> "b" is now LRU
+        tier.put("c", _page(12))
+        assert "a" in tier and "c" in tier and "b" not in tier
+        assert tier.stats()["host_evictions"] == 1
+        assert tier.bytes_used <= tier.byte_budget
+        assert tier.check_invariants() == []
+
+
+class TestManifest:
+    def test_corrupt_entry_drops_and_raises(self):
+        tier = HostTier(byte_budget=1 << 20)
+        page = _page(4)
+        tier.put("n", page)
+        # simulate host bit rot after demotion
+        tier._entries["n"].arrays["v"].reshape(-1).view(np.uint8)[0] ^= 0xFF
+        with pytest.raises(TierError):
+            tier.get("n")
+        assert "n" not in tier        # caller sees a miss and recomputes
+        assert tier.stats()["manifest_failures"] == 1
+        assert tier.bytes_used == 0
+        assert tier.check_invariants() == []
+
+    def test_check_invariants_flags_corruption_and_drift(self):
+        tier = HostTier(byte_budget=1 << 20)
+        tier.put("n", _page(5))
+        tier._entries["n"].arrays["k"].reshape(-1).view(np.uint8)[0] ^= 0xFF
+        problems = tier.check_invariants()
+        assert any("manifest" in p for p in problems)
+        tier.bytes_used += 13
+        problems = tier.check_invariants()
+        assert any("accounting drift" in p for p in problems)
+
+
+class TestFaultDrills:
+    def test_fetch_corrupt_refetches_once(self):
+        tier = HostTier(byte_budget=1 << 20)
+        page = _page(6, quantized=True)
+        with faultinject.fault_plan("kv.tier.fetch_corrupt@1"):
+            assert tier.put("n", page)
+            assert faultinject.unfired() == []
+        assert tier.stats()["fetch_retries"] == 1
+        got = tier.get("n")           # the stored copy is the CLEAN one
+        for name in page:
+            np.testing.assert_array_equal(got[name], page[name])
+        assert tier.check_invariants() == []
+
+    def test_host_oom_pauses_hold_and_warn(self):
+        tier = HostTier(byte_budget=1 << 20)
+        with faultinject.fault_plan("kv.tier.host_oom@1"):
+            assert not tier.put("a", _page(7))
+            assert faultinject.unfired() == []
+        assert tier.paused
+        assert not tier.put("b", _page(8))   # paused: no further demotion
+        assert tier.stats()["entries"] == 0
+        tier.resume()
+        assert not tier.paused
+        assert tier.put("c", _page(9))
+        assert "c" in tier

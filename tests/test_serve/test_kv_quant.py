@@ -1,0 +1,376 @@
+"""Quantized + host-tiered paged KV: block-scaled int8 helper round
+trips, quant-off purity (the exact paged arm stays scale-free and
+bitwise vs the bucketed layout), teacher-forced int8 logit drift at the
+model level (gpt AND llama), the host-tier session round trip (demote /
+promote / bitwise pass-2), non-auto `kv_cache_dtype` parity on BOTH
+layouts, ServeConfig validation for the three new knobs, and the layer
+13 KVQ001/002/003 analyzer goldens."""
+
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from easydist_tpu.analyze import (audit_quant_arena, audit_quant_program,
+                                  audit_tier_roundtrip)
+from easydist_tpu.kv.tier import HostTier
+from easydist_tpu.models import gpt, llama
+from easydist_tpu.ops import kv_dequantize, kv_quantize
+from easydist_tpu.serve import GenerationSession, ServeConfig
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = gpt.GPTConfig.tiny()
+    params = gpt.gpt_init(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def llama_model():
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.llama_init(cfg, jax.random.PRNGKey(1))
+    return cfg, params
+
+
+def _config(layout="paged", **kw):
+    kw.setdefault("decode_buckets", (32,))
+    kw.setdefault("max_decode_slots", 2)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("prefill_batch", 2)
+    return ServeConfig(kv_layout=layout, **kw)
+
+
+def _run(params, cfg, prompts, n_new=4, factory=None, session=None, **kw):
+    factory = factory or GenerationSession.for_gpt
+    sess = session or factory(params, cfg, config=_config(**kw))
+    futs = [sess.submit(p, max_new_tokens=n_new) for p in prompts]
+    sess.run_until_drained()
+    return [f.result(timeout=5)["ids"] for f in futs], sess
+
+
+# first prompt spans a full 8-token page so the trie commits it and the
+# pool keeps live pages after drain (the kv_quant_bytes_saved gauge
+# counts live pages only)
+PROMPTS = [[1, 2, 3, 4, 5, 6, 7, 8, 9], [9, 8, 7],
+           [1, 2, 3, 9, 9, 9, 4], [5, 5]]
+
+# each tier prompt spans 3 full pages; five of them overflow a 12-page
+# arena, forcing demotions in pass 1 and promotions in pass 2
+TIER_PROMPTS = [list(range(i, i + 24)) for i in range(1, 6)]
+
+
+# --------------------------------------------------------------- helpers
+class TestQuantHelpers:
+    def test_roundtrip_error_is_block_bounded(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (3, 5, 16),
+                              dtype=jnp.float32)
+        for nb in (1, 2, 4):
+            q, s = kv_quantize(x, nb)
+            assert q.dtype == jnp.int8
+            assert s.dtype == jnp.float32 and s.shape == (3, 5, nb)
+            err = jnp.abs(kv_dequantize(q, s) - x)
+            # worst case is half an int8 step per block: scale/2
+            bound = jnp.repeat(s, 16 // nb, axis=-1) * 0.5 + 1e-6
+            assert bool(jnp.all(err <= bound))
+
+    def test_zero_blocks_dequantize_exactly(self):
+        x = jnp.zeros((2, 8), jnp.float32)
+        q, s = kv_quantize(x, 2)
+        np.testing.assert_array_equal(np.asarray(s), 1.0)
+        np.testing.assert_array_equal(np.asarray(kv_dequantize(q, s)), 0.0)
+
+    def test_quantize_is_deterministic(self):
+        x = jax.random.normal(jax.random.PRNGKey(3), (4, 8))
+        q1, s1 = kv_quantize(x, 2)
+        q2, s2 = kv_quantize(x, 2)
+        np.testing.assert_array_equal(np.asarray(q1), np.asarray(q2))
+        np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+
+    def test_bad_block_count_rejected(self):
+        with pytest.raises(ValueError, match="not a multiple"):
+            kv_quantize(jnp.zeros((2, 8)), 3)
+
+
+# ------------------------------------------------- model-level int8 drift
+def _paged_greedy(params, cfg, prompt_len, n_new, quant, model_mod,
+                  prefill, decode, forced=None):
+    """Teacher-forced paged run: prefill `prompt_len` tokens, decode
+    `n_new` steps feeding the `forced` token stream (or this arm's own
+    argmax).  Returns (tokens, logits at every decode step)."""
+    pt = 8
+    n_pages = 4
+    pages = model_mod.init_kv_pages(cfg, n_pages, pt,
+                                    quant_dtype="int8" if quant else None)
+    table = jnp.arange(n_pages, dtype=jnp.int32)[None, :]
+    toks = list(range(1, prompt_len + 1))
+    logits = None
+    for c0 in range(0, prompt_len, pt):
+        chunk = (toks + [0] * pt)[c0:c0 + pt]
+        pages, logits = prefill(params, cfg, pages, table,
+                                jnp.asarray([chunk]),
+                                jnp.asarray([c0]),
+                                jnp.asarray([min(pt, prompt_len - c0)]))
+    off = (prompt_len - 1) % pt
+    step_logits = [np.asarray(logits[0, off])]
+    cur = forced[0] if forced else int(jnp.argmax(logits[0, off]))
+    out = [cur]
+    for i in range(n_new - 1):
+        pages, logits = decode(params, cfg, pages, table,
+                               jnp.asarray([cur]),
+                               jnp.asarray([prompt_len + i]))
+        step_logits.append(np.asarray(logits[0]))
+        cur = forced[i + 1] if forced else int(jnp.argmax(logits[0]))
+        out.append(cur)
+    return out, step_logits
+
+
+@pytest.mark.parametrize("which", ["gpt", "llama"])
+def test_int8_teacher_forced_drift_bounded(which, model, llama_model):
+    if which == "gpt":
+        cfg, params = model
+        mod, pre, dec = (gpt, gpt.gpt_prefill_chunk_paged,
+                         gpt.gpt_decode_step_paged)
+    else:
+        cfg, params = llama_model
+        mod, pre, dec = (llama, llama.llama_prefill_chunk_paged,
+                         llama.llama_decode_step_paged)
+    exact_toks, exact_logits = _paged_greedy(params, cfg, 13, 5, False,
+                                             mod, pre, dec)
+    # teacher-force the int8 arm on the exact arm's tokens so the two
+    # logit streams are positionally comparable
+    _, quant_logits = _paged_greedy(params, cfg, 13, 5, True, mod, pre,
+                                    dec, forced=exact_toks)
+    drift = max(float(np.max(np.abs(e - q)))
+                for e, q in zip(exact_logits, quant_logits))
+    spread = max(float(np.max(e) - np.min(e)) for e in exact_logits)
+    # int8 block scaling keeps logits within a small fraction of the
+    # logit spread — far from the 0.5 bench drift bound
+    assert drift <= 0.25 * spread, (drift, spread)
+
+
+def test_exact_paged_program_carries_no_int8(model):
+    cfg, params = model
+    pages = gpt.init_kv_pages(cfg, 2, 8)
+    assert sorted(pages) == ["k", "v"]
+    table = jnp.arange(2, dtype=jnp.int32)[None, :]
+    jaxpr = jax.make_jaxpr(
+        lambda pg, t: gpt.gpt_decode_step_paged(
+            params, cfg, pg, table, t, jnp.asarray([8])))(
+                pages, jnp.asarray([1]))
+    assert "i8[" not in str(jaxpr)  # quant-off traces the pre-quant program
+
+
+# ------------------------------------------------------- session behavior
+class TestQuantSession:
+    def test_quant_off_paged_is_scale_free_and_bitwise(self, model):
+        cfg, params = model
+        want, _ = _run(params, cfg, PROMPTS, layout="bucketed")
+        got, sess = _run(params, cfg, PROMPTS, layout="paged")
+        assert got == want
+        pool = next(iter(sess._pools.values()))
+        assert sorted(pool.arena) == ["k", "v"]
+        assert pool.arena["k"].dtype == jnp.dtype(cfg.dtype)
+
+    def test_int8_session_arena_and_accounting(self, model):
+        cfg, params = model
+        _, exact = _run(params, cfg, PROMPTS)
+        got, sess = _run(params, cfg, PROMPTS, kv_quant_dtype="int8")
+        pool = next(iter(sess._pools.values()))
+        epool = next(iter(exact._pools.values()))
+        assert sorted(pool.arena) == ["k", "k_scale", "v", "v_scale"]
+        assert pool.arena["k"].dtype == jnp.int8
+        assert pool.arena["k_scale"].dtype == jnp.float32
+        assert audit_quant_arena(pool.arena) == []
+        # satellite: bytes/seq accounting follows the STORAGE dtype
+        assert pool.page_bytes < epool.page_bytes
+        assert pool.model_page_bytes == epool.page_bytes
+        snap = sess.metrics.snapshot()
+        assert snap["gauges"].get("kv_quant_bytes_saved", 0) > 0
+        # same-seed rerun is deterministic (rint quantization)
+        again, _ = _run(params, cfg, PROMPTS, kv_quant_dtype="int8")
+        assert again == got
+
+    def test_int8_greedy_mostly_matches_exact(self, model):
+        cfg, params = model
+        want, _ = _run(params, cfg, PROMPTS, n_new=6)
+        got, _ = _run(params, cfg, PROMPTS, n_new=6,
+                      kv_quant_dtype="int8")
+        flat_w = [t for ids in want for t in ids]
+        flat_g = [t for ids in got for t in ids]
+        match = sum(a == b for a, b in zip(flat_w, flat_g)) / len(flat_w)
+        # random-init tiny model has near-tied top logits, so a handful
+        # of flips is tie-breaking noise, not quant error (bench gates
+        # the real >= 0.995 floor on a separated-logit config)
+        assert match >= 0.7, (match, want, got)
+
+
+class TestTierSession:
+    def _tier_session(self, cfg, params, **kw):
+        kw.setdefault("kv_arena_pages", 12)
+        kw.setdefault("kv_host_tier_bytes", 1 << 20)
+        return GenerationSession.for_gpt(params, cfg, config=_config(**kw))
+
+    def test_demote_promote_pass2_bitwise(self, model):
+        cfg, params = model
+        sess = self._tier_session(cfg, params)
+        pass1, _ = _run(params, cfg, TIER_PROMPTS, session=sess)
+        assert sess._pools  # paged pool exists before we inspect the tier
+        pool = next(iter(sess._pools.values()))
+        assert pool.tier is not None
+        pass2, _ = _run(params, cfg, TIER_PROMPTS, session=sess)
+        assert pass2 == pass1          # exact dtype: tier trip is bitwise
+        s = pool.tier.stats()
+        assert s["demotions"] > 0, s   # 5 prompts x 3 pages > 12-page arena
+        assert s["promotions"] > 0, s  # pass 2 pulled prefixes back
+        assert s["manifest_failures"] == 0
+        assert audit_tier_roundtrip(pool.tier) == []
+        snap = sess.metrics.snapshot()
+        assert snap["counters"].get("prefix_tokens_reused", 0) > 0
+
+    def test_int8_plus_tier_two_sessions_agree(self, model):
+        cfg, params = model
+        runs = []
+        for _ in range(2):
+            sess = self._tier_session(cfg, params, kv_quant_dtype="int8")
+            ids1, _ = _run(params, cfg, TIER_PROMPTS, session=sess)
+            ids2, _ = _run(params, cfg, TIER_PROMPTS, session=sess)
+            assert ids2 == ids1        # int8 promote/demote is bitwise too
+            runs.append(ids1)
+        assert runs[0] == runs[1]      # rint quantization: run-to-run stable
+
+
+class TestCacheDtypeParity:
+    """Satellite: non-auto `kv_cache_dtype` — bf16 arena parity within
+    the documented tolerance on BOTH layouts (bf16 rounding may flip
+    near-tied argmaxes on the tiny fixture, never most of them)."""
+
+    @pytest.mark.parametrize("layout", ["bucketed", "paged"])
+    def test_bf16_cache_parity(self, layout, model):
+        cfg, params = model
+        want, _ = _run(params, cfg, PROMPTS, n_new=6, layout=layout)
+        got, sess = _run(params, cfg, PROMPTS, n_new=6, layout=layout,
+                         kv_cache_dtype="bfloat16")
+        pool = next(iter(sess._pools.values()))
+        store = pool.arena if layout == "paged" else pool.cache
+        assert store["k"].dtype == jnp.bfloat16
+        flat_w = [t for ids in want for t in ids]
+        flat_g = [t for ids in got for t in ids]
+        match = sum(a == b for a, b in zip(flat_w, flat_g)) / len(flat_w)
+        assert match >= 0.7, (layout, match, want, got)
+        if layout == "paged":
+            # bf16 is exact-path storage, not quantization: scale-free
+            assert sorted(pool.arena) == ["k", "v"]
+
+
+class TestConfigValidation:
+    @pytest.mark.parametrize("kw", [
+        dict(kv_quant_dtype="fp4"),
+        dict(kv_quant_dtype="int8"),                       # needs paged
+        dict(kv_quant_dtype="int8", kv_layout="paged",
+             kv_cache_dtype="bfloat16"),                   # mutually excl.
+        dict(kv_quant_block=-1),
+        dict(kv_host_tier_bytes=-1),
+        dict(kv_host_tier_bytes=1 << 20),                  # needs paged
+        dict(kv_host_tier_bytes=1 << 20, kv_layout="paged",
+             enable_prefix_cache=False),                   # needs the trie
+    ])
+    def test_rejected(self, kw):
+        with pytest.raises(ValueError):
+            ServeConfig(decode_buckets=(32,), **kw)
+
+    def test_accepted(self):
+        sc = ServeConfig(decode_buckets=(32,), kv_layout="paged",
+                         kv_quant_dtype="int8", kv_quant_block=4,
+                         kv_host_tier_bytes=1 << 20)
+        assert sc.kv_quant_dtype == "int8"
+
+
+# ------------------------------------------------------ layer 13 goldens
+def _quant_arena(nb=1, **override):
+    shape = (2, 4, 2, 8, 8)
+    arena = {"k": np.zeros(shape, np.int8),
+             "v": np.zeros(shape, np.int8),
+             "k_scale": np.ones(shape[:-1] + (nb,), np.float32),
+             "v_scale": np.ones(shape[:-1] + (nb,), np.float32)}
+    arena.update(override)
+    return {k: v for k, v in arena.items() if v is not None}
+
+
+class TestKVQ001:
+    def test_clean_quant_arena(self):
+        assert audit_quant_arena(_quant_arena()) == []
+        assert audit_quant_arena(_quant_arena(nb=4)) == []
+
+    def test_clean_exact_arena(self):
+        arena = {"k": np.zeros((2, 4, 2, 8, 8), np.float32),
+                 "v": np.zeros((2, 4, 2, 8, 8), np.float32)}
+        assert audit_quant_arena(arena) == []
+
+    @pytest.mark.parametrize("override, needle", [
+        (dict(v_scale=None), "no v_scale"),
+        (dict(k=None), "no 'k' payload"),
+        (dict(k=np.zeros((2, 4, 2, 8, 8), np.float32)), "scale-free"),
+        (dict(k_scale=np.ones((2, 4, 2, 8, 1), np.float16)), "float32"),
+        (dict(k_scale=np.ones((2, 4, 2, 8, 3), np.float32)),
+         "block-partition"),
+        (dict(k_scale=np.ones((2, 4, 2, 4, 1), np.float32)),
+         "block-partition"),
+    ])
+    def test_desync_fires(self, override, needle):
+        findings = audit_quant_arena(_quant_arena(**override))
+        assert findings, override
+        assert all(f.rule_id == "KVQ001" and f.severity == "error"
+                   for f in findings)
+        assert any(needle in f.message for f in findings), \
+            (needle, [f.message for f in findings])
+
+
+class TestKVQ002:
+    def _result(self, fn, *avals):
+        return types.SimpleNamespace(jitted=fn, in_avals=avals)
+
+    def test_raw_int8_dot_fires(self):
+        res = self._result(
+            lambda q, k: jax.lax.dot_general(
+                q, k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32),
+            jax.ShapeDtypeStruct((4, 8), jnp.int8),
+            jax.ShapeDtypeStruct((8, 4), jnp.int8))
+        findings = audit_quant_program(res)
+        assert findings and all(f.rule_id == "KVQ002" for f in findings)
+        assert "int8" in findings[0].message
+
+    def test_dequantized_dot_is_clean(self):
+        def good(q, k, s):
+            return jnp.dot(q.astype(jnp.float32),
+                           kv_dequantize(k, s).T)
+
+        res = self._result(
+            good,
+            jax.ShapeDtypeStruct((4, 8), jnp.float32),
+            jax.ShapeDtypeStruct((4, 8), jnp.int8),
+            jax.ShapeDtypeStruct((4, 1), jnp.float32))
+        assert audit_quant_program(res) == []
+
+    def test_unretraceable_result_skips(self):
+        res = self._result(lambda: 1 / 0)
+        assert audit_quant_program(res) == []
+
+
+class TestKVQ003:
+    def test_clean_tier(self):
+        tier = HostTier(byte_budget=1 << 20)
+        tier.put("n", {"k": np.ones((4, 4), np.float32)})
+        assert audit_tier_roundtrip(tier) == []
+
+    def test_corrupt_entry_fires(self):
+        tier = HostTier(byte_budget=1 << 20)
+        tier.put("n", {"k": np.ones((4, 4), np.float32)})
+        tier._entries["n"].arrays["k"][0, 0] = 7.0
+        findings = audit_tier_roundtrip(tier)
+        assert len(findings) == 1
+        assert findings[0].rule_id == "KVQ003"
+        assert "manifest" in findings[0].message

@@ -110,3 +110,61 @@ class TestPlanner:
         fat = ReplicaProfile(per_token_s=0.01, chunk_s=0.05,
                              chunk_tokens=16, n_slots=4, chips=2)
         assert CapacityPlanner(fat, MESH).max_replicas == 2
+
+
+class TestTrafficSpecFromMetrics:
+    """Closing the telemetry loop: a replica's ServeMetrics snapshot,
+    replayed from a synthetic arrival log, reconstructs the TrafficSpec
+    the planner needs (rate from admissions, prompt distribution from
+    the exact histogram, output mean from generated tokens, prefix_reuse
+    from the restored-token fraction)."""
+
+    # (prompt_len, prefix_len) arrival log: 9 short, 3 long admissions
+    LOG = [(16, 8)] * 9 + [(64, 0)] * 3
+
+    def _snapshot(self):
+        from easydist_tpu.serve.metrics import ServeMetrics
+
+        m = ServeMetrics(replica_id="r0")
+        for prompt_len, prefix_len in self.LOG:
+            m.record_admission(prompt_len, prefix_len)
+        m.inc("requests_completed", 12)
+        m.inc("tokens_generated", 96)   # mean 8 per completed request
+        return m.snapshot()
+
+    def test_reconstructs_spec_from_replayed_log(self):
+        spec = TrafficSpec.from_metrics(self._snapshot(), elapsed_s=3.0)
+        assert spec.req_per_s == pytest.approx(12 / 3.0)
+        assert spec.prompt_lens == (16, 64)
+        assert spec.prompt_weights == (9.0, 3.0)
+        assert spec.output_lens == (8,)
+        # 9 * 8 reused of 9 * 16 + 3 * 64 submitted tokens
+        assert spec.prefix_reuse == pytest.approx(72 / 336)
+
+    def test_reconstructed_spec_samples_only_seen_lengths(self):
+        spec = TrafficSpec.from_metrics(self._snapshot(), elapsed_s=3.0)
+        trace = spec.sample(64, seed=1)
+        assert {p for _, p, _, _ in trace} <= {16, 64}
+        assert all(o == 8 for _, _, o, _ in trace)
+        # short prompts dominate 3:1 in the log; the trace should too
+        n_short = sum(p == 16 for _, p, _, _ in trace)
+        assert n_short > len(trace) // 2
+
+    def test_no_completions_falls_back_to_admissions(self):
+        from easydist_tpu.serve.metrics import ServeMetrics
+
+        m = ServeMetrics()
+        m.record_admission(32, 0)
+        m.inc("tokens_generated", 4)
+        spec = TrafficSpec.from_metrics(m.snapshot(), elapsed_s=2.0)
+        assert spec.output_lens == (4,)
+
+    def test_bad_windows_rejected(self):
+        snap = self._snapshot()
+        with pytest.raises(ValueError, match="elapsed_s"):
+            TrafficSpec.from_metrics(snap, elapsed_s=0.0)
+        with pytest.raises(ValueError, match="admissions"):
+            TrafficSpec.from_metrics({"counters": {}}, elapsed_s=1.0)
+        with pytest.raises(ValueError, match="prompt_hist"):
+            TrafficSpec.from_metrics(
+                {"counters": {"prefills": 4}}, elapsed_s=1.0)
